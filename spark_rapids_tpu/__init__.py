@@ -1,0 +1,19 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL execution framework.
+
+Re-design of the RAPIDS Accelerator for Apache Spark (NVIDIA/spark-rapids @ v0.3.0)
+for TPU: plan-rewrite engine -> columnar TpuExec operators -> jax/XLA/Pallas kernels
+over padded Arrow-layout device buffers -> mesh/ICI shuffle. See SURVEY.md (reference
+blueprint) and DESIGN.md (TPU-first decisions).
+"""
+
+import jax
+
+# Spark SQL semantics require 64-bit longs/doubles; jax defaults to 32-bit.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .config import TpuConf  # noqa: E402,F401
+from .columnar import dtypes  # noqa: E402,F401
+from .columnar.batch import ColumnarBatch  # noqa: E402,F401
+from .columnar.column import Column, Scalar  # noqa: E402,F401

@@ -1,0 +1,138 @@
+"""ColumnarBatch: a set of equal-capacity device columns + host-known row count.
+
+Analog of Spark's ``ColumnarBatch`` carrying ``GpuColumnVector``s
+(``GpuColumnVector.java:40-535``; batch<->Table converters). The TPU twist
+(DESIGN.md §1): all columns share a bucketed capacity, rows beyond ``num_rows``
+are zeroed+invalid padding, and kernels carry counts as device scalars until a
+host boundary reads them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+from .column import Column, Scalar, bucket
+
+
+class ColumnarBatch:
+    __slots__ = ("schema", "columns", "num_rows")
+
+    def __init__(self, schema: dt.Schema, columns: List[Column], num_rows: int):
+        assert len(schema) == len(columns), "schema/column arity mismatch"
+        caps = {c.capacity for c in columns}
+        assert len(caps) <= 1, f"mixed capacities in batch: {caps}"
+        self.schema = schema
+        self.columns = columns
+        self.num_rows = int(num_rows)
+
+    # -- shape ---------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else bucket(self.num_rows)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def device_size_bytes(self) -> int:
+        return sum(c.device_size_bytes() for c in self.columns)
+
+    def row_mask(self) -> jnp.ndarray:
+        """Bool[capacity] mask of live rows (True for rows < num_rows)."""
+        return jnp.arange(self.capacity) < self.num_rows
+
+    def column(self, name_or_idx) -> Column:
+        if isinstance(name_or_idx, int):
+            return self.columns[name_or_idx]
+        return self.columns[self.schema.index_of(name_or_idx)]
+
+    def with_columns(self, schema: dt.Schema, columns: List[Column],
+                     num_rows: Optional[int] = None) -> "ColumnarBatch":
+        return ColumnarBatch(schema, columns, self.num_rows if num_rows is None else num_rows)
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_pydict(data: Dict[str, Sequence[Any]],
+                    schema: Optional[dt.Schema] = None,
+                    capacity: Optional[int] = None) -> "ColumnarBatch":
+        names = list(data.keys())
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity or bucket(n)
+        cols: List[Column] = []
+        fields: List[dt.Field] = []
+        for name in names:
+            values = data[name]
+            if len(values) != n:
+                raise ValueError(
+                    f"column {name!r} has {len(values)} rows, expected {n}")
+            if schema is not None:
+                dtype = schema[name].dtype
+            else:
+                dtype = _infer_dtype(values)
+            if isinstance(values, np.ndarray) and dtype != dt.STRING:
+                col = Column.from_numpy(values, dtype, capacity=cap)
+            else:
+                col = Column.from_pylist(list(values), dtype, capacity=cap)
+            cols.append(col)
+            fields.append(dt.Field(name, dtype))
+        return ColumnarBatch(schema or dt.Schema(fields), cols, n)
+
+    @staticmethod
+    def from_arrow(table, capacity: Optional[int] = None) -> "ColumnarBatch":
+        """pyarrow Table/RecordBatch -> device batch (the HostColumnarToGpu analog,
+        ref HostColumnarToGpu.scala:30-235)."""
+        n = table.num_rows
+        cap = capacity or bucket(n)
+        cols = [Column.from_arrow(table.column(i), capacity=cap)
+                for i in range(table.num_columns)]
+        fields = [dt.Field(table.schema.names[i], dt.from_arrow(table.schema.types[i]))
+                  for i in range(table.num_columns)]
+        return ColumnarBatch(dt.Schema(fields), cols, n)
+
+    @staticmethod
+    def empty(schema: dt.Schema, capacity: int = 128) -> "ColumnarBatch":
+        cols = [Column.full_null(f.dtype, capacity) for f in schema]
+        return ColumnarBatch(schema, cols, 0)
+
+    # -- host extraction -----------------------------------------------------
+    def to_pydict(self) -> Dict[str, List[Any]]:
+        return {f.name: c.to_pylist(self.num_rows)
+                for f, c in zip(self.schema, self.columns)}
+
+    def to_arrow(self):
+        import pyarrow as pa
+        arrays = [c.to_arrow(self.num_rows) for c in self.columns]
+        return pa.table(arrays, names=self.schema.names())
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def rows(self) -> List[tuple]:
+        """Materialize host rows (GpuColumnarToRowExec analog for small results)."""
+        cols = [c.to_pylist(self.num_rows) for c in self.columns]
+        return list(zip(*cols)) if cols else [()] * self.num_rows
+
+    def __repr__(self):
+        return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
+                f"schema={self.schema})")
+
+
+def _infer_dtype(values: Sequence[Any]) -> dt.DType:
+    if isinstance(values, np.ndarray):
+        return dt.of(values.dtype)
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return dt.BOOL
+        if isinstance(v, int):
+            return dt.INT64
+        if isinstance(v, float):
+            return dt.FLOAT64
+        if isinstance(v, (str, bytes)):
+            return dt.STRING
+    return dt.STRING

@@ -1,0 +1,235 @@
+"""Device columnar containers: the ``GpuColumnVector`` / ``ColumnarBatch`` analog.
+
+Reference: ``GpuColumnVector.java:40-535`` (Spark ColumnVector over a cuDF column) and
+``SURVEY.md`` §2.7. TPU-first differences (DESIGN.md §1, §4):
+
+* every column lives in a *bucketed capacity* (next power of two, min 128) so XLA's
+  compile cache stays bounded; the batch tracks the logical ``num_rows``
+* NULLs are a dense bool validity vector (True = valid), not a bitmask
+* strings are fixed-width padded byte matrices ``uint8[cap, byte_cap]`` plus an
+  ``int32[cap]`` length vector — vectorizable on the VPU — instead of Arrow offsets
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+
+MIN_CAPACITY = 128
+MIN_STRING_WIDTH = 8
+
+
+def bucket(n: int, minimum: int = MIN_CAPACITY) -> int:
+    """Smallest power of two >= max(n, minimum). Bounds XLA recompiles per DESIGN.md §1."""
+    n = max(int(n), minimum)
+    return 1 << (n - 1).bit_length()
+
+
+def string_width_bucket(max_len: int) -> int:
+    return bucket(max_len, MIN_STRING_WIDTH)
+
+
+@dataclass(frozen=True)
+class Scalar:
+    """Device-free scalar value paired with its SQL type (cuDF ``Scalar`` analog,
+    used by ``GpuLiteral``/``GpuScalar`` — literals.scala in the reference)."""
+    value: Any                      # python value; None = null scalar
+    dtype: dt.DType
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+
+class Column:
+    """A device column: storage arrays sized to a capacity >= the batch's num_rows.
+
+    numeric/bool/date/timestamp: ``data[cap]`` with the type's numpy dtype
+    string:                      ``data[cap, byte_cap] uint8`` + ``lengths[cap] int32``
+    All carry ``validity[cap] bool`` (True = valid). Padding rows must be invalid and
+    their data zeroed (zeroed padding keeps kernels free of NaN/garbage hazards).
+    """
+
+    __slots__ = ("dtype", "data", "validity", "lengths")
+
+    def __init__(self, dtype: dt.DType, data, validity, lengths=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.lengths = lengths
+        if dtype == dt.STRING:
+            assert lengths is not None and data.ndim == 2, "string column needs lengths + 2D data"
+        else:
+            assert data.ndim == 1, f"non-string column must be 1D, got {data.ndim}D"
+
+    # -- capacity / shape ----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def byte_width(self) -> int:
+        """Padded byte width for strings; storage width for fixed types."""
+        if self.dtype == dt.STRING:
+            return int(self.data.shape[1])
+        return self.dtype.byte_width
+
+    def device_size_bytes(self) -> int:
+        total = self.data.size * self.data.dtype.itemsize
+        total += self.validity.size * 1
+        if self.lengths is not None:
+            total += self.lengths.size * 4
+        return int(total)
+
+    def arrays(self) -> List[jnp.ndarray]:
+        out = [self.data, self.validity]
+        if self.lengths is not None:
+            out.append(self.lengths)
+        return out
+
+    def with_arrays(self, data, validity, lengths=None) -> "Column":
+        return Column(self.dtype, data, validity,
+                      lengths if lengths is not None else
+                      (None if self.dtype != dt.STRING else self.lengths))
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: Optional[dt.DType] = None,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        values = np.asarray(values)
+        if dtype is None:
+            dtype = dt.of(values.dtype)
+        n = len(values)
+        cap = capacity or bucket(n)
+        storage = np.zeros(cap, dtype=dtype.numpy_dtype)
+        valid = np.zeros(cap, dtype=np.bool_)
+        v = values.astype(dtype.numpy_dtype, copy=False)
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+            if dtype.is_floating:
+                # NaN stays valid (SQL NaN != NULL); nothing to mask here.
+                pass
+        storage[:n] = np.where(validity, v, np.zeros((), dtype=dtype.numpy_dtype)) \
+            if len(v) else v
+        valid[:n] = validity
+        return Column(dtype, jnp.asarray(storage), jnp.asarray(valid))
+
+    @staticmethod
+    def from_pylist(values: Sequence[Any], dtype: dt.DType,
+                    capacity: Optional[int] = None,
+                    width: Optional[int] = None) -> "Column":
+        n = len(values)
+        valid_np = np.array([v is not None for v in values], dtype=np.bool_)
+        if dtype == dt.STRING:
+            encoded = [v.encode("utf-8") if isinstance(v, str)
+                       else (v if isinstance(v, bytes) else b"") for v in values]
+            max_len = max((len(b) for b in encoded), default=0)
+            w = width or string_width_bucket(max_len)
+            if max_len > w:
+                raise ValueError(f"string of {max_len} bytes exceeds width {w}")
+            cap = capacity or bucket(n)
+            mat = np.zeros((cap, w), dtype=np.uint8)
+            lens = np.zeros(cap, dtype=np.int32)
+            for i, b in enumerate(encoded):
+                mat[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+                lens[i] = len(b)
+            lens[:n] = np.where(valid_np, lens[:n], 0)
+            return Column(dt.STRING, jnp.asarray(mat), jnp.asarray(valid_np if cap == n else
+                          np.concatenate([valid_np, np.zeros(cap - n, np.bool_)])),
+                          jnp.asarray(lens))
+        vals = np.array([v if v is not None else 0 for v in values],
+                        dtype=dtype.numpy_dtype)
+        return Column.from_numpy(vals, dtype, valid_np, capacity)
+
+    @staticmethod
+    def from_arrow(arr, capacity: Optional[int] = None,
+                   width: Optional[int] = None) -> "Column":
+        """Build a device column from a pyarrow Array/ChunkedArray (host boundary)."""
+        import pyarrow as pa
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        dtype = dt.from_arrow(arr.type)
+        if dtype == dt.STRING:
+            return Column.from_pylist(arr.to_pylist(), dt.STRING, capacity, width)
+        np_valid = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 else \
+            np.asarray(arr.is_valid())
+        if dtype == dt.TIMESTAMP:
+            values = np.asarray(arr.cast(pa.timestamp("us")).view(pa.int64())
+                                .fill_null(0)).astype(np.int64)
+        elif dtype == dt.DATE:
+            values = np.asarray(arr.view(pa.int32()).fill_null(0)).astype(np.int32)
+        elif dtype == dt.BOOL:
+            values = np.asarray(arr.fill_null(False))
+        else:
+            values = np.asarray(arr.fill_null(0)).astype(dtype.numpy_dtype)
+        return Column.from_numpy(values, dtype, np_valid, capacity)
+
+    @staticmethod
+    def full_null(dtype: dt.DType, capacity: int, width: int = MIN_STRING_WIDTH) -> "Column":
+        valid = jnp.zeros(capacity, dtype=jnp.bool_)
+        if dtype == dt.STRING:
+            return Column(dtype, jnp.zeros((capacity, width), dtype=jnp.uint8), valid,
+                          jnp.zeros(capacity, dtype=jnp.int32))
+        return Column(dtype, jnp.zeros(capacity, dtype=dtype.numpy_dtype), valid)
+
+    @staticmethod
+    def from_scalar(scalar: Scalar, num_rows: int, capacity: Optional[int] = None) -> "Column":
+        cap = capacity or bucket(num_rows)
+        if scalar.is_null:
+            return Column.full_null(scalar.dtype, cap)
+        if scalar.dtype == dt.STRING:
+            return Column.from_pylist([scalar.value] * num_rows, dt.STRING, cap)
+        data = jnp.full(cap, scalar.value, dtype=scalar.dtype.numpy_dtype)
+        valid = jnp.arange(cap) < num_rows
+        data = jnp.where(valid, data, jnp.zeros((), dtype=scalar.dtype.numpy_dtype))
+        return Column(scalar.dtype, data, valid)
+
+    # -- host extraction -----------------------------------------------------
+    def to_numpy(self, num_rows: int) -> np.ndarray:
+        """Host values for the first num_rows rows; NULLs as masked array fill."""
+        if self.dtype == dt.STRING:
+            raise TypeError("use to_pylist for string columns")
+        return np.asarray(self.data[:num_rows])
+
+    def to_pylist(self, num_rows: int) -> List[Any]:
+        valid = np.asarray(self.validity[:num_rows])
+        if self.dtype == dt.STRING:
+            mat = np.asarray(self.data[:num_rows])
+            lens = np.asarray(self.lengths[:num_rows])
+            out: List[Any] = []
+            for i in range(num_rows):
+                if not valid[i]:
+                    out.append(None)
+                else:
+                    out.append(bytes(mat[i, :lens[i]]).decode("utf-8", errors="replace"))
+            return out
+        data = np.asarray(self.data[:num_rows])
+        if self.dtype == dt.BOOL:
+            return [bool(v) if ok else None for v, ok in zip(data, valid)]
+        if self.dtype.is_integral or self.dtype in (dt.DATE, dt.TIMESTAMP):
+            return [int(v) if ok else None for v, ok in zip(data, valid)]
+        return [float(v) if ok else None for v, ok in zip(data, valid)]
+
+    def to_arrow(self, num_rows: int):
+        import pyarrow as pa
+        valid = np.asarray(self.validity[:num_rows])
+        if self.dtype == dt.STRING:
+            return pa.array(self.to_pylist(num_rows), type=pa.string())
+        data = np.asarray(self.data[:num_rows])
+        mask = ~valid  # pyarrow mask semantics: True = null
+        if self.dtype == dt.DATE:
+            return pa.array(data, type=pa.date32(), mask=mask)
+        if self.dtype == dt.TIMESTAMP:
+            return pa.array(data, type=pa.timestamp("us"), mask=mask)
+        return pa.array(data, type=dt.to_arrow(self.dtype), mask=mask)
+
+    def __repr__(self):
+        extra = f", width={self.data.shape[1]}" if self.dtype == dt.STRING else ""
+        return f"Column({self.dtype}, cap={self.capacity}{extra})"

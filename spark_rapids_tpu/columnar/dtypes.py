@@ -1,0 +1,160 @@
+"""SQL type system for spark-rapids-tpu columnar data.
+
+The reference piggybacks on Spark's Catalyst ``DataType`` and cuDF ``DType``
+(type mapping in ``GpuColumnVector.java:40-535``). Here we define a small standalone
+type lattice with mappings to jax/numpy dtypes and Arrow types.
+
+Timestamps are int64 microseconds since epoch (Spark semantics); dates are int32 days
+since epoch — both match the reference's cuDF TIMESTAMP_MICROSECONDS / TIMESTAMP_DAYS
+choices (GpuColumnVector.java type mapping).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DType:
+    name: str
+    numpy_dtype: Optional[np.dtype]   # physical storage dtype (None for STRING: uint8 matrix)
+    is_numeric: bool = False
+    is_integral: bool = False
+    is_floating: bool = False
+    byte_width: int = 0               # fixed-width storage bytes (0 for string)
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+BOOL = DType("boolean", np.dtype(np.bool_), byte_width=1)
+INT8 = DType("tinyint", np.dtype(np.int8), True, True, byte_width=1)
+INT16 = DType("smallint", np.dtype(np.int16), True, True, byte_width=2)
+INT32 = DType("int", np.dtype(np.int32), True, True, byte_width=4)
+INT64 = DType("bigint", np.dtype(np.int64), True, True, byte_width=8)
+FLOAT32 = DType("float", np.dtype(np.float32), True, is_floating=True, byte_width=4)
+FLOAT64 = DType("double", np.dtype(np.float64), True, is_floating=True, byte_width=8)
+STRING = DType("string", None, byte_width=0)
+DATE = DType("date", np.dtype(np.int32), byte_width=4)            # days since epoch
+TIMESTAMP = DType("timestamp", np.dtype(np.int64), byte_width=8)  # micros since epoch
+NULLTYPE = DType("null", np.dtype(np.bool_), byte_width=1)
+
+ALL_TYPES = [BOOL, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64, STRING, DATE, TIMESTAMP]
+_BY_NAME = {t.name: t for t in ALL_TYPES}
+_ALIASES = {
+    "long": INT64, "integer": INT32, "short": INT16, "byte": INT8,
+    "bool": BOOL, "str": STRING, "float32": FLOAT32, "float64": FLOAT64,
+    "real": FLOAT32,
+}
+
+INTEGRAL_TYPES = [INT8, INT16, INT32, INT64]
+NUMERIC_TYPES = INTEGRAL_TYPES + [FLOAT32, FLOAT64]
+ORDERABLE_TYPES = NUMERIC_TYPES + [BOOL, STRING, DATE, TIMESTAMP]
+
+
+def of(name_or_dtype: Any) -> DType:
+    """Resolve a DType from a name, numpy dtype, or python type."""
+    if isinstance(name_or_dtype, DType):
+        return name_or_dtype
+    if isinstance(name_or_dtype, str):
+        t = _BY_NAME.get(name_or_dtype) or _ALIASES.get(name_or_dtype)
+        if t is None:
+            raise ValueError(f"unknown SQL type name {name_or_dtype!r}")
+        return t
+    if name_or_dtype is int:
+        return INT64
+    if name_or_dtype is float:
+        return FLOAT64
+    if name_or_dtype is bool:
+        return BOOL
+    if name_or_dtype is str:
+        return STRING
+    npdt = np.dtype(name_or_dtype)
+    for t in ALL_TYPES:
+        if t.numpy_dtype == npdt and t not in (DATE, TIMESTAMP):
+            return t
+    raise ValueError(f"cannot map {name_or_dtype!r} to a SQL type")
+
+
+def from_arrow(arrow_type) -> DType:
+    import pyarrow as pa
+    if pa.types.is_boolean(arrow_type): return BOOL
+    if pa.types.is_int8(arrow_type): return INT8
+    if pa.types.is_int16(arrow_type): return INT16
+    if pa.types.is_int32(arrow_type): return INT32
+    if pa.types.is_int64(arrow_type): return INT64
+    if pa.types.is_float32(arrow_type): return FLOAT32
+    if pa.types.is_float64(arrow_type): return FLOAT64
+    if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type): return STRING
+    if pa.types.is_date32(arrow_type): return DATE
+    if pa.types.is_timestamp(arrow_type): return TIMESTAMP
+    raise ValueError(f"unsupported arrow type {arrow_type}")
+
+
+def to_arrow(t: DType):
+    import pyarrow as pa
+    mapping = {
+        BOOL: pa.bool_(), INT8: pa.int8(), INT16: pa.int16(), INT32: pa.int32(),
+        INT64: pa.int64(), FLOAT32: pa.float32(), FLOAT64: pa.float64(),
+        STRING: pa.string(), DATE: pa.date32(), TIMESTAMP: pa.timestamp("us"),
+    }
+    return mapping[t]
+
+
+_NUMERIC_PRECEDENCE = [BOOL, INT8, INT16, INT32, INT64, FLOAT32, FLOAT64]
+
+
+def promote(a: DType, b: DType) -> DType:
+    """Numeric widening per Spark's TypeCoercion precedence
+    (Byte < Short < Int < Long < Float < Double): the result is the higher-
+    precedence type, so e.g. long + float -> float, int + smallint -> int."""
+    if a == b:
+        return a
+    try:
+        ia, ib = _NUMERIC_PRECEDENCE.index(a), _NUMERIC_PRECEDENCE.index(b)
+    except ValueError:
+        raise ValueError(f"cannot promote {a} and {b}") from None
+    return _NUMERIC_PRECEDENCE[max(ia, ib)]
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DType
+    nullable: bool = True
+
+
+class Schema:
+    def __init__(self, fields):
+        self.fields = [f if isinstance(f, Field) else Field(f[0], of(f[1])) for f in fields]
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self.fields[key]
+        return self.fields[self._index[key]]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __repr__(self):
+        inner = ", ".join(f"{f.name}: {f.dtype}" for f in self.fields)
+        return f"Schema({inner})"

@@ -1,0 +1,384 @@
+"""Typed configuration registry for spark-rapids-tpu.
+
+TPU-native analog of the reference's ``RapidsConf`` (see
+``/root/reference/sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:116-278``
+for the builder DSL and ``:282-762`` for the key registry). Mirrors its shape:
+
+* a self-documenting builder DSL (``conf("spark.rapids.tpu...").doc(...).integerConf
+  .createWithDefault(...)``)
+* byte-unit parsing for memory sizes
+* ``internal()`` keys hidden from docs
+* per-operator auto-generated enable/disable keys (``spark.rapids.tpu.sql.expression.<Name>``,
+  cf. GpuOverrides.scala:129-137) are registered dynamically by the rule registry in
+  ``plan/overrides.py``
+* ``help_text()`` generates docs/configs.md like RapidsConf.confHelp (RapidsConf.scala:133-168)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_BYTE_SUFFIXES = {
+    "b": 1,
+    "k": 1 << 10, "kb": 1 << 10, "kib": 1 << 10,
+    "m": 1 << 20, "mb": 1 << 20, "mib": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "gib": 1 << 30,
+    "t": 1 << 40, "tb": 1 << 40, "tib": 1 << 40,
+}
+
+
+def parse_bytes(value: Any) -> int:
+    """Parse '2g', '512m', '1024' etc. into a byte count (Spark byte-string semantics)."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    s = str(value).strip().lower()
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([a-z]*)", s)
+    if not m:
+        raise ValueError(f"cannot parse byte value: {value!r}")
+    num, suffix = m.groups()
+    mult = _BYTE_SUFFIXES.get(suffix or "b")
+    if mult is None:
+        raise ValueError(f"unknown byte suffix in {value!r}")
+    return int(float(num) * mult)
+
+
+def _parse_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    s = str(value).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"cannot parse boolean value: {value!r}")
+
+
+@dataclass
+class ConfEntry:
+    key: str
+    doc: str
+    default: Any
+    converter: Callable[[Any], Any]
+    type_name: str
+    internal: bool = False
+    validator: Optional[Callable[[Any], bool]] = None
+
+    def convert(self, raw: Any) -> Any:
+        v = self.converter(raw)
+        if self.validator is not None and not self.validator(v):
+            raise ValueError(f"invalid value {raw!r} for {self.key}")
+        return v
+
+
+class _ConfBuilder:
+    """Builder DSL: conf(key).doc(...).internal().booleanConf.create_with_default(...)."""
+
+    def __init__(self, registry: "ConfRegistry", key: str):
+        self._registry = registry
+        self._key = key
+        self._doc = ""
+        self._internal = False
+        self._validator: Optional[Callable[[Any], bool]] = None
+        self._converter: Optional[Callable[[Any], Any]] = None
+        self._type_name = "string"
+
+    def doc(self, text: str) -> "_ConfBuilder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "_ConfBuilder":
+        self._internal = True
+        return self
+
+    def check(self, validator: Callable[[Any], bool]) -> "_ConfBuilder":
+        self._validator = validator
+        return self
+
+    @property
+    def boolean_conf(self) -> "_ConfBuilder":
+        self._converter, self._type_name = _parse_bool, "boolean"
+        return self
+
+    @property
+    def integer_conf(self) -> "_ConfBuilder":
+        self._converter, self._type_name = int, "integer"
+        return self
+
+    @property
+    def double_conf(self) -> "_ConfBuilder":
+        self._converter, self._type_name = float, "double"
+        return self
+
+    @property
+    def string_conf(self) -> "_ConfBuilder":
+        self._converter, self._type_name = str, "string"
+        return self
+
+    @property
+    def bytes_conf(self) -> "_ConfBuilder":
+        self._converter, self._type_name = parse_bytes, "byteSize"
+        return self
+
+    def create_with_default(self, default: Any) -> ConfEntry:
+        entry = ConfEntry(
+            key=self._key,
+            doc=self._doc,
+            default=default,
+            converter=self._converter or str,
+            type_name=self._type_name,
+            internal=self._internal,
+            validator=self._validator,
+        )
+        self._registry.register(entry)
+        return entry
+
+
+class ConfRegistry:
+    def __init__(self) -> None:
+        self._entries: Dict[str, ConfEntry] = {}
+        self._lock = threading.Lock()
+
+    def conf(self, key: str) -> _ConfBuilder:
+        return _ConfBuilder(self, key)
+
+    def register(self, entry: ConfEntry) -> None:
+        with self._lock:
+            if entry.key in self._entries:
+                raise ValueError(f"duplicate conf key {entry.key}")
+            self._entries[entry.key] = entry
+
+    def register_dynamic(self, key: str, doc: str, default: bool) -> ConfEntry:
+        """Per-operator enable keys; idempotent (re-registration returns existing)."""
+        with self._lock:
+            if key in self._entries:
+                return self._entries[key]
+            entry = ConfEntry(key=key, doc=doc, default=default,
+                              converter=_parse_bool, type_name="boolean")
+            self._entries[key] = entry
+            return entry
+
+    def get_entry(self, key: str) -> Optional[ConfEntry]:
+        return self._entries.get(key)
+
+    def entries(self) -> List[ConfEntry]:
+        return sorted(self._entries.values(), key=lambda e: e.key)
+
+    def help_text(self, include_internal: bool = False) -> str:
+        lines = [
+            "# spark-rapids-tpu Configuration",
+            "",
+            "| Name | Description | Default |",
+            "|---|---|---|",
+        ]
+        for e in self.entries():
+            if e.internal and not include_internal:
+                continue
+            lines.append(f"| {e.key} | {e.doc} | {e.default} |")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = ConfRegistry()
+_conf = REGISTRY.conf
+
+# ---------------------------------------------------------------------------
+# Core keys (mirroring RapidsConf.scala where the concept transfers; citations
+# point at the reference key this replaces).
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = _conf("spark.rapids.tpu.sql.enabled").doc(
+    "Master enable for columnar TPU acceleration (ref: spark.rapids.sql.enabled, "
+    "RapidsConf.scala:744 area)").boolean_conf.create_with_default(True)
+
+EXPLAIN = _conf("spark.rapids.tpu.sql.explain").doc(
+    "Explain why parts of a query did or did not run on TPU: NONE, NOT_ON_GPU, ALL "
+    "(ref: spark.rapids.sql.explain)").string_conf.check(
+        lambda v: v in ("NONE", "NOT_ON_GPU", "ALL")).create_with_default("NONE")
+
+INCOMPATIBLE_OPS = _conf("spark.rapids.tpu.sql.incompatibleOps.enabled").doc(
+    "Enable ops whose TPU results differ from CPU in corner cases "
+    "(ref: spark.rapids.sql.incompatibleOps.enabled)").boolean_conf.create_with_default(False)
+
+HAS_NANS = _conf("spark.rapids.tpu.sql.hasNans").doc(
+    "Assume floating point data may contain NaNs; gates some agg/join key paths "
+    "(ref: spark.rapids.sql.hasNans)").boolean_conf.create_with_default(True)
+
+VARIABLE_FLOAT_AGG = _conf("spark.rapids.tpu.sql.variableFloatAgg.enabled").doc(
+    "Allow float aggregations whose result may differ from CPU due to reduction order "
+    "(ref: spark.rapids.sql.variableFloatAgg.enabled)").boolean_conf.create_with_default(True)
+
+BATCH_SIZE_BYTES = _conf("spark.rapids.tpu.sql.batchSizeBytes").doc(
+    "Target coalesced columnar batch size in bytes "
+    "(ref: spark.rapids.sql.batchSizeBytes default 2g, RapidsConf.scala:282-377)"
+).bytes_conf.create_with_default(512 * 1024 * 1024)
+
+MAX_READER_BATCH_SIZE_ROWS = _conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per scan batch (ref: spark.rapids.sql.reader.batchSizeRows)"
+).integer_conf.create_with_default(1 << 21)
+
+CONCURRENT_TPU_TASKS = _conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
+    "Number of tasks that may hold the device concurrently "
+    "(ref: spark.rapids.sql.concurrentGpuTasks, RapidsConf.scala:351)"
+).integer_conf.create_with_default(2)
+
+ALLOC_FRACTION = _conf("spark.rapids.tpu.memory.allocFraction").doc(
+    "Fraction of device HBM the pool may use (ref: spark.rapids.memory.gpu.allocFraction)"
+).double_conf.check(lambda v: 0.0 < v <= 1.0).create_with_default(0.9)
+
+HOST_SPILL_STORAGE_SIZE = _conf("spark.rapids.tpu.memory.host.spillStorageSize").doc(
+    "Bound on host-memory spill tier before cascading to disk "
+    "(ref: spark.rapids.memory.host.spillStorageSize, RapidsConf.scala:330)"
+).bytes_conf.create_with_default(4 * 1024 * 1024 * 1024)
+
+SPILL_DIR = _conf("spark.rapids.tpu.memory.spillDir").doc(
+    "Directory for the disk spill tier (ref: Spark local dirs via RapidsDiskBlockManager)"
+).string_conf.create_with_default("/tmp/spark_rapids_tpu_spill")
+
+SHUFFLE_PARTITIONS = _conf("spark.rapids.tpu.sql.shuffle.partitions").doc(
+    "Default number of shuffle partitions (ref: spark.sql.shuffle.partitions)"
+).integer_conf.create_with_default(8)
+
+SHUFFLE_COMPRESSION_CODEC = _conf("spark.rapids.tpu.shuffle.compression.codec").doc(
+    "Codec for shuffle payloads: none, lz4 (ref: spark.rapids.shuffle.compression.codec, "
+    "RapidsConf.scala:729)").string_conf.check(
+        lambda v: v in ("none", "lz4")).create_with_default("none")
+
+REPLACE_SORT_MERGE_JOIN = _conf("spark.rapids.tpu.sql.replaceHashJoin.enabled").doc(
+    "Replace hash joins with TPU sort-merge joins (inverse of the reference's "
+    "spark.rapids.sql.replaceSortMergeJoin.enabled, RapidsConf.scala:450 — TPU prefers "
+    "sort-based joins)").boolean_conf.create_with_default(True)
+
+IMPROVED_TIME_OPS = _conf("spark.rapids.tpu.sql.improvedTimeOps.enabled").doc(
+    "Enable full-range timestamp parsing ops that may differ from CPU "
+    "(ref: spark.rapids.sql.improvedTimeOps.enabled)").boolean_conf.create_with_default(False)
+
+CAST_FLOAT_TO_STRING = _conf("spark.rapids.tpu.sql.castFloatToString.enabled").doc(
+    "Enable float->string casts (formatting differs in corner cases; "
+    "ref: spark.rapids.sql.castFloatToString.enabled)").boolean_conf.create_with_default(False)
+
+CAST_STRING_TO_FLOAT = _conf("spark.rapids.tpu.sql.castStringToFloat.enabled").doc(
+    "Enable string->float casts (ref: spark.rapids.sql.castStringToFloat.enabled)"
+).boolean_conf.create_with_default(False)
+
+CAST_STRING_TO_TIMESTAMP = _conf("spark.rapids.tpu.sql.castStringToTimestamp.enabled").doc(
+    "Enable string->timestamp casts (ref: spark.rapids.sql.castStringToTimestamp.enabled)"
+).boolean_conf.create_with_default(False)
+
+MAX_STRING_BYTES = _conf("spark.rapids.tpu.sql.maxStringBytes").doc(
+    "Maximum padded width of a device string column; wider data falls back to CPU "
+    "(TPU-specific: strings are fixed-width padded byte matrices, see DESIGN.md §4)"
+).integer_conf.create_with_default(1024)
+
+WHOLESTAGE_FUSION = _conf("spark.rapids.tpu.sql.wholeStageFusion.enabled").doc(
+    "Fuse filter/project/partial-agg pipelines into a single XLA computation "
+    "(TPU-specific; see DESIGN.md §2)").boolean_conf.create_with_default(True)
+
+TEST_CONF = _conf("spark.rapids.tpu.sql.test.enabled").doc(
+    "Test mode: assert everything that should be on TPU is on TPU "
+    "(ref: spark.rapids.sql.test.enabled / assertIsOnTheGpu, "
+    "GpuTransitionOverrides.scala:311-367)").internal().boolean_conf.create_with_default(False)
+
+TEST_ALLOWED_NON_TPU = _conf("spark.rapids.tpu.sql.test.allowedNonTpu").doc(
+    "Comma-separated exec/expr class names allowed on CPU in test mode "
+    "(ref: spark.rapids.sql.test.allowedNonGpu)").internal().string_conf.create_with_default("")
+
+METRICS_ENABLED = _conf("spark.rapids.tpu.sql.metrics.enabled").doc(
+    "Collect per-operator metrics (ref: SQLMetrics/GpuMetricNames, GpuExec.scala:27-56)"
+).boolean_conf.create_with_default(True)
+
+TRACING_ENABLED = _conf("spark.rapids.tpu.sql.tracing.enabled").doc(
+    "Wrap hot regions in jax profiler TraceAnnotations (ref: NVTX ranges, "
+    "NvtxWithMetrics.scala:27)").boolean_conf.create_with_default(False)
+
+READER_TYPE = _conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
+    "Parquet reader strategy: PERFILE, COALESCING, MULTITHREADED "
+    "(ref: spark.rapids.sql.format.parquet.reader.type, RapidsConf.scala:510)"
+).string_conf.check(lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED")
+                    ).create_with_default("COALESCING")
+
+READER_THREADS = _conf("spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads").doc(
+    "Background decode threads for the MULTITHREADED reader "
+    "(ref: RapidsConf.scala:548)").integer_conf.create_with_default(4)
+
+
+class TpuConf:
+    """Immutable-ish view over a key->value dict with typed accessors.
+
+    Analog of ``RapidsConf`` the *instance* (constructed per-session from the config map).
+    """
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings: Dict[str, Any] = dict(settings or {})
+        # Environment overrides (lower priority than explicit settings):
+        # SPARK_RAPIDS_TPU_CONF__<KEY WITH DOTS AS __>, case-insensitive —
+        # env names are uppercase so the parsed key is matched against the
+        # registry ignoring case (registered keys are camelCase).
+        lower_to_key = {e.key.lower(): e.key for e in REGISTRY.entries()}
+        for env_key, env_val in os.environ.items():
+            if env_key.startswith("SPARK_RAPIDS_TPU_CONF__"):
+                raw = env_key[len("SPARK_RAPIDS_TPU_CONF__"):].replace("__", ".").lower()
+                key = lower_to_key.get(raw, raw)
+                self._settings.setdefault(key, env_val)
+
+    def get(self, entry: ConfEntry) -> Any:
+        raw = self._settings.get(entry.key, None)
+        if raw is None:
+            return entry.default
+        return entry.convert(raw)
+
+    def get_key(self, key: str, default: Any = None) -> Any:
+        entry = REGISTRY.get_entry(key)
+        if entry is not None:
+            raw = self._settings.get(key)
+            return entry.default if raw is None else entry.convert(raw)
+        return self._settings.get(key, default)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        self._settings[key] = value
+        return self
+
+    def with_overrides(self, overrides: Dict[str, Any]) -> "TpuConf":
+        merged = dict(self._settings)
+        merged.update(overrides)
+        return TpuConf(merged)
+
+    def is_operator_enabled(self, key: str, default: bool) -> bool:
+        entry = REGISTRY.register_dynamic(key, "(per-operator enable key)", default)
+        return self.get(entry)
+
+    # Convenience typed properties used across the codebase ------------------
+    @property
+    def sql_enabled(self) -> bool: return self.get(SQL_ENABLED)
+    @property
+    def explain(self) -> str: return self.get(EXPLAIN)
+    @property
+    def incompatible_ops(self) -> bool: return self.get(INCOMPATIBLE_OPS)
+    @property
+    def has_nans(self) -> bool: return self.get(HAS_NANS)
+    @property
+    def batch_size_bytes(self) -> int: return self.get(BATCH_SIZE_BYTES)
+    @property
+    def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
+    @property
+    def host_spill_storage_size(self) -> int: return self.get(HOST_SPILL_STORAGE_SIZE)
+    @property
+    def spill_dir(self) -> str: return self.get(SPILL_DIR)
+    @property
+    def shuffle_partitions(self) -> int: return self.get(SHUFFLE_PARTITIONS)
+    @property
+    def max_string_bytes(self) -> int: return self.get(MAX_STRING_BYTES)
+    @property
+    def wholestage_fusion(self) -> bool: return self.get(WHOLESTAGE_FUSION)
+    @property
+    def test_enabled(self) -> bool: return self.get(TEST_CONF)
+    @property
+    def test_allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU)
+        return [s.strip() for s in raw.split(",") if s.strip()]
+    @property
+    def metrics_enabled(self) -> bool: return self.get(METRICS_ENABLED)
+    @property
+    def tracing_enabled(self) -> bool: return self.get(TRACING_ENABLED)

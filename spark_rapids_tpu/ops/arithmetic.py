@@ -1,0 +1,210 @@
+"""Arithmetic expressions (GpuAdd/Subtract/Multiply/Divide/Remainder/Pmod/Abs/...).
+
+Reference: ``org/apache/spark/sql/rapids/arithmetic.scala`` (417 LoC) — each op maps
+to a cuDF BinaryOp through ``CudfBinaryExpression``. Here each op is a jnp expression
+with Spark null semantics: result is NULL if any input is NULL; division by zero
+yields NULL (non-ANSI Spark); integral ops wrap on overflow (Java semantics, which
+jnp integer arithmetic matches).
+
+Type coercion is done during analysis (api layer inserts Casts); binary ops here
+assume both sides share the result dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar
+from .expressions import (Expression, combine_validity, data_validity,
+                          result_column)
+
+
+class BinaryArithmetic(Expression):
+    symbol = "?"
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.left.dtype
+
+    def _compute(self, l, r):
+        raise NotImplementedError
+
+    def _extra_validity(self, l, r):
+        """Override to add null-producing conditions (e.g. div by zero)."""
+        return None
+
+    def eval(self, batch: ColumnarBatch):
+        lv = self.left.eval(batch)
+        rv = self.right.eval(batch)
+        if isinstance(lv, Scalar) and isinstance(rv, Scalar):
+            return self._fold_scalars(lv, rv)
+        ld, lval = data_validity(lv, self.dtype)
+        rd, rval = data_validity(rv, self.dtype)
+        extra = self._extra_validity(ld, rd)
+        data = self._compute_safe(ld, rd)
+        validity = combine_validity(lval, rval)
+        if extra is not None:
+            validity = extra if validity is True else (validity & extra)
+        if validity is not True:
+            data = jnp.where(jnp.broadcast_to(validity, (batch.capacity,)), data,
+                             jnp.zeros((), data.dtype))
+        return result_column(self.dtype, data, validity, batch.capacity)
+
+    def _compute_safe(self, l, r):
+        return self._compute(l, r)
+
+    def _fold_scalars(self, lv: Scalar, rv: Scalar) -> Scalar:
+        if lv.is_null or rv.is_null:
+            return Scalar(None, self.dtype)
+        import numpy as np
+        l = jnp.asarray(lv.value, self.dtype.numpy_dtype)
+        r = jnp.asarray(rv.value, self.dtype.numpy_dtype)
+        extra = self._extra_validity(l, r)
+        if extra is not None and not bool(extra):
+            return Scalar(None, self.dtype)
+        out = np.asarray(self._compute_safe(l, r))
+        return Scalar(out.item(), self.dtype)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+    def _compute(self, l, r): return l + r
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+    def _compute(self, l, r): return l - r
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+    def _compute(self, l, r): return l * r
+
+
+class Divide(BinaryArithmetic):
+    """Spark `/`: always floating; x/0 -> NULL (GpuDivide, arithmetic.scala)."""
+    symbol = "/"
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _extra_validity(self, l, r):
+        return r != 0
+
+    def _compute_safe(self, l, r):
+        safe_r = jnp.where(r != 0, r, jnp.ones((), jnp.result_type(r)))
+        return l / safe_r
+
+
+class IntegralDivide(BinaryArithmetic):
+    """Spark `div`: long division; x div 0 -> NULL (GpuIntegralDivide)."""
+    symbol = "div"
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.INT64
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _extra_validity(self, l, r):
+        return r != 0
+
+    def _compute_safe(self, l, r):
+        safe_r = jnp.where(r != 0, r, jnp.ones((), jnp.result_type(r)))
+        # Java integer division truncates toward zero; jnp // floors.
+        q = jnp.floor_divide(l, safe_r)
+        rem = l - q * safe_r
+        neg = ((l < 0) != (safe_r < 0)) & (rem != 0)
+        return (q + jnp.where(neg, jnp.ones((), q.dtype), jnp.zeros((), q.dtype))
+                ).astype(jnp.int64)
+
+
+class Remainder(BinaryArithmetic):
+    """Spark `%`: Java semantics (sign of dividend); x % 0 -> NULL even for floats
+    (GpuRemainder)."""
+    symbol = "%"
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _extra_validity(self, l, r):
+        return r != 0
+
+    def _compute_safe(self, l, r):
+        one = jnp.ones((), jnp.result_type(r))
+        safe_r = jnp.where(r != 0, r, one)
+        # Java %: truncated remainder (same sign as dividend) = jnp.fmod
+        return jnp.fmod(l, safe_r)
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulus (GpuPmod): ((x % y) + y) % y; y == 0 -> NULL."""
+    symbol = "pmod"
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _extra_validity(self, l, r):
+        return r != 0
+
+    def _compute_safe(self, l, r):
+        one = jnp.ones((), jnp.result_type(r))
+        safe_r = jnp.where(r != 0, r, one)
+        m = jnp.fmod(l, safe_r)
+        return jnp.where(m != 0, jnp.fmod(m + safe_r, safe_r), m)
+
+
+class UnaryMinus(Expression):
+    """GpuUnaryMinus."""
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        if isinstance(v, Scalar):
+            return Scalar(None if v.is_null else -v.value, self.dtype)
+        return Column(self.dtype, -v.data, v.validity)
+
+    def __repr__(self):
+        return f"(- {self.children[0]!r})"
+
+
+class UnaryPositive(Expression):
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        return self.children[0].eval(batch)
+
+
+class Abs(Expression):
+    """GpuAbs."""
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        if isinstance(v, Scalar):
+            return Scalar(None if v.is_null else abs(v.value), self.dtype)
+        return Column(self.dtype, jnp.abs(v.data), v.validity)

@@ -1,0 +1,204 @@
+"""Cast expression: the GpuCast matrix (reference ``GpuCast.scala``, 861 LoC).
+
+Device-side (fusable) casts: numeric<->numeric, numeric<->bool, date<->timestamp,
+timestamp<->integral-seconds. Host-side (non-fusable, like the reference's
+conf-gated string casts, GpuOverrides.scala:591-602): anything involving STRING.
+
+Spark non-ANSI semantics implemented here:
+* float->integral saturates at the target range, NaN -> 0 (Java double->long rules)
+* integral->narrower-integral wraps (Java truncation)
+* bool->numeric is 0/1; numeric->bool is x != 0
+* string->numeric returns NULL on unparseable input
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar
+from .expressions import Expression, result_column
+
+_INT_RANGE = {
+    dt.INT8: (-(1 << 7), (1 << 7) - 1),
+    dt.INT16: (-(1 << 15), (1 << 15) - 1),
+    dt.INT32: (-(1 << 31), (1 << 31) - 1),
+    dt.INT64: (-(1 << 63), (1 << 63) - 1),
+}
+
+MICROS_PER_SECOND = 1_000_000
+MICROS_PER_DAY = 86_400 * MICROS_PER_SECOND
+
+
+def _is_device_castable(src: dt.DType, dst: dt.DType) -> bool:
+    if src == dst:
+        return True
+    if dt.STRING in (src, dst):
+        return False
+    return True
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: dt.DType, ansi: bool = False):
+        super().__init__(child)
+        self.to = to
+        self.ansi = ansi
+
+    @property
+    def fusable(self) -> bool:  # type: ignore[override]
+        return _is_device_castable(self.children[0].dtype, self.to)
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.to
+
+    @property
+    def nullable(self) -> bool:
+        src = self.children[0].dtype
+        if src == dt.STRING and self.to != dt.STRING:
+            return True
+        return self.children[0].nullable
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        src = self.children[0].dtype
+        if isinstance(v, Scalar):
+            return _cast_scalar(v, src, self.to)
+        if src == self.to:
+            return v
+        if _is_device_castable(src, self.to):
+            data = device_cast(v.data, src, self.to)
+            return Column(self.to, data, v.validity)
+        return _host_cast_column(v, src, self.to, batch)
+
+    def __repr__(self):
+        return f"cast({self.children[0]!r} AS {self.to})"
+
+
+def device_cast(data: jnp.ndarray, src: dt.DType, dst: dt.DType) -> jnp.ndarray:
+    if src == dst:
+        return data
+    npdst = dst.numpy_dtype
+    if dst == dt.BOOL:
+        return data != 0
+    if src == dt.BOOL:
+        return data.astype(npdst)
+    if src == dt.DATE and dst == dt.TIMESTAMP:
+        return data.astype(jnp.int64) * MICROS_PER_DAY
+    if src == dt.TIMESTAMP and dst == dt.DATE:
+        return jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32)
+    if src == dt.TIMESTAMP and dst.is_integral:
+        secs = jnp.floor_divide(data, MICROS_PER_SECOND)
+        return secs.astype(npdst)
+    if src.is_integral and dst == dt.TIMESTAMP:
+        return data.astype(jnp.int64) * MICROS_PER_SECOND
+    if src == dt.TIMESTAMP and dst.is_floating:
+        return data.astype(jnp.float64) / MICROS_PER_SECOND
+    if src.is_floating and dst == dt.TIMESTAMP:
+        return (data * MICROS_PER_SECOND).astype(jnp.int64)
+    if src.is_floating and dst.is_integral:
+        lo, hi = _INT_RANGE[dst]
+        trunc = jnp.trunc(jnp.where(jnp.isnan(data), 0.0, data))
+        clipped = jnp.clip(trunc, float(lo), float(hi))
+        # first go through int64 (saturating), then wrap-narrow like Java
+        as64 = jnp.where(trunc <= float(lo), jnp.int64(lo),
+                         jnp.where(trunc >= float(hi), jnp.int64(hi),
+                                   clipped.astype(jnp.int64)))
+        return as64.astype(npdst)
+    # integral->integral (wrap), integral->float, float<->float, date<->int
+    return data.astype(npdst)
+
+
+def _cast_scalar(v: Scalar, src: dt.DType, dst: dt.DType) -> Scalar:
+    if v.is_null:
+        return Scalar(None, dst)
+    if src == dst:
+        return v
+    if dst == dt.STRING:
+        return Scalar(_format_value(v.value, src), dst)
+    if src == dt.STRING:
+        return Scalar(_parse_value(v.value, dst), dst)
+    out = np.asarray(device_cast(jnp.asarray(v.value, src.numpy_dtype), src, dst))
+    return Scalar(out.item(), dst)
+
+
+# ---------------------------------------------------------------------------
+# Host-side string casts (non-fusable; analog of conf-gated GpuCast string paths)
+# ---------------------------------------------------------------------------
+
+def _format_value(value, src: dt.DType) -> str:
+    import datetime
+    if src == dt.BOOL:
+        return "true" if value else "false"
+    if src.is_integral:
+        return str(int(value))
+    if src.is_floating:
+        f = float(value)
+        if f != f:
+            return "NaN"
+        if f in (float("inf"), float("-inf")):
+            return "Infinity" if f > 0 else "-Infinity"
+        if f == int(f) and abs(f) < 1e16:
+            return f"{f:.1f}"
+        return repr(f)
+    if src == dt.DATE:
+        return (datetime.date(1970, 1, 1) +
+                datetime.timedelta(days=int(value))).isoformat()
+    if src == dt.TIMESTAMP:
+        ts = datetime.datetime(1970, 1, 1) + datetime.timedelta(
+            microseconds=int(value))
+        base = ts.strftime("%Y-%m-%d %H:%M:%S")
+        if ts.microsecond:
+            return f"{base}.{ts.microsecond:06d}".rstrip("0")
+        return base
+    raise TypeError(f"cannot format {src} as string")
+
+
+def _parse_value(s: str, dst: dt.DType):
+    import datetime
+    s = s.strip()
+    try:
+        if dst == dt.BOOL:
+            ls = s.lower()
+            if ls in ("true", "t", "yes", "y", "1"):
+                return True
+            if ls in ("false", "f", "no", "n", "0"):
+                return False
+            return None
+        if dst.is_integral:
+            val = int(s)
+            lo, hi = _INT_RANGE[dst]
+            return val if lo <= val <= hi else None
+        if dst.is_floating:
+            return float(s)
+        if dst == dt.DATE:
+            return (datetime.date.fromisoformat(s) -
+                    datetime.date(1970, 1, 1)).days
+        if dst == dt.TIMESTAMP:
+            fmt = s.replace("T", " ")
+            d = datetime.datetime.fromisoformat(fmt)
+            epoch = datetime.datetime(1970, 1, 1, tzinfo=d.tzinfo) \
+                if d.tzinfo else datetime.datetime(1970, 1, 1)
+            return int((d - epoch).total_seconds() * MICROS_PER_SECOND)
+    except (ValueError, OverflowError):
+        return None
+    raise TypeError(f"cannot parse string as {dst}")
+
+
+def _host_cast_column(v: Column, src: dt.DType, dst: dt.DType,
+                      batch: ColumnarBatch) -> Column:
+    n = batch.num_rows
+    cap = batch.capacity
+    if src == dt.STRING:
+        values = v.to_pylist(n)
+        parsed = [None if x is None else _parse_value(x, dst) for x in values]
+        return Column.from_pylist(parsed, dst, capacity=cap)
+    # fixed-width -> string
+    valid = np.asarray(v.validity[:n])
+    data = np.asarray(v.data[:n])
+    out = [(_format_value(data[i], src) if valid[i] else None) for i in range(n)]
+    return Column.from_pylist(out, dt.STRING, capacity=cap)

@@ -1,0 +1,181 @@
+"""Conditional expressions: If, CaseWhen, Coalesce, Least, Greatest, Nvl, NullIf.
+
+Reference: ``conditionalExpressions.scala`` + ``nullExpressions.scala`` (~520 LoC).
+All are lazy in Spark row-land but eager columnar here (both branches evaluated,
+selected by mask) — same trade the reference makes on GPU.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar
+from .expressions import Expression, data_validity, materialize, result_column
+
+
+def _select(mask, a: Column, b: Column, dtype: dt.DType, capacity: int) -> Column:
+    """Row-wise select between two materialized columns of the same dtype."""
+    mask = jnp.broadcast_to(mask, (capacity,))
+    validity = jnp.where(mask, a.validity, b.validity)
+    if dtype == dt.STRING:
+        w = max(a.data.shape[1], b.data.shape[1])
+        ad = jnp.pad(a.data, ((0, 0), (0, w - a.data.shape[1])))
+        bd = jnp.pad(b.data, ((0, 0), (0, w - b.data.shape[1])))
+        data = jnp.where(mask[:, None], ad, bd)
+        lengths = jnp.where(mask, a.lengths, b.lengths)
+        return Column(dtype, data, validity, lengths)
+    data = jnp.where(mask, a.data, b.data)
+    return Column(dtype, data, validity)
+
+
+def _bool_mask(v, capacity: int) -> jnp.ndarray:
+    """Predicate value -> taken-mask (NULL predicate counts as false, Spark semantics)."""
+    if isinstance(v, Scalar):
+        taken = bool(v.value) if not v.is_null else False
+        return jnp.broadcast_to(jnp.asarray(taken), (capacity,))
+    return v.data & v.validity
+
+
+class If(Expression):
+    """GpuIf."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[1].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        pred = self.children[0].eval(batch)
+        tv = self.children[1].eval(batch)
+        fv = self.children[2].eval(batch)
+        if isinstance(pred, Scalar) and isinstance(tv, Scalar) and isinstance(fv, Scalar):
+            taken = bool(pred.value) if not pred.is_null else False
+            return tv if taken else fv
+        mask = _bool_mask(pred, batch.capacity)
+        return _select(mask, materialize(tv, batch), materialize(fv, batch),
+                       self.dtype, batch.capacity)
+
+
+class CaseWhen(Expression):
+    """GpuCaseWhen: children = [cond1, val1, cond2, val2, ..., (else)]."""
+
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat.extend([c, v])
+        if else_value is not None:
+            flat.append(else_value)
+        super().__init__(*flat)
+        self.num_branches = len(branches)
+        self.has_else = else_value is not None
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[1].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        cap = batch.capacity
+        if self.has_else:
+            result = materialize(self.children[-1].eval(batch), batch)
+        else:
+            result = Column.full_null(self.dtype, cap)
+        # apply branches last-to-first so the first matching branch wins
+        for i in reversed(range(self.num_branches)):
+            cond = self.children[2 * i].eval(batch)
+            val = materialize(self.children[2 * i + 1].eval(batch), batch)
+            mask = _bool_mask(cond, cap)
+            result = _select(mask, val, result, self.dtype, cap)
+        return result
+
+
+class Coalesce(Expression):
+    """GpuCoalesce: first non-null argument."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    @property
+    def nullable(self) -> bool:
+        return all(c.nullable for c in self.children)
+
+    def eval(self, batch: ColumnarBatch):
+        cap = batch.capacity
+        result = Column.full_null(self.dtype, cap)
+        decided = jnp.zeros(cap, dtype=jnp.bool_)
+        for child in self.children:
+            v = materialize(child.eval(batch), batch)
+            take = (~decided) & v.validity
+            result = _select(take, v, result, self.dtype, cap)
+            decided = decided | v.validity
+        return result
+
+
+class Nvl(Coalesce):
+    """ifnull/nvl = 2-arg coalesce (nullExpressions.scala)."""
+
+
+class NullIf(Expression):
+    """nullif(a, b): NULL when a = b else a."""
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        from .predicates import EqualTo
+        a = materialize(self.children[0].eval(batch), batch)
+        eq = EqualTo(self.children[0], self.children[1]).eval(batch)
+        eq_mask = _bool_mask(eq, batch.capacity)
+        validity = a.validity & ~eq_mask
+        if self.dtype == dt.STRING:
+            return Column(self.dtype, a.data, validity, a.lengths)
+        return Column(self.dtype, jnp.where(validity, a.data,
+                                            jnp.zeros((), a.data.dtype)), validity)
+
+
+class _MinMaxN(Expression):
+    """Least/Greatest: skip NULLs; NULL only when all inputs NULL. NaN handling:
+    greatest treats NaN as largest (Spark uses standard ordering)."""
+
+    _take_greater: bool
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    @property
+    def nullable(self) -> bool:
+        return all(c.nullable for c in self.children)
+
+    def eval(self, batch: ColumnarBatch):
+        cap = batch.capacity
+        result = Column.full_null(self.dtype, cap)
+        for child in self.children:
+            v = materialize(child.eval(batch), batch)
+            if self.dtype == dt.STRING:
+                from .strings_util import string_compare
+                cmp = string_compare(v, result, cap)
+                better = cmp > 0 if self._take_greater else cmp < 0
+            elif self.dtype.is_floating:
+                from .predicates import float_lt
+                better = float_lt(result.data, v.data) if self._take_greater \
+                    else float_lt(v.data, result.data)
+            else:
+                better = v.data > result.data if self._take_greater \
+                    else v.data < result.data
+            take = v.validity & (~result.validity | better)
+            result = _select(take, v, result, self.dtype, cap)
+        return result
+
+
+class Greatest(_MinMaxN):
+    _take_greater = True
+
+
+class Least(_MinMaxN):
+    _take_greater = False

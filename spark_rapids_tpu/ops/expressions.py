@@ -1,0 +1,274 @@
+"""Expression tree base classes: the ``GpuExpression`` analog.
+
+Reference: ``GpuExpressions.scala:63-109`` (columnarEval contract: each expression
+evaluates a ColumnarBatch to a GpuColumnVector or Scalar) plus ``literals.scala``,
+``GpuBoundAttribute.scala``, ``namedExpressions.scala``.
+
+TPU-first difference (DESIGN.md §2): ``eval`` is pure jax.numpy over the batch's
+device arrays, so an entire expression tree traces into ONE XLA computation instead
+of one cuDF kernel launch per node. Expressions that need host work (e.g. number->
+string formatting) set ``fusable = False`` and run eagerly between fused stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar
+
+ColumnOrScalar = Union[Column, Scalar]
+
+
+class Expression:
+    """Base expression. Subclasses set ``children`` and implement ``dtype``/``eval``."""
+
+    fusable: bool = True          # False => needs host execution, breaks stage fusion
+    side_effect_free: bool = True
+
+    def __init__(self, *children: "Expression"):
+        self.children: List[Expression] = list(children)
+
+    @property
+    def dtype(self) -> dt.DType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children else True
+
+    def eval(self, batch: ColumnarBatch) -> ColumnOrScalar:
+        raise NotImplementedError
+
+    # -- tree utilities ------------------------------------------------------
+    def transform(self, fn) -> "Expression":
+        """Bottom-up transform returning a new tree (Catalyst transformUp analog)."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self
+        if new_children != self.children:
+            node = self.with_children(new_children)
+        replaced = fn(node)
+        return node if replaced is None else replaced
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        import copy
+        node = copy.copy(node_src := self)
+        node.children = children
+        # subclasses keeping aliases of children must override
+        node._rebind_child_aliases()
+        return node
+
+    def _rebind_child_aliases(self) -> None:
+        pass
+
+    def collect(self, pred) -> List["Expression"]:
+        out = [self] if pred(self) else []
+        for c in self.children:
+            out.extend(c.collect(pred))
+        return out
+
+    def tree_fusable(self) -> bool:
+        return self.fusable and all(c.tree_fusable() for c in self.children)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def sql_name(self) -> str:
+        return type(self).__name__.lower()
+
+    def __repr__(self):
+        args = ", ".join(repr(c) for c in self.children)
+        return f"{type(self).__name__}({args})"
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class Literal(Expression):
+    """GpuLiteral analog (literals.scala)."""
+
+    def __init__(self, value: Any, dtype: Optional[dt.DType] = None):
+        super().__init__()
+        if dtype is None:
+            if isinstance(value, bool):
+                dtype = dt.BOOL
+            elif isinstance(value, int):
+                dtype = dt.INT64  # will narrow via implicit cast if needed
+            elif isinstance(value, float):
+                dtype = dt.FLOAT64
+            elif isinstance(value, str):
+                dtype = dt.STRING
+            elif value is None:
+                dtype = dt.NULLTYPE
+            else:
+                raise TypeError(f"cannot infer literal type for {value!r}")
+        self._dtype = dtype
+        self.value = value
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    def eval(self, batch: ColumnarBatch) -> Scalar:
+        return Scalar(self.value, self._dtype)
+
+    def __repr__(self):
+        return f"Literal({self.value!r})"
+
+
+class ColumnRef(Expression):
+    """Name-based column reference (pre-binding; Catalyst AttributeReference analog)."""
+
+    def __init__(self, col_name: str):
+        super().__init__()
+        self.col_name = col_name
+        self._resolved: Optional[dt.Field] = None
+
+    def resolve(self, schema: dt.Schema) -> "ColumnRef":
+        self._resolved = schema[self.col_name]
+        return self
+
+    @property
+    def dtype(self) -> dt.DType:
+        if self._resolved is None:
+            raise RuntimeError(f"unresolved column {self.col_name!r}")
+        return self._resolved.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._resolved.nullable if self._resolved else True
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        return batch.column(self.col_name)
+
+    def __repr__(self):
+        return f"col({self.col_name!r})"
+
+
+class BoundReference(Expression):
+    """Ordinal-bound input reference (GpuBoundReference, GpuBoundAttribute.scala)."""
+
+    def __init__(self, ordinal: int, dtype: dt.DType, nullable: bool = True,
+                 col_name: str = ""):
+        super().__init__()
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+        self.col_name = col_name
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        return batch.columns[self.ordinal]
+
+    def __repr__(self):
+        return f"input[{self.ordinal}, {self._dtype}]"
+
+
+class Alias(Expression):
+    """Named output wrapper (GpuAlias, namedExpressions.scala)."""
+
+    def __init__(self, child: Expression, alias: str):
+        super().__init__(child)
+        self.alias = alias
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def _rebind_child_aliases(self) -> None:
+        pass
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.child.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def eval(self, batch: ColumnarBatch) -> ColumnOrScalar:
+        return self.child.eval(batch)
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.alias}"
+
+
+def output_name(expr: Expression, idx: int) -> str:
+    if isinstance(expr, Alias):
+        return expr.alias
+    if isinstance(expr, ColumnRef):
+        return expr.col_name
+    if isinstance(expr, BoundReference) and expr.col_name:
+        return expr.col_name
+    return f"col{idx}"
+
+
+# ---------------------------------------------------------------------------
+# Eval helpers shared by concrete expression modules
+# ---------------------------------------------------------------------------
+
+def materialize(value: ColumnOrScalar, batch: ColumnarBatch) -> Column:
+    """Scalar -> broadcast Column at the batch's capacity (rare; ops prefer inline)."""
+    if isinstance(value, Scalar):
+        return Column.from_scalar(value, batch.num_rows, batch.capacity)
+    return value
+
+
+def data_validity(value: ColumnOrScalar, dtype: dt.DType):
+    """(data, validity) pair usable in jnp broadcasting.
+
+    Scalars become 0-d jnp values + validity True/False python bools so XLA folds
+    them as constants inside fused computations.
+    """
+    if isinstance(value, Scalar):
+        if value.is_null:
+            return jnp.zeros((), dtype=dtype.numpy_dtype), False
+        return jnp.asarray(value.value, dtype=dtype.numpy_dtype), True
+    return value.data, value.validity
+
+
+def combine_validity(*vs):
+    """AND of validities where python ``True`` means always-valid."""
+    cols = [v for v in vs if not (v is True)]
+    if not cols:
+        return True
+    out = cols[0]
+    for v in cols[1:]:
+        out = out & v
+    return out
+
+
+def result_column(dtype: dt.DType, data: jnp.ndarray, validity, capacity: int,
+                  lengths=None) -> Column:
+    if validity is True:
+        validity = jnp.ones(capacity, dtype=jnp.bool_)
+    elif validity is False:
+        validity = jnp.zeros(capacity, dtype=jnp.bool_)
+    if data.ndim == 0 or (dtype != dt.STRING and data.shape[0] != capacity):
+        data = jnp.broadcast_to(data, (capacity,))
+    return Column(dtype, data, validity, lengths)
+
+
+def lit(value: Any, dtype: Optional[dt.DType] = None) -> Literal:
+    return Literal(value, dtype)
+
+
+def col(name: str) -> ColumnRef:
+    return ColumnRef(name)

@@ -1,0 +1,248 @@
+"""Core columnar device kernels: gather, compaction, sort-key encoding, lexsort.
+
+This is the in-tree replacement for the cuDF kernel surface the reference calls
+through JNI (``SURVEY.md`` §2.11: join/groupby/sort/filter/contiguous-split all come
+from ``ai.rapids.cudf``). Everything here is pure-functional jax.numpy so it can run
+eagerly, under ``jax.jit``, or inside a fused whole-stage computation (DESIGN.md §2).
+
+Key techniques (TPU-first, no data-dependent shapes):
+* filter = stable compaction by ``argsort`` of the keep-mask — output capacity equals
+  input capacity, the true row count travels as a device scalar
+* sort = ``jnp.lexsort`` over *order-preserving unsigned key encodings* (sign-flip for
+  ints, IEEE total-order trick for floats, big-endian packed words for strings) with
+  explicit null-rank and padding-rank keys
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+
+_UNSIGNED = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+_SIGNBIT = {1: 0x80, 2: 0x8000, 4: 0x8000_0000, 8: 0x8000_0000_0000_0000}
+
+
+# ---------------------------------------------------------------------------
+# Order-preserving unsigned encodings (for radix-style lexsort keys)
+# ---------------------------------------------------------------------------
+
+def encode_orderable_words(data: jnp.ndarray, dtype: dt.DType,
+                           descending: bool = False) -> List[jnp.ndarray]:
+    """Sort-key arrays (most-significant first) whose lexicographic order equals
+    SQL ascending (or descending) order for this dtype.
+
+    Ints/bool/date/timestamp: unsigned sign-flip encoding (bitwise NOT for desc).
+    Floats: kept AS FLOATS — a NaN-rank key plus a NaN-free value key (negated for
+    desc). No f64 bitcasts: TPU's X64 rewrite cannot bitcast emulated f64, and XLA
+    sorts floats natively anyway. Spark semantics preserved: all NaN sort largest
+    and equal (so desc puts NaN first).
+    """
+    if dtype == dt.BOOL:
+        u = data.astype(jnp.uint8)
+        return [~u if descending else u]
+    if dtype.is_integral or dtype in (dt.DATE, dt.TIMESTAMP):
+        w = dtype.byte_width
+        u = data.astype(_UNSIGNED[w]) ^ jnp.asarray(_SIGNBIT[w], dtype=_UNSIGNED[w])
+        return [~u if descending else u]
+    if dtype.is_floating:
+        is_nan = jnp.isnan(data)
+        nan_rank = jnp.where(is_nan, jnp.uint8(0 if descending else 1),
+                             jnp.uint8(1 if descending else 0))
+        value = jnp.where(is_nan, jnp.zeros((), data.dtype), data)
+        return [nan_rank, -value if descending else value]
+    raise TypeError(f"not an orderable fixed-width type: {dtype}")
+
+
+def pack_string_words(data: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Pack a padded uint8[N, W] byte matrix into big-endian uint32[N, W/4] words.
+
+    Unsigned word-wise lexicographic order == byte-wise lexicographic order because
+    padding bytes are zero and any byte beats end-of-string (0 pad). Cuts lexsort
+    passes by 4x vs per-byte keys.
+    """
+    n, w = data.shape
+    pad_w = (-w) % 4
+    if pad_w:
+        data = jnp.pad(data, ((0, 0), (0, pad_w)))
+        w += pad_w
+    b = data.reshape(n, w // 4, 4).astype(jnp.uint32)
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+class SortKey(NamedTuple):
+    column: Column
+    ascending: bool = True
+    nulls_first: bool = True   # Spark default: NULLS FIRST for asc, NULLS LAST for desc
+
+
+def _key_arrays(key: SortKey) -> List[jnp.ndarray]:
+    """Most-significant-first list of unsigned arrays encoding one sort key."""
+    col, asc = key.column, key.ascending
+    if col.dtype == dt.STRING:
+        words = pack_string_words(col.data, col.lengths)
+        # length as final tie-break: zero padding is indistinguishable from an
+        # embedded NUL in the word keys, and segment_starts compares lengths too
+        encoded = [words[:, i] for i in range(words.shape[1])]
+        encoded.append(col.lengths.astype(jnp.uint32))
+        if not asc:
+            encoded = [~e for e in encoded]
+    else:
+        encoded = encode_orderable_words(col.data, col.dtype, descending=not asc)
+    # null rank precedes value: 0 sorts before 1
+    null_first = key.nulls_first
+    null_rank = jnp.where(col.validity, jnp.uint8(1 if null_first else 0),
+                          jnp.uint8(0 if null_first else 1))
+    return [null_rank] + encoded
+
+
+def sort_indices(keys: Sequence[SortKey], num_rows, capacity: int) -> jnp.ndarray:
+    """Stable permutation ordering live rows by the keys; padding rows go last.
+
+    cuDF analog: ``Table.orderBy`` (used by GpuSortExec, GpuSortExec.scala:33-105).
+    ``num_rows`` may be a python int or a traced device scalar.
+    """
+    pad_rank = (jnp.arange(capacity) >= num_rows).astype(jnp.uint8)
+    msf: List[jnp.ndarray] = [pad_rank]
+    for key in keys:
+        msf.extend(_key_arrays(key))
+    # jnp.lexsort wants least-significant first
+    return jnp.lexsort(tuple(reversed(msf)))
+
+
+# ---------------------------------------------------------------------------
+# Gather / compaction / slicing
+# ---------------------------------------------------------------------------
+
+def gather_column(col: Column, indices: jnp.ndarray,
+                  out_valid: Optional[jnp.ndarray] = None) -> Column:
+    """Row gather; ``out_valid`` additionally masks output rows (False => null+zero).
+
+    cuDF analog: ``Table.gather``. Out-of-range indices must not occur (clip upstream).
+    """
+    validity = col.validity[indices]
+    if out_valid is not None:
+        validity = validity & out_valid
+    if col.dtype == dt.STRING:
+        keep = out_valid if out_valid is not None else None
+        data = col.data[indices]
+        lengths = col.lengths[indices]
+        if keep is not None:
+            data = jnp.where(keep[:, None], data, jnp.uint8(0))
+            lengths = jnp.where(keep, lengths, jnp.int32(0))
+        return Column(col.dtype, data, validity, lengths)
+    data = col.data[indices]
+    if out_valid is not None:
+        data = jnp.where(out_valid, data, jnp.zeros((), data.dtype))
+    return Column(col.dtype, data, validity)
+
+
+def compaction_indices(keep: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(perm, count): stable order with kept rows first. keep must be False on padding."""
+    perm = jnp.argsort(~keep, stable=True)
+    return perm, jnp.sum(keep).astype(jnp.int32)
+
+
+def compact_columns(cols: Sequence[Column], keep: jnp.ndarray
+                    ) -> Tuple[List[Column], jnp.ndarray]:
+    """Filter: keep rows where ``keep`` is True, compacted to the front.
+
+    cuDF analog: ``Table.filter`` (GpuFilter helper, basicPhysicalOperators.scala:98-130).
+    Returns same-capacity columns + device row count; caller syncs/rebuckets at a
+    host boundary (DESIGN.md "dynamic-size protocol").
+    """
+    perm, count = compaction_indices(keep)
+    live = jnp.arange(keep.shape[0]) < count
+    return [gather_column(c, perm, out_valid=live) for c in cols], count
+
+
+def slice_column(col: Column, start: int, out_capacity: int, length) -> Column:
+    """Contiguous slice [start, start+length) into a fresh capacity (host-known start)."""
+    idx = jnp.clip(jnp.arange(out_capacity) + start, 0, col.capacity - 1)
+    live = jnp.arange(out_capacity) < length
+    return gather_column(col, idx, out_valid=live)
+
+
+def concat_columns(cols: Sequence[Column], counts: Sequence[int],
+                   out_capacity: int) -> Column:
+    """Concatenate same-dtype columns into one of out_capacity rows.
+
+    cuDF analog: ``Table.concatenate`` (GpuCoalesceBatches.scala:132-702). Host-known
+    counts (this runs at batch-coalesce boundaries, not inside fused stages).
+    """
+    dtype = cols[0].dtype
+    if dtype == dt.STRING:
+        width = max(int(c.data.shape[1]) for c in cols)
+        datas, valids, lens = [], [], []
+        for c, n in zip(cols, counts):
+            d = c.data[:n]
+            if d.shape[1] < width:
+                d = jnp.pad(d, ((0, 0), (0, width - d.shape[1])))
+            datas.append(d)
+            valids.append(c.validity[:n])
+            lens.append(c.lengths[:n])
+        total = sum(counts)
+        pad = out_capacity - total
+        data = jnp.concatenate(datas + ([jnp.zeros((pad, width), jnp.uint8)] if pad else []))
+        valid = jnp.concatenate(valids + ([jnp.zeros(pad, jnp.bool_)] if pad else []))
+        lengths = jnp.concatenate(lens + ([jnp.zeros(pad, jnp.int32)] if pad else []))
+        return Column(dtype, data, valid, lengths)
+    datas = [c.data[:n] for c, n in zip(cols, counts)]
+    valids = [c.validity[:n] for c, n in zip(cols, counts)]
+    total = sum(counts)
+    pad = out_capacity - total
+    if pad:
+        datas.append(jnp.zeros(pad, datas[0].dtype))
+        valids.append(jnp.zeros(pad, jnp.bool_))
+    return Column(dtype, jnp.concatenate(datas), jnp.concatenate(valids))
+
+
+def rebucket_column(col: Column, num_rows: int, new_capacity: int) -> Column:
+    """Grow/shrink capacity around the first num_rows rows (host-known count)."""
+    return slice_column(col, 0, new_capacity, num_rows)
+
+
+# ---------------------------------------------------------------------------
+# Segment utilities (groupby/window building blocks)
+# ---------------------------------------------------------------------------
+
+def segment_starts_from_sorted_keys(key_cols: Sequence[Column], num_rows,
+                                    capacity: int) -> jnp.ndarray:
+    """Bool[cap]: True where row i starts a new group in key-sorted data.
+
+    NULL keys compare equal to each other (Spark groupby semantics). Padding rows
+    are never starts.
+    """
+    live = jnp.arange(capacity) < num_rows
+    is_start = live & (jnp.arange(capacity) == 0)
+    changed = jnp.zeros(capacity, dtype=jnp.bool_)
+    for col in key_cols:
+        prev_valid = jnp.concatenate([col.validity[:1], col.validity[:-1]])
+        vdiff = col.validity != prev_valid
+        if col.dtype == dt.STRING:
+            prev_d = jnp.concatenate([col.data[:1], col.data[:-1]])
+            ddiff = jnp.any(col.data != prev_d, axis=1)
+            prev_l = jnp.concatenate([col.lengths[:1], col.lengths[:-1]])
+            ddiff = ddiff | (col.lengths != prev_l)
+        else:
+            prev_d = jnp.concatenate([col.data[:1], col.data[:-1]])
+            if col.dtype.is_floating:
+                # NaN == NaN for grouping (Spark normalizes)
+                both_nan = jnp.isnan(col.data) & jnp.isnan(prev_d)
+                ddiff = (col.data != prev_d) & ~both_nan
+            else:
+                ddiff = col.data != prev_d
+        # data diff only matters when both rows valid
+        changed = changed | vdiff | (ddiff & col.validity & prev_valid)
+    idx = jnp.arange(capacity)
+    return is_start | (live & (idx > 0) & changed)
+
+
+def segment_ids(starts: jnp.ndarray) -> jnp.ndarray:
+    """Int32[cap] group id per row from group-start flags (0-based; padding gets last id+)."""
+    return (jnp.cumsum(starts.astype(jnp.int32)) - 1).astype(jnp.int32)

@@ -1,0 +1,203 @@
+"""Math expressions (trig/log/exp/pow/sqrt/...): GpuSin, GpuLog, GpuPow, ...
+
+Reference: ``org/apache/spark/sql/rapids/mathExpressions.scala`` (361 LoC). Spark
+semantics notes: log of non-positive returns NULL; sqrt of negative returns NaN;
+all unary math ops operate on DOUBLE (analysis inserts casts).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar
+from .expressions import Expression, combine_validity, data_validity, result_column
+
+
+class UnaryMath(Expression):
+    """Double -> Double elementwise op."""
+    fn: Callable = None
+    pyfn: Callable = None
+
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.FLOAT64
+
+    def _domain_validity(self, d):
+        """Return extra validity mask (None = total function)."""
+        return None
+
+    def _safe_input(self, d):
+        return d
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        if isinstance(v, Scalar):
+            if v.is_null:
+                return Scalar(None, dt.FLOAT64)
+            x = jnp.asarray(float(v.value))
+            extra = self._domain_validity(x)
+            if extra is not None and not bool(extra):
+                return Scalar(None, dt.FLOAT64)
+            import numpy as np
+            return Scalar(float(np.asarray(type(self).fn(self._safe_input(x)))),
+                          dt.FLOAT64)
+        d = v.data.astype(jnp.float64)
+        extra = self._domain_validity(d)
+        data = type(self).fn(self._safe_input(d))
+        validity = v.validity if extra is None else (v.validity & extra)
+        # keep the zeroed-invalid-rows invariant (column.py): exp(0)=1 etc. would
+        # otherwise leave garbage on null/padding rows
+        data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+        return result_column(dt.FLOAT64, data, validity, batch.capacity)
+
+    def sql_name(self) -> str:
+        return type(self).__name__.lower()
+
+
+def _unary(name: str, fn, domain: Optional[Callable] = None,
+           safe: Optional[Callable] = None) -> type:
+    attrs = {"fn": staticmethod(fn)}
+    if domain is not None:
+        attrs["_domain_validity"] = lambda self, d, _dom=domain: _dom(d)
+    if safe is not None:
+        attrs["_safe_input"] = lambda self, d, _s=safe: _s(d)
+    return type(name, (UnaryMath,), attrs)
+
+
+Sin = _unary("Sin", jnp.sin)
+Cos = _unary("Cos", jnp.cos)
+Tan = _unary("Tan", jnp.tan)
+Asin = _unary("Asin", jnp.arcsin)
+Acos = _unary("Acos", jnp.arccos)
+Atan = _unary("Atan", jnp.arctan)
+Sinh = _unary("Sinh", jnp.sinh)
+Cosh = _unary("Cosh", jnp.cosh)
+Tanh = _unary("Tanh", jnp.tanh)
+Exp = _unary("Exp", jnp.exp)
+Expm1 = _unary("Expm1", jnp.expm1)
+Sqrt = _unary("Sqrt", jnp.sqrt)       # sqrt(<0) = NaN, matches Spark
+Cbrt = _unary("Cbrt", jnp.cbrt)
+Rint = _unary("Rint", jnp.rint)
+Signum = _unary("Signum", jnp.sign)
+ToDegrees = _unary("ToDegrees", jnp.degrees)
+ToRadians = _unary("ToRadians", jnp.radians)
+# Spark: log/log10/log2/log1p of x <= 0 (or <= -1 for log1p) returns NULL
+Log = _unary("Log", jnp.log, domain=lambda d: d > 0,
+             safe=lambda d: jnp.where(d > 0, d, 1.0))
+Log10 = _unary("Log10", jnp.log10, domain=lambda d: d > 0,
+               safe=lambda d: jnp.where(d > 0, d, 1.0))
+Log2 = _unary("Log2", jnp.log2, domain=lambda d: d > 0,
+              safe=lambda d: jnp.where(d > 0, d, 1.0))
+Log1p = _unary("Log1p", jnp.log1p, domain=lambda d: d > -1,
+               safe=lambda d: jnp.where(d > -1, d, 0.0))
+
+
+class Floor(Expression):
+    """GpuFloor: returns LONG for double input (Spark semantics)."""
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.INT64 if self.children[0].dtype.is_floating else self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        if isinstance(v, Scalar):
+            return Scalar(None if v.is_null else math.floor(v.value), self.dtype)
+        if not self.children[0].dtype.is_floating:
+            return v
+        return Column(self.dtype, jnp.floor(v.data).astype(jnp.int64), v.validity)
+
+
+class Ceil(Expression):
+    """GpuCeil."""
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.INT64 if self.children[0].dtype.is_floating else self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        if isinstance(v, Scalar):
+            return Scalar(None if v.is_null else math.ceil(v.value), self.dtype)
+        if not self.children[0].dtype.is_floating:
+            return v
+        return Column(self.dtype, jnp.ceil(v.data).astype(jnp.int64), v.validity)
+
+
+class Pow(Expression):
+    """GpuPow (binary)."""
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch):
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        if isinstance(lv, Scalar) and isinstance(rv, Scalar):
+            if lv.is_null or rv.is_null:
+                return Scalar(None, dt.FLOAT64)
+            return Scalar(float(lv.value) ** float(rv.value), dt.FLOAT64)
+        ld, lval = data_validity(lv, dt.FLOAT64)
+        rd, rval = data_validity(rv, dt.FLOAT64)
+        data = jnp.power(ld.astype(jnp.float64), rd.astype(jnp.float64))
+        validity = combine_validity(lval, rval)
+        if validity is not True:
+            data = jnp.where(validity, data, 0.0)  # pow(0,0)=1 on invalid rows
+        return result_column(dt.FLOAT64, data, validity, batch.capacity)
+
+
+class Atan2(Expression):
+    @property
+    def dtype(self) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch):
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        ld, lval = data_validity(lv, dt.FLOAT64)
+        rd, rval = data_validity(rv, dt.FLOAT64)
+        data = jnp.arctan2(ld.astype(jnp.float64), rd.astype(jnp.float64))
+        validity = combine_validity(lval, rval)
+        if validity is not True:
+            data = jnp.where(validity, data, 0.0)
+        return result_column(dt.FLOAT64, data, validity, batch.capacity)
+
+
+class Round(Expression):
+    """GpuRound: HALF_UP rounding (Spark), scale as literal int."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        super().__init__(child)
+        self.scale = scale
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.children[0].dtype
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        child_t = self.children[0].dtype
+        factor = 10.0 ** self.scale
+        if isinstance(v, Scalar):
+            if v.is_null:
+                return Scalar(None, self.dtype)
+            x = float(v.value)
+            r = math.floor(abs(x) * factor + 0.5) / factor * (1 if x >= 0 else -1)
+            return Scalar(r if child_t.is_floating else int(r), self.dtype)
+        if child_t.is_floating:
+            # HALF_UP: round(|x|*f + 0.5)/f with sign restored (jnp.round is HALF_EVEN)
+            scaled = jnp.abs(v.data) * factor
+            rounded = jnp.floor(scaled + 0.5) / factor
+            data = jnp.where(v.data < 0, -rounded, rounded)
+            return Column(self.dtype, data.astype(v.data.dtype), v.validity)
+        if self.scale >= 0:
+            return v
+        f = int(10 ** (-self.scale))
+        half = f // 2
+        sign = jnp.where(v.data < 0, -1, 1).astype(v.data.dtype)
+        mag = jnp.abs(v.data.astype(jnp.int64))
+        data = ((mag + half) // f * f).astype(v.data.dtype) * sign
+        return Column(self.dtype, data, v.validity)
