@@ -1,0 +1,111 @@
+"""Benchmark: fused columnar SQL pipeline throughput on the TPU chip.
+
+Measures the flagship whole-stage pipeline (filter -> project -> sort-based
+group-by aggregate, DESIGN.md §2) on device over a ~8M-row batch — the
+scan+filter+project+agg hot path of SURVEY.md §3.3 (BASELINE.md milestone
+config 1/2). The same pipeline runs on pandas host CPU as the baseline, so
+``vs_baseline`` is the TPU speedup over single-core pandas (the reference
+repo publishes no numeric GPU baselines — BASELINE.md: "chart image only").
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_inputs(n_rows: int, cap: int):
+    rng = np.random.default_rng(42)
+    keys = np.zeros(cap, dtype=np.int64)
+    keys[:n_rows] = rng.integers(0, 1024, n_rows)
+    key_valid = np.zeros(cap, dtype=bool)
+    key_valid[:n_rows] = True
+    vals = np.zeros(cap, dtype=np.float64)
+    vals[:n_rows] = rng.normal(0, 10, n_rows)
+    val_valid = np.zeros(cap, dtype=bool)
+    val_valid[:n_rows] = rng.random(n_rows) < 0.95
+    flags = np.zeros(cap, dtype=bool)
+    flags[:n_rows] = rng.random(n_rows) < 0.8
+    return keys, key_valid, vals, val_valid, flags
+
+
+def bench_tpu(n_rows: int, cap: int, iters: int = 10) -> float:
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.ops import kernels as K
+    from spark_rapids_tpu.ops import aggregates as agg_k
+
+    keys, key_valid, vals, val_valid, flags = build_inputs(n_rows, cap)
+
+    def fused_stage(keys, key_valid, vals, val_valid, flags, num_rows):
+        live = jnp.arange(cap) < num_rows
+        keep = live & flags & val_valid & (vals > 0)
+        cols = [Column(dt.INT64, keys, key_valid),
+                Column(dt.FLOAT64, vals, val_valid)]
+        compacted, count = K.compact_columns(cols, keep)
+        kcol, vcol = compacted
+        projected = Column(dt.FLOAT64, vcol.data * 2.0 + 1.0, vcol.validity)
+        out_keys, out_aggs, n_groups = agg_k.groupby_aggregate(
+            [kcol], [agg_k.AggSpec("sum", projected),
+                     agg_k.AggSpec("count", projected),
+                     agg_k.AggSpec("max", projected)], count, cap)
+        return (out_keys[0].data, out_aggs[0].data, out_aggs[1].data,
+                out_aggs[2].data, n_groups)
+
+    fn = jax.jit(fused_stage)
+    args = (jnp.asarray(keys), jnp.asarray(key_valid), jnp.asarray(vals),
+            jnp.asarray(val_valid), jnp.asarray(flags), jnp.int32(n_rows))
+    # compile + warm (block_until_ready is unreliable over the device tunnel;
+    # a host scalar fetch is the only true completion barrier)
+    out = fn(*args)
+    _ = int(out[-1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        _ = int(out[-1])   # force completion via host fetch
+    dt_s = (time.perf_counter() - t0) / iters
+    return n_rows / dt_s
+
+
+def bench_pandas(n_rows: int, cap: int, iters: int = 3) -> float:
+    import pandas as pd
+    keys, key_valid, vals, val_valid, flags = build_inputs(n_rows, cap)
+    df = pd.DataFrame({
+        "k": keys[:n_rows],
+        "v": np.where(val_valid[:n_rows], vals[:n_rows], np.nan),
+        "flag": flags[:n_rows]})
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sub = df[df["flag"] & (df["v"] > 0)]
+        proj = sub.assign(p=sub["v"] * 2.0 + 1.0)
+        _ = proj.groupby("k")["p"].agg(["sum", "count", "max"])
+    dt_s = (time.perf_counter() - t0) / iters
+    return n_rows / dt_s
+
+
+def main():
+    n_rows = 8_000_000
+    cap = 1 << 23
+    import jax
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # smaller size when benching without an accelerator (CI sanity)
+        n_rows = 1_000_000
+        cap = 1 << 20
+    tpu_rows_per_s = bench_tpu(n_rows, cap)
+    cpu_rows_per_s = bench_pandas(n_rows, cap)
+    print(json.dumps({
+        "metric": "fused filter+project+groupby throughput",
+        "value": round(tpu_rows_per_s / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(tpu_rows_per_s / cpu_rows_per_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,3 +17,14 @@ from .config import TpuConf  # noqa: E402,F401
 from .columnar import dtypes  # noqa: E402,F401
 from .columnar.batch import ColumnarBatch  # noqa: E402,F401
 from .columnar.column import Column, Scalar  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # lazy: importing the api pulls in the full plan/exec stack
+    if name == "TpuSession":
+        from .api.session import TpuSession
+        return TpuSession
+    if name == "functions":
+        from .api import functions
+        return functions
+    raise AttributeError(name)

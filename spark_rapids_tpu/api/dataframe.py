@@ -1,0 +1,320 @@
+"""DataFrame + session API (pyspark surface over the logical plan builder).
+
+The reference rides Spark's own DataFrame API; standalone we mirror the
+pyspark subset its integration tests exercise (SURVEY.md §4 ring 2: joins,
+aggregates, sorts, repartition, IO round-trips) so those test shapes port.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..columnar import dtypes as dt
+from ..ops import expressions as ex
+from ..ops import predicates as pr
+from ..plan import logical as lp
+from .column import Col, _unwrap
+from . import functions as F
+
+ColumnOrName = Union[Col, str]
+
+
+def _to_expr(c: ColumnOrName) -> ex.Expression:
+    if isinstance(c, str):
+        return ex.ColumnRef(c)
+    return _unwrap(c)
+
+
+class DataFrame:
+    def __init__(self, plan: lp.LogicalPlan, session: "TpuSession"):
+        self._plan = plan
+        self.session = session
+
+    # -- plan access ---------------------------------------------------------
+    @property
+    def schema(self) -> dt.Schema:
+        return self._analyzed().schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names()
+
+    def logical_plan(self) -> lp.LogicalPlan:
+        return self._plan
+
+    def _analyzed(self) -> lp.LogicalPlan:
+        import copy
+        plan = copy.deepcopy(self._plan)
+        return lp.analyze(plan)
+
+    def _df(self, plan: lp.LogicalPlan) -> "DataFrame":
+        return DataFrame(plan, self.session)
+
+    # -- transformations -----------------------------------------------------
+    def select(self, *cols: ColumnOrName) -> "DataFrame":
+        if not cols:
+            cols = tuple(self.columns)
+        exprs = [_to_expr(c) for c in cols]
+        return self._df(lp.Project(self._plan, exprs))
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        raise NotImplementedError("SQL string expressions need the parser")
+
+    def filter(self, condition: Col) -> "DataFrame":
+        return self._df(lp.Filter(self._plan, _unwrap(condition)))
+
+    where = filter
+
+    def withColumn(self, name: str, col: Col) -> "DataFrame":
+        exprs: List[ex.Expression] = []
+        replaced = False
+        for c in self.columns:
+            if c == name:
+                exprs.append(ex.Alias(_unwrap(col), name))
+                replaced = True
+            else:
+                exprs.append(ex.ColumnRef(c))
+        if not replaced:
+            exprs.append(ex.Alias(_unwrap(col), name))
+        return self._df(lp.Project(self._plan, exprs))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [ex.Alias(ex.ColumnRef(c), new) if c == old else ex.ColumnRef(c)
+                 for c in self.columns]
+        return self._df(lp.Project(self._plan, exprs))
+
+    def drop(self, *names: str) -> "DataFrame":
+        exprs = [ex.ColumnRef(c) for c in self.columns if c not in names]
+        return self._df(lp.Project(self._plan, exprs))
+
+    def groupBy(self, *cols: ColumnOrName) -> "GroupedData":
+        return GroupedData(self, [_to_expr(c) for c in cols])
+
+    groupby = groupBy
+
+    def agg(self, *aggs: Col) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"outer": "full", "full_outer": "full", "leftouter": "left",
+               "left_outer": "left", "rightouter": "right",
+               "right_outer": "right", "leftsemi": "left_semi",
+               "semi": "left_semi", "leftanti": "left_anti",
+               "anti": "left_anti"}.get(how, how)
+        cond = None
+        using = None
+        if isinstance(on, Col):
+            cond = _unwrap(on)
+        elif isinstance(on, str):
+            using = [on]
+        elif isinstance(on, (list, tuple)) and on:
+            if isinstance(on[0], str):
+                using = list(on)
+            else:
+                c = _unwrap(on[0])
+                for o in on[1:]:
+                    c = pr.And(c, _unwrap(o))
+                cond = c
+        if using is not None:
+            cond = None
+            for name in using:
+                eq = pr.EqualTo(ex.ColumnRef(name), _UsingRight(name))
+                cond = eq if cond is None else pr.And(cond, eq)
+            plan = lp.Join(self._plan, other._plan, how, cond, using)
+            return self._df(_dedupe_using(plan, using, how, self, other))
+        return self._df(lp.Join(self._plan, other._plan, how, cond))
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return self._df(lp.Join(self._plan, other._plan, "cross"))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._df(lp.Union(self._plan, other._plan))
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return self._df(lp.Distinct(self._plan))
+
+    def dropDuplicates(self, subset: Optional[List[str]] = None) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        grouping = [ex.ColumnRef(c) for c in subset]
+        aggs = []
+        for c in self.columns:
+            if c in subset:
+                aggs.append(ex.ColumnRef(c))
+            else:
+                aggs.append(ex.Alias(
+                    lp.AggregateExpression("first", ex.ColumnRef(c)), c))
+        return self._df(lp.Aggregate(self._plan, grouping, aggs))
+
+    def orderBy(self, *cols, ascending: Optional[Any] = None) -> "DataFrame":
+        orders = []
+        for i, c in enumerate(cols):
+            if isinstance(c, lp.SortOrder):
+                orders.append(c)
+                continue
+            e = _to_expr(c)
+            asc = True
+            if ascending is not None:
+                asc = ascending[i] if isinstance(ascending, (list, tuple)) \
+                    else bool(ascending)
+            orders.append(lp.SortOrder(e, asc))
+        return self._df(lp.Sort(self._plan, orders, is_global=True))
+
+    sort = orderBy
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._df(lp.Limit(self._plan, n))
+
+    def repartition(self, n: int, *cols: ColumnOrName) -> "DataFrame":
+        by = [_to_expr(c) for c in cols] or None
+        return self._df(lp.Repartition(self._plan, n, by))
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return self._df(lp.Repartition(self._plan, n))
+
+    def alias(self, name: str) -> "DataFrame":
+        return self  # single-session name scoping not needed yet
+
+    # -- actions -------------------------------------------------------------
+    def _execute(self):
+        plan = self._analyzed()
+        from ..plan.overrides import Overrides
+        ov = Overrides(self.session.conf)
+        exec_plan = ov.apply(plan)
+        self.session._last_exec_plan = exec_plan
+        self.session._last_overrides = ov
+        return exec_plan
+
+    def collect_batch(self):
+        return self._execute().execute_collect()
+
+    def collect(self) -> List[tuple]:
+        return self.collect_batch().rows()
+
+    def toPandas(self):
+        return self.collect_batch().to_pandas()
+
+    def to_arrow(self):
+        return self.collect_batch().to_arrow()
+
+    def count(self) -> int:
+        plan = lp.Aggregate(self._plan, [], [
+            ex.Alias(lp.AggregateExpression("count_star", None), "count")])
+        df = self._df(plan)
+        return df.collect()[0][0]
+
+    def show(self, n: int = 20, truncate: bool = True) -> None:
+        print(self.limit(n).toPandas().to_string(index=False))
+
+    def explain(self, extended: bool = False) -> None:
+        plan = self._analyzed()
+        from ..plan.overrides import Overrides
+        conf = self.session.conf.with_overrides(
+            {"spark.rapids.tpu.sql.explain": "NONE"})
+        ov = Overrides(conf)
+        exec_plan = ov.apply(plan)
+        print(exec_plan)
+        if extended and ov.last_explain:
+            print(ov.last_explain)
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        self.session._views[name] = self._plan
+
+
+class _UsingRight(ex.ColumnRef):
+    """Marker ref that must resolve against the RIGHT side in a USING join."""
+
+
+def _dedupe_using(plan: lp.Join, using: List[str], how: str,
+                  left: DataFrame, right: DataFrame) -> lp.LogicalPlan:
+    """USING-join output keeps one copy of the key columns (Spark semantics)."""
+    lnames = left.columns
+    rnames = right.columns
+    if how in ("left_semi", "left_anti"):
+        return plan
+    keep: List[ex.Expression] = []
+    for c in lnames:
+        keep.append(ex.ColumnRef(c))
+    for c in rnames:
+        if c not in using:
+            keep.append(ex.ColumnRef(c))
+    return lp.Project(plan, keep)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping: List[ex.Expression]):
+        self.df = df
+        self.grouping = grouping
+
+    def agg(self, *aggs: Union[Col, Dict[str, str]]) -> DataFrame:
+        out: List[ex.Expression] = list(self.grouping)
+        if len(aggs) == 1 and isinstance(aggs[0], dict):
+            aggs = tuple(
+                getattr(F, op if op != "mean" else "avg")(F.col(c))
+                for c, op in aggs[0].items())
+        for a in aggs:
+            out.append(_unwrap(a))
+        return self.df._df(lp.Aggregate(self.df._plan, self.grouping, out))
+
+    def count(self) -> DataFrame:
+        return self.agg(Col(ex.Alias(
+            lp.AggregateExpression("count_star", None), "count")))
+
+    def sum(self, *cols: str) -> DataFrame:
+        return self.agg(*[F.sum(c).alias(f"sum({c})") for c in cols])
+
+    def avg(self, *cols: str) -> DataFrame:
+        return self.agg(*[F.avg(c).alias(f"avg({c})") for c in cols])
+
+    mean = avg
+
+    def min(self, *cols: str) -> DataFrame:
+        return self.agg(*[F.min(c).alias(f"min({c})") for c in cols])
+
+    def max(self, *cols: str) -> DataFrame:
+        return self.agg(*[F.max(c).alias(f"max({c})") for c in cols])
+
+
+class DataFrameWriter:
+    def __init__(self, df: DataFrame):
+        self.df = df
+        self._mode = "error"
+        self._options: Dict[str, Any] = {}
+        self._partition_by: List[str] = []
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, k: str, v: Any) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    def parquet(self, path: str) -> None:
+        self._write("parquet", path)
+
+    def csv(self, path: str) -> None:
+        self._write("csv", path)
+
+    def orc(self, path: str) -> None:
+        self._write("orc", path)
+
+    def _write(self, fmt: str, path: str) -> None:
+        plan = lp.WriteFile(self.df._plan, fmt, path, self._mode,
+                            self._options, self._partition_by)
+        df = self.df._df(plan)
+        exec_plan = df._execute()
+        for part in exec_plan.execute():
+            for _ in part:
+                pass

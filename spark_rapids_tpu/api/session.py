@@ -1,0 +1,188 @@
+"""TpuSession: the SparkSession analog + plugin bootstrap.
+
+Reference: ``SQLPlugin.scala`` + ``Plugin.scala:108-154`` (driver/executor
+init: conf fixup, device+memory init, semaphore init). Standalone, session
+construction performs the executor-side bootstrap directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .. import config as cfg
+from ..columnar import dtypes as dt
+from ..plan import logical as lp
+from .dataframe import DataFrame
+
+
+class TpuSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, Any] = {}
+
+    def config(self, key: str, value: Any = None) -> "TpuSessionBuilder":
+        if isinstance(key, dict):
+            self._conf.update(key)
+        else:
+            self._conf[key] = value
+        return self
+
+    def appName(self, name: str) -> "TpuSessionBuilder":
+        self._conf["app.name"] = name
+        return self
+
+    def master(self, m: str) -> "TpuSessionBuilder":
+        return self
+
+    def getOrCreate(self) -> "TpuSession":
+        return TpuSession(cfg.TpuConf(self._conf))
+
+
+class RuntimeConf:
+    """session.conf facade (set/get like Spark's RuntimeConfig)."""
+
+    def __init__(self, session: "TpuSession"):
+        self._session = session
+
+    def set(self, key: str, value: Any) -> None:
+        self._session.conf = self._session.conf.with_overrides({key: value})
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._session.conf.get_key(key, default)
+
+
+class TpuSession:
+    builder = TpuSessionBuilder()
+
+    _active: Optional["TpuSession"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[cfg.TpuConf] = None):
+        self.conf = conf or cfg.TpuConf()
+        self._views: Dict[str, lp.LogicalPlan] = {}
+        self._last_exec_plan = None
+        self._last_overrides = None
+        self._bootstrap()
+        with TpuSession._lock:
+            TpuSession._active = self
+
+    def _bootstrap(self) -> None:
+        """Executor-plugin init analog (Plugin.scala:124-154): device, memory
+        budget, semaphore, spill catalog."""
+        from ..exec.device import DeviceManager, TpuSemaphore
+        from ..exec.spill import BufferCatalog
+        dm = DeviceManager.get(self.conf)
+        TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
+        cat = BufferCatalog.get()
+        cat.device_budget = dm.memory_budget_bytes
+
+    @classmethod
+    def active(cls) -> "TpuSession":
+        with cls._lock:
+            if cls._active is None:
+                cls._active = TpuSession()
+            return cls._active
+
+    # -- dataframe creation --------------------------------------------------
+    def createDataFrame(self, data, schema=None) -> DataFrame:
+        import pandas as pd
+        import pyarrow as pa
+        if isinstance(data, pd.DataFrame):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:
+            # rows: list of tuples/dicts (+ schema names)
+            if schema is not None and isinstance(schema, (list, tuple)):
+                names = list(schema)
+                cols = {n: [row[i] for row in data] for i, n in enumerate(names)}
+                table = pa.table(cols)
+            elif data and isinstance(data[0], dict):
+                names = list(data[0].keys())
+                cols = {n: [row.get(n) for row in data] for n in names}
+                table = pa.table(cols)
+            else:
+                raise TypeError("provide schema names for row data")
+        if isinstance(schema, dt.Schema):
+            # cast arrow table to requested types
+            import pyarrow as pa
+            fields = [pa.field(f.name, dt.to_arrow(f.dtype)) for f in schema]
+            table = table.cast(pa.schema(fields))
+        return DataFrame(lp.LocalScan(table), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              numPartitions: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(lp.Range(start, end, step, numPartitions), self)
+
+    def table(self, name: str) -> DataFrame:
+        return DataFrame(self._views[name], self)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    def sql(self, query: str) -> DataFrame:
+        from .sql import parse_sql
+        return parse_sql(query, self)
+
+    def stop(self) -> None:
+        with TpuSession._lock:
+            if TpuSession._active is self:
+                TpuSession._active = None
+
+    # -- testing hooks (ExecutionPlanCaptureCallback analog) ----------------
+    def last_plan(self):
+        return self._last_exec_plan
+
+    def assert_on_tpu(self, allowed_fallbacks: Sequence[str] = ()) -> None:
+        """assertIsOnTheGpu test mode (GpuTransitionOverrides.scala:311-367)."""
+        from ..plan.physical import CpuFallbackExec
+        from ..plan.overrides import CpuOpBridgeExec
+
+        def walk(node):
+            if isinstance(node, (CpuFallbackExec, CpuOpBridgeExec)):
+                name = node.plan.name
+                if name not in allowed_fallbacks:
+                    raise AssertionError(
+                        f"{name} ran on CPU; explain:\n"
+                        f"{self._last_overrides.last_explain}")
+            for c in node.children:
+                walk(c)
+        assert self._last_exec_plan is not None, "no plan executed yet"
+        walk(self._last_exec_plan)
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self.session = session
+        self._options: Dict[str, Any] = {}
+        self._schema: Optional[dt.Schema] = None
+
+    def option(self, k: str, v: Any) -> "DataFrameReader":
+        self._options[k] = v
+        return self
+
+    def options(self, **kw) -> "DataFrameReader":
+        self._options.update(kw)
+        return self
+
+    def schema(self, s: dt.Schema) -> "DataFrameReader":
+        self._schema = s
+        return self
+
+    def parquet(self, *paths: str) -> DataFrame:
+        return self._scan("parquet", list(paths))
+
+    def csv(self, *paths: str) -> DataFrame:
+        return self._scan("csv", list(paths))
+
+    def orc(self, *paths: str) -> DataFrame:
+        return self._scan("orc", list(paths))
+
+    def _scan(self, fmt: str, paths: List[str]) -> DataFrame:
+        return DataFrame(
+            lp.FileScan(fmt, paths, self._schema, self._options), self.session)

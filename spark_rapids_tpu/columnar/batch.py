@@ -59,7 +59,8 @@ class ColumnarBatch:
     def from_pydict(data: Dict[str, Sequence[Any]],
                     schema: Optional[dt.Schema] = None,
                     capacity: Optional[int] = None) -> "ColumnarBatch":
-        names = list(data.keys())
+        # build in schema order when one is given so fields and columns line up
+        names = schema.names() if schema is not None else list(data.keys())
         n = len(next(iter(data.values()))) if data else 0
         cap = capacity or bucket(n)
         cols: List[Column] = []

@@ -130,7 +130,11 @@ class Field:
 class Schema:
     def __init__(self, fields):
         self.fields = [f if isinstance(f, Field) else Field(f[0], of(f[1])) for f in fields]
-        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        # first occurrence wins on duplicate names (post-join schemas carry
+        # both sides; USING-join dedup keeps the left copy, Spark semantics)
+        self._index = {}
+        for i, f in enumerate(self.fields):
+            self._index.setdefault(f.name, i)
 
     def __len__(self) -> int:
         return len(self.fields)

@@ -1,0 +1,107 @@
+"""CPU window execution for the pandas engine (golden-compare side)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import pandas as pd
+
+from ..ops import window as W
+from ..plan import logical as lp
+
+
+def exec_window_cpu(plan: lp.Window, df: pd.DataFrame) -> pd.DataFrame:
+    from .engine import CpuEvaluator, _obj_df, _order_key, _agg_py, _group_cell
+    n = len(df)
+    out = df.copy()
+    for name, w in plan.window_exprs:
+        ev = CpuEvaluator(df)
+        pkeys = [ev.eval(e) for e in w.spec.partition_by]
+        okey_vals = [(ev.eval(o.child), o) for o in w.spec.order_by]
+
+        # sort order: partition keys then order keys (same as device path)
+        idx = list(range(n))
+
+        def key_fn(i):
+            parts = []
+            for col in pkeys:
+                v = col[i]
+                parts.append((v is None, _order_key(v) if v is not None else 0))
+            for col, o in okey_vals:
+                v = col[i]
+                null_rank = 0 if (v is None) == o.nulls_first else 1
+                if v is None:
+                    parts.append((null_rank, 0))
+                else:
+                    k = _order_key(v)
+                    from .engine import _Asc, _Neg
+                    parts.append((null_rank, _Asc(k) if o.ascending else _Neg(k)))
+            return tuple(parts)
+
+        idx.sort(key=key_fn)
+
+        # segment starts
+        def pkey_of(i):
+            return tuple(_group_cell(c[i]) for c in pkeys)
+
+        def okey_of(i):
+            return tuple(_group_cell(c[i]) for c, _ in okey_vals)
+
+        results = [None] * n
+        fn = w.function
+        seg_start = 0
+        for pos in range(n + 1):
+            is_boundary = pos == n or (
+                pos > 0 and pkey_of(idx[pos]) != pkey_of(idx[pos - 1]))
+            if pos > 0 and is_boundary:
+                seg = idx[seg_start:pos]
+                _compute_segment(fn, w.spec, seg, df, ev, okey_of, results)
+                seg_start = pos
+        out[name] = pd.Series(results, dtype=object)
+    return out
+
+
+def _compute_segment(fn, spec, seg: List[int], df, ev, okey_of, results):
+    from .engine import _agg_py
+    if isinstance(fn, W.RowNumber):
+        for r, i in enumerate(seg):
+            results[i] = r + 1
+        return
+    if isinstance(fn, (W.Rank, W.DenseRank)):
+        dense = isinstance(fn, W.DenseRank)
+        rank = 0
+        dr = 0
+        prev = object()
+        for r, i in enumerate(seg):
+            k = okey_of(i)
+            if k != prev:
+                rank = r + 1
+                dr += 1
+                prev = k
+            results[i] = dr if dense else rank
+        return
+    if isinstance(fn, W.Lead):
+        vals = ev.eval(fn.children[0])
+        off = fn.offset if not isinstance(fn, W.Lag) else -fn.offset
+        for r, i in enumerate(seg):
+            src = r + off
+            if 0 <= src < len(seg):
+                results[i] = vals[seg[src]]
+            else:
+                results[i] = fn.default
+        return
+    if isinstance(fn, lp.AggregateExpression):
+        vals = ev.eval(fn.children[0]) if fn.children else [1] * len(df)
+        frame = spec.frame
+        whole = frame is None or frame.is_whole_partition or not spec.order_by
+        if whole:
+            agg = _agg_py(fn.op, [vals[i] for i in seg], fn.ignore_nulls)
+            for i in seg:
+                results[i] = agg
+            return
+        if frame.is_unbounded_to_current:
+            for r, i in enumerate(seg):
+                window_rows = [vals[j] for j in seg[:r + 1]]
+                results[i] = _agg_py(fn.op, window_rows, fn.ignore_nulls)
+            return
+    raise NotImplementedError(f"cpu window fn {type(fn).__name__}")

@@ -1,0 +1,120 @@
+"""Device manager + task semaphore: the GpuDeviceManager / GpuSemaphore analog.
+
+Reference: ``GpuDeviceManager.scala:31-306`` (one GPU per executor, RMM pool
+init, pinned pool) and ``GpuSemaphore.scala:27-161`` (bounds concurrent tasks
+on the device; acquire AFTER first batch materialized / IO done).
+
+TPU differences: XLA/PJRT owns the HBM allocator, so the "pool" here is an
+accounting budget enforced by the spill framework (spill.py) rather than a
+sub-allocator; jax array donation + XLA buffer reuse replace RMM arena blocks.
+The semaphore contract transfers unchanged: admission control for host threads
+driving device work, sized by ``spark.rapids.tpu.sql.concurrentTpuTasks``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .. import config as cfg
+
+
+class DeviceManager:
+    """Process-singleton device bootstrap (GpuDeviceManager.initializeGpuAndMemory
+    analog, Plugin.scala:124-154 executor init)."""
+
+    _instance: Optional["DeviceManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[cfg.TpuConf] = None):
+        import jax
+        self.conf = conf or cfg.TpuConf()
+        self.devices = jax.devices()
+        self.device = self.devices[0]
+        self.platform = self.device.platform
+        self.memory_budget_bytes = self._compute_budget()
+
+    def _compute_budget(self) -> int:
+        """allocFraction * device memory (GpuDeviceManager.scala:159-262)."""
+        frac = self.conf.get(cfg.ALLOC_FRACTION)
+        stats = None
+        try:
+            stats = self.device.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"] * frac)
+        # CPU backend / no stats: fall back to a conservative fixed budget
+        return int(self.conf.get(cfg.BATCH_SIZE_BYTES)) * 8
+
+    @classmethod
+    def get(cls, conf: Optional[cfg.TpuConf] = None) -> "DeviceManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceManager(conf)
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def synchronize(self) -> None:
+        """Block until all outstanding device work completes."""
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+class TpuSemaphore:
+    """Bounds the number of concurrently-executing device tasks
+    (GpuSemaphore.scala:27-161). Ordering contract preserved from the
+    reference: acquire only after the task's first input batch is ready
+    (i.e. after host-side IO/decode), release on task completion."""
+
+    _instance: Optional["TpuSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._held = threading.local()
+
+    @classmethod
+    def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
+        with cls._lock:
+            cls._instance = TpuSemaphore(max_concurrent)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = TpuSemaphore(
+                    cfg.TpuConf().get(cfg.CONCURRENT_TPU_TASKS))
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def acquire_if_necessary(self) -> None:
+        """Idempotent per-thread acquire (GpuSemaphore.acquireIfNecessary)."""
+        if getattr(self._held, "value", False):
+            return
+        self._sem.acquire()
+        self._held.value = True
+
+    def release_if_necessary(self) -> None:
+        if getattr(self._held, "value", False):
+            self._sem.release()
+            self._held.value = False
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release_if_necessary()
+        return False

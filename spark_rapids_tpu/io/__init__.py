@@ -1,0 +1,121 @@
+"""Columnar IO: Parquet/ORC/CSV via pyarrow CPU decode + device upload.
+
+Reference: SURVEY.md §2.5 — the reference reads footers and assembles row
+groups on CPU, then decodes on GPU (``Table.readParquet``,
+GpuParquetScan.scala:1022). TPUs have no decode engines, so the decode
+boundary shifts fully to the CPU (DESIGN.md §7): pyarrow decodes to Arrow;
+upload to device is the HostColumnarToGpu step. The three reader strategies
+(PERFILE / COALESCING / MULTITHREADED, GpuParquetScan.scala:1451,824,1145)
+are preserved at the host level in scan.py.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+from ..columnar import dtypes as dt
+
+
+def expand_paths(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                # prune hidden/staging dirs (_temporary, .hive-staging) and
+                # sort in place for deterministic traversal across hosts
+                dirs[:] = sorted(d for d in dirs if not d.startswith((".", "_")))
+                for f in sorted(files):
+                    if not f.startswith((".", "_")) and not f.endswith(".crc"):
+                        out.append(os.path.join(root, f))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def infer_schema(fmt: str, paths: List[str],
+                 options: Dict[str, Any]) -> dt.Schema:
+    files = expand_paths(paths)
+    if not files:
+        raise FileNotFoundError(f"no input files in {paths}")
+    first = files[0]
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        arrow_schema = pq.read_schema(first)
+    elif fmt == "orc":
+        import pyarrow.orc as orc
+        arrow_schema = orc.ORCFile(first).schema
+    elif fmt == "csv":
+        arrow_schema = _csv_schema(first, options)
+    else:
+        raise ValueError(f"unsupported format {fmt}")
+    fields = []
+    for name, typ in zip(arrow_schema.names, arrow_schema.types):
+        fields.append(dt.Field(name, dt.from_arrow(typ)))
+    return dt.Schema(fields)
+
+
+def _csv_opts(options: Dict[str, Any]):
+    import pyarrow.csv as pcsv
+    header = str(options.get("header", "false")).lower() == "true"
+    delim = options.get("sep", options.get("delimiter", ","))
+    read_opts = pcsv.ReadOptions(autogenerate_column_names=not header)
+    parse_opts = pcsv.ParseOptions(delimiter=delim)
+    # Spark: only the configured nullValue (default empty string) reads as NULL
+    conv = pcsv.ConvertOptions(
+        null_values=[options.get("nullValue", "")], strings_can_be_null=True)
+    return header, read_opts, parse_opts, conv
+
+
+def _csv_schema(path: str, options: Dict[str, Any]):
+    """Schema from the first block only (no full-file decode at plan time)."""
+    import pyarrow.csv as pcsv
+    header, read_opts, parse_opts, conv = _csv_opts(options)
+    with pcsv.open_csv(path, read_options=read_opts, parse_options=parse_opts,
+                       convert_options=conv) as reader:
+        schema = reader.schema
+    if not header:
+        import pyarrow as pa
+        schema = pa.schema([f.with_name(f"_c{i}")
+                            for i, f in enumerate(schema)])
+    return schema
+
+
+def _read_csv(path: str, options: Dict[str, Any]):
+    import pyarrow.csv as pcsv
+    header, read_opts, parse_opts, conv = _csv_opts(options)
+    table = pcsv.read_csv(path, read_options=read_opts,
+                          parse_options=parse_opts, convert_options=conv)
+    if not header:
+        # Spark naming: _c0, _c1...
+        table = table.rename_columns(
+            [f"_c{i}" for i in range(table.num_columns)])
+    return table
+
+
+def read_file_to_arrow(fmt: str, path: str, options: Dict[str, Any],
+                       columns: Optional[List[str]] = None, filters=None):
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        return pq.read_table(path, columns=columns, filters=filters)
+    if fmt == "orc":
+        import pyarrow.orc as orc
+        return orc.ORCFile(path).read(columns=columns)
+    if fmt == "csv":
+        t = _read_csv(path, options)
+        if columns:
+            t = t.select(columns)
+        return t
+    raise ValueError(f"unsupported format {fmt}")
+
+
+def read_to_arrow(fmt: str, paths: List[str], options: Dict[str, Any]):
+    import pyarrow as pa
+    files = expand_paths(paths)
+    tables = [read_file_to_arrow(fmt, f, options) for f in files]
+    if len(tables) == 1:
+        return tables[0]
+    return pa.concat_tables(tables, promote_options="permissive")

@@ -1,0 +1,139 @@
+"""File scan exec with the reference's three reader strategies.
+
+Reference: ``GpuParquetScan.scala`` — PERFILE (``ParquetPartitionReader:1451``,
+one file per batch), COALESCING (``MultiFileParquetPartitionReader:824``,
+combine many small files into one buffer before decode; disabled when
+``input_file_name()`` is used), MULTITHREADED
+(``MultiFileCloudParquetPartitionReader:1145``, background CPU threads
+prefetch+decode for high-latency storage; pool ``MultiFileThreadPoolFactory``).
+Strategy conf: ``spark.rapids.tpu.sql.format.parquet.reader.type``
+(RapidsConf.scala:510), thread count (RapidsConf.scala:548).
+
+Predicate pushdown: pyarrow's parquet reader prunes row groups with min/max
+stats from pushed filters — the same CPU-side ``filterBlocks`` role
+(GpuParquetScan.scala:239-297).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, List, Optional
+
+from .. import config as cfg
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..ops import expressions as ex
+from ..plan import logical as lp
+from ..plan.physical import Partition, TpuExec
+from . import expand_paths, read_file_to_arrow
+
+
+def _pushdown_filters(exprs: List[ex.Expression]):
+    """Translate simple predicates to pyarrow filter tuples (row-group prune)."""
+    from ..ops import predicates as pr
+    out = []
+    for e in exprs:
+        if isinstance(e, (pr.EqualTo, pr.LessThan, pr.LessThanOrEqual,
+                          pr.GreaterThan, pr.GreaterThanOrEqual)):
+            l, r = e.children
+            if isinstance(l, ex.ColumnRef) and isinstance(r, ex.Literal) \
+                    and r.value is not None:
+                op = {pr.EqualTo: "=", pr.LessThan: "<", pr.LessThanOrEqual: "<=",
+                      pr.GreaterThan: ">", pr.GreaterThanOrEqual: ">="}[type(e)]
+                out.append((l.col_name, op, r.value))
+    return out or None
+
+
+class TpuFileScanExec(TpuExec):
+    """GpuFileSourceScanExec / GpuBatchScanExec analog."""
+
+    def __init__(self, plan: lp.FileScan, conf: Optional[cfg.TpuConf] = None):
+        super().__init__()
+        self.plan = plan
+        self.conf = conf or cfg.TpuConf()
+        self.files = expand_paths(plan.paths)
+        self.reader_type = str(
+            self.conf.get_key("spark.rapids.tpu.sql.format.parquet.reader.type",
+                              "COALESCING")).upper()
+        self.num_threads = int(self.conf.get_key(
+            "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 4))
+        self.filters = _pushdown_filters(plan.pushed_filters) \
+            if plan.fmt == "parquet" else None
+
+    @property
+    def schema(self) -> dt.Schema:
+        return self.plan.schema
+
+    def execute(self) -> List[Partition]:
+        if not self.files:
+            def empty():
+                return
+                yield
+            return [empty()]
+        if self.reader_type == "MULTITHREADED":
+            return [self._multithreaded()]
+        if self.reader_type == "COALESCING" and self.plan.fmt != "csv":
+            return [self._coalescing()]
+        return [self._perfile()]
+
+    # -- strategies ----------------------------------------------------------
+    def _read(self, path: str):
+        from ..ops.hashing import InputFileName
+        InputFileName.set_current(path)
+        t = read_file_to_arrow(self.plan.fmt, path, self.plan.options,
+                               filters=self.filters)
+        self.metrics.inc("bufferTime")
+        return t
+
+    def _perfile(self) -> Partition:
+        for f in self.files:
+            table = self._read(f)
+            if table.num_rows == 0:
+                continue
+            with self.metrics.timer("tpuDecodeTime"):
+                batch = ColumnarBatch.from_arrow(table)
+            self.metrics.inc("numOutputRows", batch.num_rows)
+            self.metrics.inc("numOutputBatches")
+            yield batch
+
+    def _coalescing(self) -> Partition:
+        """Combine files up to the batch byte target before one upload
+        (MultiFileParquetPartitionReader's coalesce behavior)."""
+        import pyarrow as pa
+        target = self.conf.batch_size_bytes
+        pending, pending_bytes = [], 0
+        for f in self.files:
+            t = self._read(f)
+            if t.num_rows == 0:
+                continue
+            pending.append(t)
+            pending_bytes += t.nbytes
+            if pending_bytes >= target:
+                yield self._upload(pending)
+                pending, pending_bytes = [], 0
+        if pending:
+            yield self._upload(pending)
+
+    def _multithreaded(self) -> Partition:
+        """Background prefetch threads (MultiFileCloudParquetPartitionReader)."""
+        with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
+            futures = [pool.submit(self._read, f) for f in self.files]
+            for fut in futures:
+                t = fut.result()
+                if t.num_rows == 0:
+                    continue
+                yield self._upload([t])
+
+    def _upload(self, tables) -> ColumnarBatch:
+        import pyarrow as pa
+        table = tables[0] if len(tables) == 1 else \
+            pa.concat_tables(tables, promote_options="permissive")
+        with self.metrics.timer("tpuDecodeTime"):
+            batch = ColumnarBatch.from_arrow(table)
+        self.metrics.inc("numOutputRows", batch.num_rows)
+        self.metrics.inc("numOutputBatches")
+        return batch
+
+    def _node_string(self):
+        return (f"TpuFileScanExec[{self.plan.fmt}, {len(self.files)} files, "
+                f"{self.reader_type}]")

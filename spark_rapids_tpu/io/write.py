@@ -1,0 +1,115 @@
+"""File writers: Parquet/ORC/CSV output (GpuDataWritingCommandExec analog).
+
+Reference: ``GpuParquetFileFormat.scala`` / ``GpuOrcFileFormat`` write through
+cuDF TableWriter on device; ``GpuFileFormatWriter.scala`` handles partitioned
+writes (sort by partition cols, split, one writer per partition dir). Here the
+device batch downloads to Arrow and pyarrow writes — the encode boundary moves
+to CPU exactly like the decode side (DESIGN.md §7).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from typing import Dict, List
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..plan import logical as lp
+from ..plan.physical import Partition, TpuExec
+
+
+class TpuWriteFileExec(TpuExec):
+    def __init__(self, child: TpuExec, plan: lp.WriteFile):
+        super().__init__(child)
+        self.plan = plan
+
+    @property
+    def schema(self) -> dt.Schema:
+        return dt.Schema([])
+
+    def execute(self) -> List[Partition]:
+        path = self.plan.path
+        mode = self.plan.mode
+        if os.path.exists(path):
+            if mode == "overwrite":
+                shutil.rmtree(path) if os.path.isdir(path) else os.unlink(path)
+            elif mode in ("error", "errorifexists"):
+                raise FileExistsError(f"path {path} already exists")
+            elif mode == "ignore":
+                def noop():
+                    return
+                    yield
+                return [noop()]
+        os.makedirs(path, exist_ok=True)
+
+        def write_part(idx: int, part: Partition) -> Partition:
+            batches = list(part)
+            if batches:
+                self._write_batches(idx, batches)
+            return
+            yield
+
+        parts = self.children[0].execute()
+        out = [write_part(i, p) for i, p in enumerate(parts)]
+        # force execution eagerly (write is an action)
+        for o in out:
+            for _ in o:
+                pass
+        self._write_success()
+        def done():
+            return
+            yield
+        return [done()]
+
+    def _write_success(self):
+        with open(os.path.join(self.plan.path, "_SUCCESS"), "w"):
+            pass
+
+    def _write_batches(self, idx: int, batches: List[ColumnarBatch]) -> None:
+        import pyarrow as pa
+        tables = [b.to_arrow() for b in batches]
+        table = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+        if self.plan.partition_by:
+            self._write_partitioned(idx, table)
+            return
+        self._write_table(table, self.plan.path, idx)
+
+    def _write_partitioned(self, idx: int, table) -> None:
+        """Partitioned write: split by partition column values into
+        key=value/ dirs (GpuFileFormatWriter partitioned path)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        pcols = self.plan.partition_by
+        rest = [n for n in table.schema.names if n not in pcols]
+        # group rows by partition tuple
+        keys = list(zip(*[table.column(c).to_pylist() for c in pcols]))
+        groups: Dict[tuple, List[int]] = {}
+        for i, k in enumerate(keys):
+            groups.setdefault(k, []).append(i)
+        for k, rows in groups.items():
+            sub = table.take(rows).select(rest)
+            dirname = "/".join(
+                f"{c}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+                for c, v in zip(pcols, k))
+            outdir = os.path.join(self.plan.path, dirname)
+            os.makedirs(outdir, exist_ok=True)
+            self._write_table(sub, outdir, idx)
+
+    def _write_table(self, table, outdir: str, idx: int) -> None:
+        fmt = self.plan.fmt
+        name = f"part-{idx:05d}-{uuid.uuid4().hex[:12]}"
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(table, os.path.join(outdir, name + ".parquet"))
+        elif fmt == "orc":
+            import pyarrow.orc as orc
+            orc.write_table(table, os.path.join(outdir, name + ".orc"))
+        elif fmt == "csv":
+            import pyarrow.csv as pcsv
+            header = str(self.plan.options.get("header", "false")).lower() == "true"
+            opts = pcsv.WriteOptions(include_header=header)
+            pcsv.write_csv(table, os.path.join(outdir, name + ".csv"), opts)
+        else:
+            raise ValueError(f"unsupported write format {fmt}")

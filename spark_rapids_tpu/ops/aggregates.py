@@ -1,0 +1,245 @@
+"""Group-by and reduction aggregate kernels: the cuDF ``groupBy.aggregate`` analog.
+
+Reference: ``org/apache/spark/sql/rapids/AggregateFunctions.scala`` (531 LoC) —
+each Spark aggregate decomposes into ``CudfAggregate`` update/merge pairs
+(average = sum + count; the hash-agg exec drives update-aggregation per batch and
+merge-aggregation across batches, aggregate.scala:305-560).
+
+TPU-first design (DESIGN.md §3): no device hash tables. Group-by is sort-based:
+  lexsort rows by the group keys -> segment-start flags -> segment ids ->
+  ``jax.ops.segment_*`` reductions with num_segments = capacity (static shape).
+Group count travels as a device scalar; group keys are the key values at segment
+starts, compacted to the front. SQL null semantics: aggregates skip NULL inputs;
+an all-NULL (or empty) group yields NULL for sum/min/max/avg and 0 for count.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+from . import kernels as K
+
+
+class AggSpec(NamedTuple):
+    """One aggregation over one input column (None input = COUNT(*))."""
+    op: str                      # count/count_star/sum/min/max/avg/first/last
+    column: Optional[Column]
+    ignore_nulls: bool = True    # for first/last
+
+
+def _sum_dtype(in_dtype: dt.DType) -> dt.DType:
+    """Spark widens SUM: integral -> bigint, floating -> double."""
+    if in_dtype.is_integral or in_dtype == dt.BOOL:
+        return dt.INT64
+    return dt.FLOAT64
+
+
+def result_dtype(op: str, in_dtype: Optional[dt.DType]) -> dt.DType:
+    if op in ("count", "count_star"):
+        return dt.INT64
+    if op == "sum":
+        return _sum_dtype(in_dtype)
+    if op == "avg":
+        return dt.FLOAT64
+    return in_dtype  # min/max/first/last preserve type
+
+
+# ---------------------------------------------------------------------------
+# Segment reductions (update phase)
+# ---------------------------------------------------------------------------
+
+def _seg_sum(data, seg_ids, num_segments):
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+
+
+def _seg_min(data, seg_ids, num_segments):
+    return jax.ops.segment_min(data, seg_ids, num_segments=num_segments)
+
+
+def _seg_max(data, seg_ids, num_segments):
+    return jax.ops.segment_max(data, seg_ids, num_segments=num_segments)
+
+
+def _masked(data, mask, fill):
+    return jnp.where(mask, data, jnp.asarray(fill, data.dtype))
+
+
+def _string_ordinal_minmax(col: Column, contrib, seg_ids, cap: int, want_min: bool):
+    """Min/max for strings: reduce over the *row index* ordered by the encoded
+    string key, then gather the winning row's bytes."""
+    words = K.pack_string_words(col.data, col.lengths)
+    # build a sortable composite: argsort rows by string order, then the rank of
+    # each row is a uint32 we can min/max within segments
+    order = jnp.lexsort(tuple(reversed(
+        [w for w in words.T] + [col.lengths.astype(jnp.uint32)])))
+    rank = jnp.zeros(cap, dtype=jnp.int32).at[order].set(
+        jnp.arange(cap, dtype=jnp.int32))
+    sentinel = jnp.int32(cap) if want_min else jnp.int32(-1)
+    r = jnp.where(contrib, rank, sentinel)
+    red = _seg_min(r, seg_ids, cap) if want_min else _seg_max(r, seg_ids, cap)
+    has = red != sentinel
+    win_rank = jnp.where(has, red, 0)
+    # rank -> row index
+    win_row = order[jnp.clip(win_rank, 0, cap - 1)]
+    return win_row, has
+
+
+def segment_aggregate(spec: AggSpec, seg_ids: jnp.ndarray, live: jnp.ndarray,
+                      capacity: int) -> Column:
+    """Update-phase aggregation: reduce each segment of input rows to one output
+    row per group id. Output column has ``capacity`` slots (group g at slot g);
+    slots beyond the group count are zeroed+invalid by construction because no
+    row contributes to them.
+    """
+    op = spec.op
+    if op == "count_star":
+        data = _seg_sum(live.astype(jnp.int64), seg_ids, capacity)
+        valid = _seg_sum(live.astype(jnp.int32), seg_ids, capacity) > 0
+        return Column(dt.INT64, data, valid)
+
+    col = spec.column
+    contrib = live & col.validity
+    if op == "count":
+        data = _seg_sum(contrib.astype(jnp.int64), seg_ids, capacity)
+        valid = _seg_sum(live.astype(jnp.int32), seg_ids, capacity) > 0
+        return Column(dt.INT64, data, valid)
+
+    group_has = _seg_sum(contrib.astype(jnp.int32), seg_ids, capacity) > 0
+
+    if op == "sum":
+        out_t = _sum_dtype(col.dtype)
+        d = _masked(col.data.astype(out_t.numpy_dtype), contrib, 0)
+        data = _seg_sum(d, seg_ids, capacity)
+        return Column(out_t, _masked(data, group_has, 0), group_has)
+
+    if op == "avg":
+        d = _masked(col.data.astype(jnp.float64), contrib, 0.0)
+        s = _seg_sum(d, seg_ids, capacity)
+        c = _seg_sum(contrib.astype(jnp.float64), seg_ids, capacity)
+        data = jnp.where(group_has, s / jnp.maximum(c, 1.0), 0.0)
+        return Column(dt.FLOAT64, data, group_has)
+
+    if op in ("min", "max"):
+        if col.dtype == dt.STRING:
+            win_row, has = _string_ordinal_minmax(col, contrib, seg_ids, capacity,
+                                                  want_min=(op == "min"))
+            out = K.gather_column(col, win_row, out_valid=has)
+            return out
+        if col.dtype.is_floating:
+            # Spark total order: NaN largest. Use +/-inf fill, restore NaN via flags.
+            is_nan = jnp.isnan(col.data) & contrib
+            seg_nan = _seg_sum(is_nan.astype(jnp.int32), seg_ids, capacity) > 0
+            seg_non_nan = _seg_sum((contrib & ~is_nan).astype(jnp.int32),
+                                   seg_ids, capacity) > 0
+            fill = jnp.inf if op == "min" else -jnp.inf
+            d = _masked(col.data, contrib & ~is_nan, fill)
+            red = (_seg_min if op == "min" else _seg_max)(d, seg_ids, capacity)
+            if op == "min":
+                data = jnp.where(seg_non_nan, red, jnp.nan)  # all-NaN group -> NaN
+            else:
+                data = jnp.where(seg_nan, jnp.nan, red)      # any NaN -> NaN max
+            data = jnp.where(group_has, data, 0.0).astype(col.data.dtype)
+            return Column(col.dtype, data, group_has)
+        if col.dtype == dt.BOOL:
+            d = _masked(col.data.astype(jnp.int32), contrib, 1 if op == "min" else 0)
+            red = (_seg_min if op == "min" else _seg_max)(d, seg_ids, capacity)
+            data = (red > 0) & group_has
+            return Column(dt.BOOL, data, group_has)
+        info = jnp.iinfo(col.data.dtype)
+        fill = info.max if op == "min" else info.min
+        d = _masked(col.data, contrib, fill)
+        red = (_seg_min if op == "min" else _seg_max)(d, seg_ids, capacity)
+        return Column(col.dtype, _masked(red, group_has, 0), group_has)
+
+    if op in ("first", "last"):
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+        pick_from = contrib if spec.ignore_nulls else live
+        grp_has = _seg_sum(pick_from.astype(jnp.int32), seg_ids, capacity) > 0
+        if op == "first":
+            r = jnp.where(pick_from, idx, capacity)
+            win = _seg_min(r, seg_ids, capacity)
+        else:
+            r = jnp.where(pick_from, idx, -1)
+            win = _seg_max(r, seg_ids, capacity)
+        win = jnp.clip(win, 0, capacity - 1)
+        return K.gather_column(col, win, out_valid=grp_has)
+
+    raise ValueError(f"unknown aggregate op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# Whole group-by driver
+# ---------------------------------------------------------------------------
+
+def groupby_aggregate(key_cols: Sequence[Column], specs: Sequence[AggSpec],
+                      num_rows, capacity: int
+                      ) -> Tuple[List[Column], List[Column], jnp.ndarray]:
+    """Sort-based group-by: returns (group key columns, agg result columns,
+    device group count). All outputs have ``capacity`` slots with groups
+    compacted to the front.
+
+    cuDF analog: ``Table.groupBy(...).aggregate(...)`` as driven by
+    GpuHashAggregateExec (aggregate.scala:427-485).
+    """
+    sort_keys = [K.SortKey(c) for c in key_cols]
+    order = K.sort_indices(sort_keys, num_rows, capacity)
+    sorted_keys = [K.gather_column(c, order) for c in key_cols]
+    live = jnp.arange(capacity) < num_rows
+    starts = K.segment_starts_from_sorted_keys(sorted_keys, num_rows, capacity)
+    seg_ids = K.segment_ids(starts)
+    n_groups = jnp.sum(starts).astype(jnp.int32)
+
+    # group keys: gather the first row of each segment to the front
+    start_perm, _ = K.compaction_indices(starts)
+    group_live = jnp.arange(capacity) < n_groups
+    out_keys = [K.gather_column(c, start_perm, out_valid=group_live)
+                for c in sorted_keys]
+
+    out_aggs: List[Column] = []
+    for spec in specs:
+        s = spec
+        if spec.column is not None:
+            s = spec._replace(column=K.gather_column(spec.column, order))
+        agg = segment_aggregate(s, seg_ids, live, capacity)
+        # mask agg slots beyond the group count (paranoia: segment ids of padding
+        # rows alias the last group, which is a real group, so data is fine; but
+        # enforce the padding invariant explicitly)
+        out_aggs.append(_mask_to(agg, group_live))
+    return out_keys, out_aggs, n_groups
+
+
+def reduce_aggregate(specs: Sequence[AggSpec], num_rows, capacity: int
+                     ) -> List[Column]:
+    """Grouping-free reduction (SELECT SUM(x) FROM t): one output row at slot 0.
+
+    Empty input: count = 0, everything else NULL (aggregate.scala:487-505
+    empty-input reduction semantics).
+    """
+    seg_ids = jnp.zeros(capacity, dtype=jnp.int32)
+    live = jnp.arange(capacity) < num_rows
+    out: List[Column] = []
+    one = jnp.arange(capacity) < 1
+    for spec in specs:
+        agg = segment_aggregate(spec, seg_ids, live, capacity)
+        if spec.op in ("count", "count_star"):
+            # count of empty input is 0 (valid), not NULL
+            data = jnp.where(one, agg.data, 0)
+            out.append(Column(dt.INT64, data, one))
+        else:
+            out.append(_mask_to(agg, one))
+    return out
+
+
+def _mask_to(col: Column, mask: jnp.ndarray) -> Column:
+    validity = col.validity & mask
+    if col.dtype == dt.STRING:
+        data = jnp.where(mask[:, None], col.data, jnp.uint8(0))
+        lengths = jnp.where(mask, col.lengths, jnp.int32(0))
+        return Column(col.dtype, data, validity, lengths)
+    data = jnp.where(validity, col.data, jnp.zeros((), col.data.dtype))
+    return Column(col.dtype, data, validity)

@@ -181,9 +181,13 @@ def _parse_value(s: str, dst: dt.DType):
         if dst == dt.TIMESTAMP:
             fmt = s.replace("T", " ")
             d = datetime.datetime.fromisoformat(fmt)
-            epoch = datetime.datetime(1970, 1, 1, tzinfo=d.tzinfo) \
-                if d.tzinfo else datetime.datetime(1970, 1, 1)
-            return int((d - epoch).total_seconds() * MICROS_PER_SECOND)
+            if d.tzinfo is not None:
+                # honor the UTC offset: convert to UTC before differencing
+                d = d.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+            # integer timedelta division: float total_seconds() loses the
+            # last microsecond on ~1% of values
+            return (d - datetime.datetime(1970, 1, 1)) // \
+                datetime.timedelta(microseconds=1)
     except (ValueError, OverflowError):
         return None
     raise TypeError(f"cannot parse string as {dst}")

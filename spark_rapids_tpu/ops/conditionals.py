@@ -127,10 +127,23 @@ class NullIf(Expression):
         return self.children[0].dtype
 
     def eval(self, batch: ColumnarBatch):
-        from .predicates import EqualTo
+        from .predicates import float_eq
+        from .strings_util import string_equal
         a = materialize(self.children[0].eval(batch), batch)
-        eq = EqualTo(self.children[0], self.children[1]).eval(batch)
-        eq_mask = _bool_mask(eq, batch.capacity)
+        bv = self.children[1].eval(batch)
+        in_dtype = self.dtype
+        if in_dtype == dt.STRING:
+            eq = string_equal(a, bv, batch.capacity)
+            bvalid = bv.validity if isinstance(bv, Column) else \
+                jnp.broadcast_to(jnp.asarray(not bv.is_null), (batch.capacity,))
+            eq = eq & bvalid
+        else:
+            bd, bval = data_validity(bv, in_dtype)
+            eq = float_eq(a.data, bd) if in_dtype.is_floating else (a.data == bd)
+            bvalid = bv.validity if isinstance(bv, Column) else \
+                jnp.broadcast_to(jnp.asarray(bval), (batch.capacity,))
+            eq = eq & bvalid
+        eq_mask = jnp.broadcast_to(eq, (batch.capacity,)) & a.validity
         validity = a.validity & ~eq_mask
         if self.dtype == dt.STRING:
             return Column(self.dtype, a.data, validity, a.lengths)

@@ -1,0 +1,251 @@
+"""Sort-merge equality join kernels: the cuDF join analog, TPU-first.
+
+Reference: per-shim ``GpuHashJoin.scala:29-296`` drives cuDF hash joins
+(``Table.onColumns(...).leftJoin/innerJoin``); the plugin replaces Spark's
+sort-merge join with hash join. Here we invert (DESIGN.md §3): TPU has no device
+hash tables but sorts fast, so all equality joins are sort-merge:
+
+  1. lexsort the BUILD side by its keys (order-preserving unsigned encodings)
+  2. vectorized multi-word binary search gives, per STREAM row, the contiguous
+     range [lo, hi) of matching build rows
+  3. a prefix-sum over match counts + gather expands the pairs into output rows
+
+Two-phase dynamic-size protocol (DESIGN.md): ``join_match`` returns the device
+total pair count; the host reads it, buckets an output capacity, and calls
+``join_gather`` — the same cadence as cuDF's size-returning join calls.
+
+SQL semantics: NULL keys never match (null-aware anti join is handled at the
+exec level); Spark float semantics make NaN == NaN for joins, which the
+encoded-words equality gives us for free (all NaN encode identically).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+from . import kernels as K
+
+
+def _encode_key_words(col: Column) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """(words most-significant-first, row-is-usable) for one join key column.
+
+    Equality of the word vectors == SQL join-key equality (NaNs unified, nulls
+    excluded via the usable mask).
+    """
+    if col.dtype == dt.STRING:
+        packed = K.pack_string_words(col.data, col.lengths)
+        words = [packed[:, i] for i in range(packed.shape[1])]
+        words.append(col.lengths.astype(jnp.uint32))
+    else:
+        words = K.encode_orderable_words(col.data, col.dtype)
+        words = [w if w.dtype.kind == "u" else w for w in words]
+    return words, col.validity
+
+
+def _normalize_words(cols: Sequence[Column]) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Stack all key columns' words into one most-significant-first list.
+    Invalid (NULL) rows are marked unusable."""
+    all_words: List[jnp.ndarray] = []
+    usable = None
+    for c in cols:
+        words, valid = _encode_key_words(c)
+        for w in words:
+            # floats produce float value-words; bitcast to sortable uint for
+            # equality/compare purposes via the total-order encoding
+            if w.dtype.kind == "f":
+                bits = jax.lax.bitcast_convert_type(
+                    w.astype(jnp.float32), jnp.uint32)
+                sign = bits >> 31
+                w = jnp.where(sign == 1, ~bits, bits | jnp.uint32(0x8000_0000))
+            all_words.append(w)
+        usable = valid if usable is None else (usable & valid)
+    return all_words, usable
+
+
+def _lex_cmp(a_words: List[jnp.ndarray], b_words: List[jnp.ndarray]):
+    """(a < b, a == b) elementwise lexicographic over word lists."""
+    lt = jnp.zeros(a_words[0].shape, dtype=jnp.bool_)
+    eq = jnp.ones(a_words[0].shape, dtype=jnp.bool_)
+    for a, b in zip(a_words, b_words):
+        lt = lt | (eq & (a < b))
+        eq = eq & (a == b)
+    return lt, eq
+
+
+def _search_bounds(build_words: List[jnp.ndarray], n_build,
+                   probe_words: List[jnp.ndarray], side: str) -> jnp.ndarray:
+    """Vectorized binary search of each probe key into the sorted build keys.
+
+    side='left' -> first index with build >= probe; 'right' -> first with
+    build > probe. Build rows beyond n_build are treated as +infinity.
+    """
+    cap = build_words[0].shape[0]
+    steps = max(1, (cap - 1).bit_length())
+    lo = jnp.zeros(probe_words[0].shape, dtype=jnp.int32)
+    hi = jnp.full(probe_words[0].shape, n_build, dtype=jnp.int32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        active = lo < hi                        # converged lanes must freeze
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, cap - 1)
+        bw = [w[midc] for w in build_words]
+        blt, beq = _lex_cmp(bw, probe_words)   # build[mid] < probe, == probe
+        if side == "left":
+            go_right = blt                      # build < probe -> search right
+        else:
+            go_right = blt | beq                # build <= probe -> search right
+        # rows at/after n_build are +infinity, never less-or-equal
+        go_right = go_right & (mid < jnp.asarray(n_build, mid.dtype))
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+class JoinMatch(NamedTuple):
+    lo: jnp.ndarray            # int32[stream_cap] first matching build row
+    count: jnp.ndarray         # int32[stream_cap] matches per stream row
+    build_order: jnp.ndarray   # int32[build_cap] sort permutation of build side
+    total_pairs: jnp.ndarray   # int32 scalar: sum of counts
+    build_matched: jnp.ndarray  # bool[build_cap] (in sorted order) build row matched
+
+
+def join_match(build_keys: Sequence[Column], n_build,
+               stream_keys: Sequence[Column], n_stream,
+               stream_capacity: int) -> JoinMatch:
+    """Phase 1: sort build side, find per-stream-row match ranges + counts."""
+    build_cap = build_keys[0].capacity
+    order = K.sort_indices([K.SortKey(c) for c in build_keys], n_build, build_cap)
+    sorted_build = [K.gather_column(c, order) for c in build_keys]
+    b_words, b_usable = _normalize_words(sorted_build)
+    s_words, s_usable = _normalize_words(stream_keys)
+
+    lo = _search_bounds(b_words, n_build, s_words, "left")
+    hi = _search_bounds(b_words, n_build, s_words, "right")
+
+    s_live = jnp.arange(stream_capacity) < n_stream
+    ok = s_usable & s_live
+    count = jnp.where(ok, hi - lo, 0).astype(jnp.int32)
+    # null build rows sort first (nulls_first) and can only match null probes,
+    # which `ok` already excludes; but guard against usable-build mismatch
+    b_live = jnp.arange(build_cap) < n_build
+    # mark matched build rows: +1 at lo, -1 at hi, prefix sum > 0
+    delta = jnp.zeros(build_cap + 1, dtype=jnp.int32)
+    add = jnp.where(ok, 1, 0)
+    delta = delta.at[jnp.clip(lo, 0, build_cap)].add(add)
+    delta = delta.at[jnp.clip(hi, 0, build_cap)].add(-add)
+    covered = jnp.cumsum(delta[:-1]) > 0
+    build_matched = covered & b_live & b_usable
+    total = jnp.sum(count).astype(jnp.int32)
+    return JoinMatch(lo, count, order, total, build_matched)
+
+
+def _expand_indices(m: JoinMatch, out_capacity: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(stream_idx, build_sorted_idx, live) for each of out_capacity output slots."""
+    cum = jnp.cumsum(m.count)                    # inclusive
+    starts = cum - m.count                       # exclusive prefix
+    out_i = jnp.arange(out_capacity, dtype=jnp.int32)
+    live = out_i < m.total_pairs
+    # which stream row does output slot i belong to: first j with cum[j] > i
+    stream_idx = jnp.searchsorted(cum, out_i, side="right").astype(jnp.int32)
+    stream_idx = jnp.clip(stream_idx, 0, m.count.shape[0] - 1)
+    offset = out_i - starts[stream_idx]
+    build_sorted_idx = m.lo[stream_idx] + offset
+    return stream_idx, build_sorted_idx, live
+
+
+def join_gather(m: JoinMatch, stream_cols: Sequence[Column],
+                build_cols: Sequence[Column], out_capacity: int,
+                join_type: str = "inner", n_stream=None,
+                ) -> Tuple[List[Column], List[Column], jnp.ndarray]:
+    """Phase 2: expand matches into output columns at a host-chosen capacity.
+
+    join_type:
+      inner       — matched pairs only
+      left        — + unmatched stream rows with NULL build columns
+      left_semi   — stream rows with >=1 match (stream columns only)
+      left_anti   — stream rows with 0 matches (stream columns only)
+    Right joins are planned as left joins with sides swapped (the reference does
+    the same remap, GpuHashJoin.scala:112-132). full outer = left + the
+    unmatched build rows appended (exec layer composes it via
+    ``unmatched_build_gather``).
+    Returns (stream output cols, build output cols, device row count).
+    """
+    stream_cap = m.count.shape[0]
+    if join_type in ("left_semi", "left_anti"):
+        s_live = jnp.arange(stream_cap) < n_stream
+        keep = (m.count > 0) if join_type == "left_semi" else \
+            ((m.count == 0) & s_live)
+        keep = keep & s_live
+        perm, cnt = K.compaction_indices(keep)
+        live = jnp.arange(stream_cap) < cnt
+        out = [K.gather_column(c, perm, out_valid=live) for c in stream_cols]
+        return out, [], cnt
+
+    if join_type == "left":
+        # every stream row emits max(count, 1) rows; the padded row carries
+        # NULL build columns
+        count = jnp.where(jnp.arange(stream_cap) < n_stream,
+                          jnp.maximum(m.count, 1), 0).astype(jnp.int32)
+        matched = m.count > 0
+        m2 = m._replace(count=count, total_pairs=jnp.sum(count).astype(jnp.int32))
+        stream_idx, build_sorted_idx, live = _expand_indices(m2, out_capacity)
+        row_matched = matched[stream_idx]
+        s_out = [K.gather_column(c, stream_idx, out_valid=live)
+                 for c in stream_cols]
+        bidx = m.build_order[jnp.clip(build_sorted_idx, 0,
+                                      m.build_order.shape[0] - 1)]
+        b_valid = live & row_matched
+        b_out = [K.gather_column(c, bidx, out_valid=b_valid) for c in build_cols]
+        return s_out, b_out, m2.total_pairs
+
+    # inner
+    stream_idx, build_sorted_idx, live = _expand_indices(m, out_capacity)
+    s_out = [K.gather_column(c, stream_idx, out_valid=live) for c in stream_cols]
+    bidx = m.build_order[jnp.clip(build_sorted_idx, 0, m.build_order.shape[0] - 1)]
+    b_out = [K.gather_column(c, bidx, out_valid=live) for c in build_cols]
+    return s_out, b_out, m.total_pairs
+
+
+def unmatched_build_gather(m: JoinMatch, build_cols: Sequence[Column], n_build
+                           ) -> Tuple[List[Column], jnp.ndarray]:
+    """Build rows with no stream match, compacted (for FULL OUTER composition).
+    Note: NULL-key build rows count as unmatched (full outer emits them)."""
+    build_cap = m.build_order.shape[0]
+    b_live = jnp.arange(build_cap) < n_build
+    keep_sorted = b_live & ~m.build_matched
+    # back to original row order indices
+    perm, cnt = K.compaction_indices(keep_sorted)
+    orig_idx = m.build_order[perm]
+    live = jnp.arange(build_cap) < cnt
+    out = [K.gather_column(c, orig_idx, out_valid=live) for c in build_cols]
+    return out, cnt
+
+
+def cross_join_gather(left_cols: Sequence[Column], n_left,
+                      right_cols: Sequence[Column], n_right,
+                      out_capacity: int
+                      ) -> Tuple[List[Column], List[Column], jnp.ndarray]:
+    """Cartesian product (GpuCartesianProductExec / BroadcastNestedLoop analog):
+    output slot i -> (left i // n_right, right i % n_right)."""
+    out_i = jnp.arange(out_capacity, dtype=jnp.int64)
+    total = (jnp.asarray(n_left, jnp.int64) * jnp.asarray(n_right, jnp.int64)
+             ).astype(jnp.int32)
+    live = out_i < total
+    nr = jnp.maximum(jnp.asarray(n_right, jnp.int64), 1)
+    li = jnp.clip((out_i // nr).astype(jnp.int32), 0,
+                  left_cols[0].capacity - 1 if left_cols else 0)
+    ri = jnp.clip((out_i % nr).astype(jnp.int32), 0,
+                  right_cols[0].capacity - 1 if right_cols else 0)
+    l_out = [K.gather_column(c, li, out_valid=live) for c in left_cols]
+    r_out = [K.gather_column(c, ri, out_valid=live) for c in right_cols]
+    return l_out, r_out, total

@@ -167,9 +167,12 @@ class EqualNullSafe(Expression):
         rval = jnp.broadcast_to(jnp.asarray(rval), (batch.capacity,))
         data = jnp.where(lval & rval, jnp.broadcast_to(eq, (batch.capacity,)),
                          lval == rval)
-        # padding rows are invalid==invalid -> would read True; mask to live rows
-        data = data & batch.row_mask()
-        return result_column(dt.BOOL, data, True, batch.capacity)
+        # padding rows are invalid==invalid -> would read True; mask to live rows.
+        # validity is the live-row mask (never NULL on live rows) so the padding
+        # invariant (invalid + zeroed) holds for downstream consumers like Not.
+        live = batch.row_mask()
+        data = data & live
+        return result_column(dt.BOOL, data, live, batch.capacity)
 
 
 class And(Expression):
@@ -252,9 +255,11 @@ class IsNull(Expression):
         v = self.children[0].eval(batch)
         if isinstance(v, Scalar):
             return Scalar(v.is_null, dt.BOOL)
-        # padding rows are invalid; mask to live rows so they don't read as "null rows"
-        data = (~v.validity) & batch.row_mask()
-        return result_column(dt.BOOL, data, True, batch.capacity)
+        # padding rows are invalid; mask to live rows so they don't read as "null
+        # rows", and keep validity=live so padding stays invalid + zeroed
+        live = batch.row_mask()
+        data = (~v.validity) & live
+        return result_column(dt.BOOL, data, live, batch.capacity)
 
 
 class IsNotNull(Expression):
@@ -271,8 +276,8 @@ class IsNotNull(Expression):
         v = self.children[0].eval(batch)
         if isinstance(v, Scalar):
             return Scalar(not v.is_null, dt.BOOL)
-        return result_column(dt.BOOL, v.validity & batch.row_mask(), True,
-                             batch.capacity)
+        live = batch.row_mask()
+        return result_column(dt.BOOL, v.validity & live, live, batch.capacity)
 
 
 class IsNaN(Expression):
@@ -290,7 +295,8 @@ class IsNaN(Expression):
         if isinstance(v, Scalar):
             import math
             return Scalar(bool(v.value is not None and math.isnan(v.value)), dt.BOOL)
-        return result_column(dt.BOOL, jnp.isnan(v.data) & v.validity, True,
+        live = batch.row_mask()
+        return result_column(dt.BOOL, jnp.isnan(v.data) & v.validity, live,
                              batch.capacity)
 
 

@@ -1,0 +1,250 @@
+"""SPMD distributed execution over a jax device mesh.
+
+Reference mapping (DESIGN.md §5, SURVEY.md §5 "distributed communication
+backend"): the reference's parallelism is Spark tasks + exchange operators
+over UCX RDMA (shuffle-plugin). TPU-native, the exchange lowers to dense
+padded ``all_to_all`` over ICI inside a single jitted SPMD program:
+
+  map side:   per-worker partial op (filter/project/partial agg)
+  exchange:   bucket rows by hash(key) % n_workers into fixed-capacity slots,
+              one ``lax.all_to_all`` moves every slot to its owner over ICI
+  reduce:     per-worker final op (merge agg / join / sort)
+
+No host round-trip between stages — the entire distributed pipeline is ONE
+XLA computation, the fusion win the reference cannot express (its every
+exchange bounces through the shuffle manager). The host-orchestrated shuffle
+(shuffle/exchange.py) remains the fallback for multi-host DCN and elastic
+retry, mirroring the reference's UCX-vs-fallback split.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, bucket
+from ..ops import kernels as K
+from ..ops import aggregates as agg_k
+from ..ops.hashing import murmur3_batch
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), ("workers",))
+
+
+# ---------------------------------------------------------------------------
+# In-jit exchange: bucket-by-hash + all_to_all (the ICI shuffle data plane)
+# ---------------------------------------------------------------------------
+
+def bucket_rows_for_exchange(arrays: Sequence[jnp.ndarray],
+                             pids: jnp.ndarray, live: jnp.ndarray,
+                             n_workers: int, cap: int
+                             ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Pack rows into [n_workers, cap] slots by target worker id.
+
+    Slot t holds the rows destined for worker t, compacted to the front and
+    zero-padded (the bounce-buffer window analog, WindowedBlockIterator —
+    except static shapes make it one gather instead of a windowing protocol).
+    Returns (stacked arrays [n, cap, ...], counts int32[n]).
+    """
+    outs = [[] for _ in arrays]
+    counts = []
+    for t in range(n_workers):
+        keep = live & (pids == t)
+        perm, cnt = K.compaction_indices(keep)
+        slot_live = jnp.arange(cap) < cnt
+        for i, a in enumerate(arrays):
+            g = a[perm]
+            if g.ndim == 1:
+                g = jnp.where(slot_live, g, jnp.zeros((), g.dtype))
+            else:
+                g = jnp.where(slot_live[:, None], g, jnp.zeros((), g.dtype))
+            outs[i].append(g)
+        counts.append(cnt)
+    stacked = [jnp.stack(o) for o in outs]
+    return stacked, jnp.stack(counts).astype(jnp.int32)
+
+
+def exchange(stacked: List[jnp.ndarray], counts: jnp.ndarray, axis: str
+             ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """all_to_all over ICI: slot [t] of worker w -> slot [w] of worker t."""
+    moved = [jax.lax.all_to_all(a, axis, 0, 0, tiled=False) for a in stacked]
+    moved_counts = jax.lax.all_to_all(counts, axis, 0, 0, tiled=False)
+    return moved, moved_counts
+
+
+def flatten_received(stacked: List[jnp.ndarray], counts: jnp.ndarray,
+                     out_cap: int) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """[n, cap, ...] received slots -> single [out_cap, ...] compacted arrays.
+
+    Received rows are compacted front-of-slot; build a gather index mapping
+    output position -> (slot, offset)."""
+    n, cap = stacked[0].shape[0], stacked[0].shape[1]
+    starts = jnp.cumsum(counts) - counts          # exclusive prefix
+    total = jnp.sum(counts)
+    out_i = jnp.arange(out_cap, dtype=jnp.int32)
+    live = out_i < total
+    slot = jnp.searchsorted(jnp.cumsum(counts), out_i, side="right"
+                            ).astype(jnp.int32)
+    slot = jnp.clip(slot, 0, n - 1)
+    offset = out_i - starts[slot]
+    offset = jnp.clip(offset, 0, cap - 1)
+    outs = []
+    for a in stacked:
+        flat = a[slot, offset]
+        if flat.ndim == 1:
+            flat = jnp.where(live, flat, jnp.zeros((), flat.dtype))
+        else:
+            flat = jnp.where(live[:, None], flat, jnp.zeros((), flat.dtype))
+        outs.append(flat)
+    return outs, total.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Distributed group-by: the flagship SPMD pipeline
+# ---------------------------------------------------------------------------
+
+def _column_arrays(cols: Sequence[Column]) -> List[jnp.ndarray]:
+    out = []
+    for c in cols:
+        out.extend(c.arrays())
+    return out
+
+
+def _rebuild_columns(schema_dtypes: Sequence[dt.DType],
+                     arrays: List[jnp.ndarray]) -> List[Column]:
+    cols = []
+    i = 0
+    for t in schema_dtypes:
+        if t == dt.STRING:
+            cols.append(Column(t, arrays[i], arrays[i + 1], arrays[i + 2]))
+            i += 3
+        else:
+            cols.append(Column(t, arrays[i], arrays[i + 1]))
+            i += 2
+    return cols
+
+
+def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
+                           val_dtypes: Sequence[dt.DType],
+                           agg_ops: Sequence[str], cap: int):
+    """Build the jitted SPMD group-by step over `mesh`.
+
+    Input: per-worker shards of key/value arrays + local row counts.
+    Pipeline per worker: partial agg -> hash-bucket groups -> all_to_all ->
+    merge agg. Output: per-worker final groups (disjoint key ownership).
+
+    This is the GpuHashAggregate(partial) -> GpuShuffleExchange(hash) ->
+    GpuHashAggregate(final) pipeline fused into ONE XLA computation
+    (SURVEY.md §3.3 downstream), collectives riding ICI.
+    """
+    n = mesh.devices.size
+    from jax.experimental.shard_map import shard_map
+
+    merge_ops = ["sum" if op in ("count", "count_star", "avg") else op
+                 for op in agg_ops]
+
+    def per_worker(*arrays_and_count):
+        *arrays, local_n = arrays_and_count
+        # drop the leading worker axis shard_map leaves (size-1)
+        arrays = [a[0] for a in arrays]
+        local_n = local_n[0]
+        nk = sum(3 if t == dt.STRING else 2 for t in key_dtypes)
+        key_cols = _rebuild_columns(key_dtypes, arrays[:nk])
+        val_cols = _rebuild_columns(val_dtypes, arrays[nk:])
+
+        # 1. local partial aggregate
+        specs = []
+        for op, c in zip(agg_ops, val_cols):
+            specs.append(agg_k.AggSpec(op if op != "avg" else "sum", c))
+        out_keys, out_aggs, n_groups = agg_k.groupby_aggregate(
+            key_cols, specs, local_n, cap)
+
+        # 2. bucket groups by hash(key) % n  ->  all_to_all over ICI
+        pids = jnp.mod(jnp.mod(murmur3_batch(out_keys, cap), n) + n, n)
+        live = jnp.arange(cap) < n_groups
+        payload = _column_arrays(out_keys) + _column_arrays(out_aggs)
+        stacked, counts = bucket_rows_for_exchange(payload, pids, live, n, cap)
+        moved, moved_counts = exchange(stacked, counts, "workers")
+        flat, recv_n = flatten_received(moved, moved_counts, cap * 1)
+
+        # 3. merge aggregate over received partials
+        recv_keys = _rebuild_columns(key_dtypes, flat[:nk])
+        agg_dtypes = [a.dtype for a in out_aggs]
+        recv_aggs = _rebuild_columns(agg_dtypes, flat[nk:])
+        mspecs = [agg_k.AggSpec(mop, c)
+                  for mop, c in zip(merge_ops, recv_aggs)]
+        f_keys, f_aggs, f_groups = agg_k.groupby_aggregate(
+            recv_keys, mspecs, recv_n, cap)
+        out = (_column_arrays(f_keys) + _column_arrays(f_aggs) +
+               [f_groups])
+        return tuple(a[None] for a in out)
+
+    in_specs = tuple([P("workers")] * (
+        sum(3 if t == dt.STRING else 2 for t in key_dtypes) +
+        sum(3 if t == dt.STRING else 2 for t in val_dtypes) + 1))
+    out_count = (sum(3 if t == dt.STRING else 2 for t in key_dtypes))
+
+    smapped = shard_map(per_worker, mesh=mesh,
+                        in_specs=in_specs,
+                        out_specs=P("workers"),
+                        check_rep=False)
+    return jax.jit(smapped)
+
+
+def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
+                            key_idx: List[int], val_idx: List[int],
+                            agg_ops: List[str]) -> List[ColumnarBatch]:
+    """Host driver: shard batches across workers, run the fused SPMD step,
+    return per-worker result batches."""
+    n = mesh.devices.size
+    assert len(batches) == n, "one shard per worker"
+    cap = max(b.capacity for b in batches)
+    key_dtypes = [batches[0].columns[i].dtype for i in key_idx]
+    val_dtypes = [batches[0].columns[i].dtype for i in val_idx]
+
+    # stack shards on a leading workers axis
+    def stack(get_arrays):
+        per_worker = [get_arrays(b) for b in batches]
+        return [jnp.stack([pw[i] for pw in per_worker])
+                for i in range(len(per_worker[0]))]
+
+    def arrays_of(b: ColumnarBatch):
+        out = []
+        for i in key_idx + val_idx:
+            c = b.columns[i]
+            if c.capacity < cap:
+                c = K.rebucket_column(c, b.num_rows, cap)
+            out.extend(c.arrays())
+        return out
+
+    stacked = stack(arrays_of)
+    counts = jnp.asarray([b.num_rows for b in batches], dtype=jnp.int32)
+
+    fn = distributed_groupby_fn(mesh, key_dtypes, val_dtypes, agg_ops, cap)
+    outs = fn(*stacked, counts)
+
+    # unpack per-worker results
+    agg_out_dtypes = [agg_k.result_dtype(
+        op if op not in ("avg",) else "sum",
+        val_dtypes[i]) for i, op in enumerate(agg_ops)]
+    results = []
+    nk_arrays = sum(3 if t == dt.STRING else 2 for t in key_dtypes)
+    for w in range(n):
+        arrays = [o[w] for o in outs[:-1]]
+        n_groups = int(outs[-1][w])
+        keys = _rebuild_columns(key_dtypes, arrays[:nk_arrays])
+        aggs = _rebuild_columns(agg_out_dtypes, arrays[nk_arrays:])
+        fields = [dt.Field(f"k{i}", t) for i, t in enumerate(key_dtypes)]
+        fields += [dt.Field(f"a{i}", t) for i, t in enumerate(agg_out_dtypes)]
+        results.append(ColumnarBatch(dt.Schema(fields), keys + aggs, n_groups))
+    return results

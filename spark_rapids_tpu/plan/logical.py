@@ -1,0 +1,522 @@
+"""Logical plan: the Catalyst-plan analog that the rewrite engine consumes.
+
+The reference plugs into Spark and receives Catalyst physical plans
+(GpuOverrides.apply, GpuOverrides.scala:1991-2010). This framework is
+standalone, so it owns a small logical algebra with the same operator
+vocabulary Spark produces for the supported surface: scan / project / filter /
+aggregate / join / sort / limit / union / range / expand / generate / window /
+repartition / write.
+
+Analysis (``analyze``) mirrors the slice of Catalyst the plugin depends on:
+name resolution (ColumnRef -> BoundReference), numeric type coercion via
+implicit Casts (dtypes.promote), and schema computation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..columnar import dtypes as dt
+from ..ops import expressions as ex
+from ..ops.cast import Cast
+
+
+class SortOrder:
+    def __init__(self, child: ex.Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.child = child
+        self.ascending = ascending
+        # Spark default: NULLS FIRST for asc, NULLS LAST for desc
+        self.nulls_first = ascending if nulls_first is None else nulls_first
+
+    def __repr__(self):
+        return (f"{self.child!r} {'ASC' if self.ascending else 'DESC'} "
+                f"NULLS {'FIRST' if self.nulls_first else 'LAST'}")
+
+
+class AggregateExpression(ex.Expression):
+    """Wrapper marking an aggregate call inside an Aggregate node's output list
+    (GpuDeclarativeAggregate analog, AggregateFunctions.scala)."""
+
+    AGG_OPS = ("count", "count_star", "sum", "min", "max", "avg", "first", "last")
+
+    def __init__(self, op: str, child: Optional[ex.Expression],
+                 ignore_nulls: bool = True, distinct: bool = False):
+        super().__init__(*([child] if child is not None else []))
+        assert op in self.AGG_OPS, op
+        self.op = op
+        self.ignore_nulls = ignore_nulls
+        self.distinct = distinct
+
+    @property
+    def dtype(self) -> dt.DType:
+        from ..ops.aggregates import result_dtype
+        child_t = self.children[0].dtype if self.children else None
+        return result_dtype(self.op, child_t)
+
+    @property
+    def nullable(self) -> bool:
+        return self.op not in ("count", "count_star")
+
+    def eval(self, batch):
+        raise RuntimeError("AggregateExpression is planned, not evaluated directly")
+
+    def __repr__(self):
+        arg = repr(self.children[0]) if self.children else "*"
+        return f"{self.op}({'DISTINCT ' if self.distinct else ''}{arg})"
+
+
+# ---------------------------------------------------------------------------
+# Plan nodes
+# ---------------------------------------------------------------------------
+
+class LogicalPlan:
+    def __init__(self, *children: "LogicalPlan"):
+        self.children: List[LogicalPlan] = list(children)
+        self._schema: Optional[dt.Schema] = None
+
+    @property
+    def schema(self) -> dt.Schema:
+        if self._schema is None:
+            self._schema = self._compute_schema()
+        return self._schema
+
+    def _compute_schema(self) -> dt.Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def expressions(self) -> List[ex.Expression]:
+        return []
+
+    def __repr__(self):
+        return self._tree_string(0)
+
+    def _node_string(self) -> str:
+        return self.name
+
+    def _tree_string(self, depth: int) -> str:
+        out = "  " * depth + self._node_string()
+        for c in self.children:
+            out += "\n" + c._tree_string(depth + 1)
+        return out
+
+
+class LocalScan(LogicalPlan):
+    """In-memory data scan (createDataFrame analog)."""
+
+    def __init__(self, data: "pyarrow.Table", name: str = "local"):
+        super().__init__()
+        self.data = data
+        self.scan_name = name
+
+    def _compute_schema(self) -> dt.Schema:
+        return dt.Schema([
+            dt.Field(n, dt.from_arrow(t))
+            for n, t in zip(self.data.schema.names, self.data.schema.types)])
+
+    def _node_string(self):
+        return f"LocalScan [{', '.join(self.schema.names())}]"
+
+
+class FileScan(LogicalPlan):
+    """File source scan (GpuFileSourceScanExec / GpuBatchScanExec analog)."""
+
+    def __init__(self, fmt: str, paths: List[str],
+                 schema: Optional[dt.Schema] = None,
+                 options: Optional[Dict[str, Any]] = None,
+                 pushed_filters: Optional[List[ex.Expression]] = None):
+        super().__init__()
+        self.fmt = fmt                     # parquet / orc / csv
+        self.paths = paths
+        self._file_schema = schema
+        self.options = options or {}
+        self.pushed_filters = pushed_filters or []
+
+    def _compute_schema(self) -> dt.Schema:
+        if self._file_schema is None:
+            from ..io import infer_schema
+            self._file_schema = infer_schema(self.fmt, self.paths, self.options)
+        return self._file_schema
+
+    def _node_string(self):
+        return f"FileScan {self.fmt} {self.paths}"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: List[ex.Expression]):
+        super().__init__(child)
+        self.exprs = exprs
+
+    def expressions(self):
+        return self.exprs
+
+    def _compute_schema(self) -> dt.Schema:
+        return dt.Schema([
+            dt.Field(ex.output_name(e, i), e.dtype, e.nullable)
+            for i, e in enumerate(self.exprs)])
+
+    def _node_string(self):
+        return f"Project [{', '.join(map(repr, self.exprs))}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: ex.Expression):
+        super().__init__(child)
+        self.condition = condition
+
+    def expressions(self):
+        return [self.condition]
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Filter {self.condition!r}"
+
+
+class Aggregate(LogicalPlan):
+    """Group-by aggregate. ``aggregate_exprs`` are the output expressions;
+    aggregate calls appear as AggregateExpression subtrees (possibly wrapped
+    in Alias / arithmetic result expressions)."""
+
+    def __init__(self, child: LogicalPlan, grouping: List[ex.Expression],
+                 aggregate_exprs: List[ex.Expression]):
+        super().__init__(child)
+        self.grouping = grouping
+        self.aggregate_exprs = aggregate_exprs
+
+    def expressions(self):
+        return self.grouping + self.aggregate_exprs
+
+    def _compute_schema(self) -> dt.Schema:
+        return dt.Schema([
+            dt.Field(ex.output_name(e, i), e.dtype, e.nullable)
+            for i, e in enumerate(self.aggregate_exprs)])
+
+    def _node_string(self):
+        return (f"Aggregate key=[{', '.join(map(repr, self.grouping))}] "
+                f"out=[{', '.join(map(repr, self.aggregate_exprs))}]")
+
+
+class Join(LogicalPlan):
+    JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti",
+                  "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, how: str,
+                 condition: Optional[ex.Expression] = None,
+                 using: Optional[List[str]] = None):
+        super().__init__(left, right)
+        assert how in self.JOIN_TYPES, how
+        self.how = how
+        self.condition = condition
+        self.using = using
+
+    def expressions(self):
+        return [self.condition] if self.condition is not None else []
+
+    def _compute_schema(self) -> dt.Schema:
+        left, right = self.children[0].schema, self.children[1].schema
+        if self.how in ("left_semi", "left_anti"):
+            return left
+        fields = list(left.fields)
+        l_null = self.how == "full"
+        r_null = self.how in ("left", "full")
+        if l_null:
+            fields = [dt.Field(f.name, f.dtype, True) for f in fields]
+        rf = [dt.Field(f.name, f.dtype, True if r_null else f.nullable)
+              for f in right.fields]
+        return dt.Schema(fields + rf)
+
+    def _node_string(self):
+        return f"Join {self.how} on={self.condition!r}"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: List[SortOrder],
+                 is_global: bool = True):
+        super().__init__(child)
+        self.orders = orders
+        self.is_global = is_global
+
+    def expressions(self):
+        return [o.child for o in self.orders]
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Sort [{', '.join(map(repr, self.orders))}] global={self.is_global}"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        super().__init__(child)
+        self.n = n
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Limit {self.n}"
+
+
+class Union(LogicalPlan):
+    def __init__(self, *children: LogicalPlan):
+        super().__init__(*children)
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.children[0].schema
+
+
+class Range(LogicalPlan):
+    """range(start, end, step) -> single bigint column 'id' (GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+
+    def _compute_schema(self) -> dt.Schema:
+        return dt.Schema([dt.Field("id", dt.INT64, nullable=False)])
+
+    def _node_string(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Distinct(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        super().__init__(child)
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.children[0].schema
+
+
+class Repartition(LogicalPlan):
+    def __init__(self, child: LogicalPlan, num_partitions: int,
+                 by: Optional[List[ex.Expression]] = None):
+        super().__init__(child)
+        self.num_partitions = num_partitions
+        self.by = by
+
+    def expressions(self):
+        return self.by or []
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Repartition {self.num_partitions} by={self.by}"
+
+
+class Expand(LogicalPlan):
+    """Grouping-sets expansion (GpuExpandExec): each projection list is applied
+    to every input row."""
+
+    def __init__(self, child: LogicalPlan, projections: List[List[ex.Expression]],
+                 output_names: List[str]):
+        super().__init__(child)
+        self.projections = projections
+        self.output_names = output_names
+
+    def expressions(self):
+        return [e for p in self.projections for e in p]
+
+    def _compute_schema(self) -> dt.Schema:
+        first = self.projections[0]
+        return dt.Schema([
+            dt.Field(n, e.dtype, True)
+            for n, e in zip(self.output_names, first)])
+
+
+class Window(LogicalPlan):
+    """Window operator: adds window function columns to the child's output
+    (GpuWindowExec). window_exprs: list of (name, WindowExpression)."""
+
+    def __init__(self, child: LogicalPlan, window_exprs: List[Tuple[str, Any]]):
+        super().__init__(child)
+        self.window_exprs = window_exprs
+
+    def expressions(self):
+        return [w for _, w in self.window_exprs]
+
+    def _compute_schema(self) -> dt.Schema:
+        fields = list(self.children[0].schema.fields)
+        for name, w in self.window_exprs:
+            fields.append(dt.Field(name, w.dtype, True))
+        return dt.Schema(fields)
+
+
+class WriteFile(LogicalPlan):
+    """File write command (GpuDataWritingCommandExec analog)."""
+
+    def __init__(self, child: LogicalPlan, fmt: str, path: str,
+                 mode: str = "error", options: Optional[Dict[str, Any]] = None,
+                 partition_by: Optional[List[str]] = None):
+        super().__init__(child)
+        self.fmt = fmt
+        self.path = path
+        self.mode = mode
+        self.options = options or {}
+        self.partition_by = partition_by or []
+
+    def _compute_schema(self) -> dt.Schema:
+        return dt.Schema([])
+
+
+# ---------------------------------------------------------------------------
+# Analysis: resolve + coerce + validate
+# ---------------------------------------------------------------------------
+
+class AnalysisError(Exception):
+    pass
+
+
+def _resolve_expr(e: ex.Expression, schema: dt.Schema) -> ex.Expression:
+    def fn(node):
+        if isinstance(node, ex.ColumnRef):
+            if node.col_name not in schema:
+                raise AnalysisError(
+                    f"cannot resolve column {node.col_name!r}; "
+                    f"available: {schema.names()}")
+            return node.resolve(schema)
+        return None
+    return e.transform(fn)
+
+
+def _coerce(e: ex.Expression) -> ex.Expression:
+    """Insert implicit casts for numeric binary ops & comparisons
+    (TypeCoercion analog, the slice the plugin relies on)."""
+    from ..ops import arithmetic as ar
+    from ..ops import predicates as pr
+    from ..ops import math_ops as mo
+    from ..ops import conditionals as co
+
+    def fn(node):
+        if isinstance(node, (ar.BinaryArithmetic, pr.BinaryComparison,
+                             pr.EqualNullSafe)):
+            l, r = node.children
+            lt, rt = l.dtype, r.dtype
+            if lt == rt:
+                return None
+            if lt == dt.NULLTYPE:
+                return node.with_children([Cast(l, rt), r])
+            if rt == dt.NULLTYPE:
+                return node.with_children([l, Cast(r, lt)])
+            if lt.is_numeric and rt.is_numeric or \
+                    {lt, rt} <= {dt.BOOL, *dt.NUMERIC_TYPES}:
+                target = dt.promote(lt if lt != dt.BOOL else dt.INT8,
+                                    rt if rt != dt.BOOL else dt.INT8)
+                if isinstance(node, ar.Divide):
+                    target = dt.FLOAT64
+                nl = l if lt == target else Cast(l, target)
+                nr = r if rt == target else Cast(r, target)
+                return node.with_children([nl, nr])
+            if {lt, rt} == {dt.STRING, dt.DATE} or {lt, rt} == {dt.STRING, dt.TIMESTAMP}:
+                # string vs date/timestamp comparison: cast string side
+                target = rt if lt == dt.STRING else lt
+                nl = Cast(l, target) if lt == dt.STRING else l
+                nr = Cast(r, target) if rt == dt.STRING else r
+                return node.with_children([nl, nr])
+            raise AnalysisError(f"cannot coerce {lt} vs {rt} in {node!r}")
+        if isinstance(node, ar.Divide):
+            l, r = node.children
+            if l.dtype.is_integral:
+                return node.with_children([Cast(l, dt.FLOAT64), Cast(r, dt.FLOAT64)])
+            return None
+        if isinstance(node, mo.UnaryMath):
+            c = node.children[0]
+            if c.dtype != dt.FLOAT64:
+                return node.with_children([Cast(c, dt.FLOAT64)])
+            return None
+        if isinstance(node, AggregateExpression) and node.children:
+            c = node.children[0]
+            if node.op in ("sum", "avg") and c.dtype == dt.BOOL:
+                return node.with_children([Cast(c, dt.INT32)])
+            return None
+        if isinstance(node, (co.Coalesce, co.Least, co.Greatest, co.If,
+                             co.CaseWhen)):
+            return _coerce_branches(node)
+        return None
+
+    return e.transform(fn)
+
+
+def _coerce_branches(node):
+    """Unify branch result types for conditionals."""
+    from ..ops import conditionals as co
+
+    def value_positions():
+        n = len(node.children)
+        if isinstance(node, co.If):
+            return [1, 2]
+        if isinstance(node, co.CaseWhen):
+            pos = [2 * i + 1 for i in range(node.num_branches)]
+            if node.has_else:
+                pos.append(n - 1)
+            return pos
+        return list(range(n))
+
+    positions = value_positions()
+    dts = [node.children[i].dtype for i in positions
+           if node.children[i].dtype != dt.NULLTYPE]
+    if not dts:
+        return None
+    target = dts[0]
+    for t in dts[1:]:
+        if t != target:
+            target = dt.promote(target, t)
+    changed = False
+    new_children = list(node.children)
+    for i in positions:
+        c = new_children[i]
+        if c.dtype != target:
+            new_children[i] = Cast(c, target)
+            changed = True
+    if not changed:
+        return None
+    return node.with_children(new_children)
+
+
+def analyze(plan: LogicalPlan) -> LogicalPlan:
+    """Bottom-up resolve + coerce. Mutates expression references in place
+    (plans are single-use builder products, like Catalyst's analyzed plans)."""
+    for c in plan.children:
+        analyze(c)
+    child_schema = plan.children[0].schema if plan.children else None
+
+    def ra(e):
+        e = _resolve_expr(e, child_schema) if child_schema else e
+        return _coerce(e)
+
+    if isinstance(plan, Project):
+        plan.exprs = [ra(e) for e in plan.exprs]
+    elif isinstance(plan, Filter):
+        plan.condition = ra(plan.condition)
+        if plan.condition.dtype != dt.BOOL:
+            raise AnalysisError(
+                f"filter condition must be boolean, got {plan.condition.dtype}")
+    elif isinstance(plan, Aggregate):
+        plan.grouping = [ra(e) for e in plan.grouping]
+        plan.aggregate_exprs = [ra(e) for e in plan.aggregate_exprs]
+    elif isinstance(plan, Join):
+        if plan.condition is not None:
+            left, right = plan.children[0].schema, plan.children[1].schema
+            merged = dt.Schema(list(left.fields) + list(right.fields))
+            plan.condition = _coerce(_resolve_expr(plan.condition, merged))
+    elif isinstance(plan, Sort):
+        plan.orders = [SortOrder(ra(o.child), o.ascending, o.nulls_first)
+                       for o in plan.orders]
+    elif isinstance(plan, Repartition) and plan.by:
+        plan.by = [ra(e) for e in plan.by]
+    elif isinstance(plan, Expand):
+        plan.projections = [[ra(e) for e in p] for p in plan.projections]
+    elif isinstance(plan, Window):
+        plan.window_exprs = [(n, w.resolve_refs(child_schema))
+                             for n, w in plan.window_exprs]
+    plan._schema = None  # recompute after coercion
+    return plan

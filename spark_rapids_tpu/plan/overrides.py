@@ -1,0 +1,401 @@
+"""Plan-rewrite engine: the GpuOverrides / RapidsMeta analog.
+
+Reference: ``GpuOverrides.scala:63-275,1656-2051`` (typed replacement-rule
+registry, per-op enable confs, wrap -> tagForGpu -> explain -> convert) and
+``RapidsMeta.scala:66-300`` (meta wrappers accumulating willNotWorkOnGpu
+reasons; children-first tagging; convertIfNeeded for mixed plans).
+
+Differences forced by being standalone: the input is our logical plan, not a
+Spark physical plan, and the CPU side is the pandas engine (cpu/engine.py)
+rather than stock Spark execs. The per-op conf keys
+(``spark.rapids.tpu.sql.exec.<Op>`` / ``...expression.<Expr>``), incompat
+gating, explain formatting, and fallback layering all mirror the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from .. import config as cfg
+from ..columnar import dtypes as dt
+from ..ops import expressions as ex
+from ..ops import arithmetic as ar
+from ..ops import predicates as pr
+from ..ops import conditionals as co
+from ..ops import math_ops as mo
+from ..ops import strings as st
+from ..ops import datetime as dtm
+from ..ops import hashing as hs
+from ..ops.cast import Cast
+from . import logical as lp
+from . import physical as ph
+
+
+# ---------------------------------------------------------------------------
+# Expression rule registry (ExprRule analog, GpuOverrides.scala:129-137
+# auto-generates the per-expression enable keys)
+# ---------------------------------------------------------------------------
+
+class ExprRule:
+    def __init__(self, klass: Type[ex.Expression], incompat: Optional[str] = None,
+                 disabled_reason: Optional[str] = None):
+        self.klass = klass
+        self.incompat = incompat
+        self.disabled_reason = disabled_reason
+
+    @property
+    def conf_key(self) -> str:
+        return f"spark.rapids.tpu.sql.expression.{self.klass.__name__}"
+
+
+_EXPR_RULES: Dict[Type[ex.Expression], ExprRule] = {}
+
+
+def _expr(klass, incompat: Optional[str] = None):
+    _EXPR_RULES[klass] = ExprRule(klass, incompat)
+
+
+for k in (ex.Literal, ex.ColumnRef, ex.BoundReference, ex.Alias,
+          ar.Add, ar.Subtract, ar.Multiply, ar.Divide, ar.IntegralDivide,
+          ar.Remainder, ar.Pmod, ar.UnaryMinus, ar.UnaryPositive, ar.Abs,
+          pr.EqualTo, pr.NotEqual, pr.LessThan, pr.LessThanOrEqual,
+          pr.GreaterThan, pr.GreaterThanOrEqual, pr.EqualNullSafe,
+          pr.And, pr.Or, pr.Not, pr.IsNull, pr.IsNotNull, pr.IsNaN, pr.In,
+          co.If, co.CaseWhen, co.Coalesce, co.Nvl, co.NullIf, co.Least,
+          co.Greatest, Cast,
+          mo.Floor, mo.Ceil, mo.Round, mo.Atan2,
+          st.Length, st.Substring, st.ConcatStr, st.Contains, st.StartsWith,
+          st.EndsWith, st.Like, st.StringLocate, st.StringReplace,
+          st.StringTrim, st.StringTrimLeft, st.StringTrimRight,
+          st.StringLPad, st.StringRPad,
+          dtm.Year, dtm.Month, dtm.DayOfMonth, dtm.Quarter, dtm.DayOfWeek,
+          dtm.WeekDay, dtm.DayOfYear, dtm.LastDay, dtm.Hour, dtm.Minute,
+          dtm.Second, dtm.DateAdd, dtm.DateSub, dtm.DateDiff, dtm.AddMonths,
+          dtm.UnixTimestamp, dtm.FromUnixTime, dtm.ToDate,
+          hs.Murmur3Hash, hs.Md5, hs.MonotonicallyIncreasingID,
+          hs.SparkPartitionID, hs.Rand,
+          lp.AggregateExpression):
+    _expr(k)
+
+for sub in mo.UnaryMath.__subclasses__():
+    _expr(sub)
+
+# incompat expressions: results can differ from Spark in corner cases
+# (GpuOverrides incompat doc chaining, GpuOverrides.scala:84-97)
+_EXPR_RULES[st.Upper] = ExprRule(st.Upper, incompat="ASCII-only case mapping")
+_EXPR_RULES[st.Lower] = ExprRule(st.Lower, incompat="ASCII-only case mapping")
+_EXPR_RULES[st.InitCap] = ExprRule(st.InitCap, incompat="ASCII-only case mapping")
+_EXPR_RULES[mo.Pow] = ExprRule(mo.Pow, incompat="pow lowers to exp(y*log x)")
+_EXPR_RULES[st.RegExpExtractHost] = ExprRule(st.RegExpExtractHost,
+                                             incompat="host regex engine")
+
+
+SUPPORTED_TYPES = set(dt.ALL_TYPES) - {dt.NULLTYPE}
+
+
+# ---------------------------------------------------------------------------
+# Meta wrappers (RapidsMeta.scala)
+# ---------------------------------------------------------------------------
+
+class BaseMeta:
+    def __init__(self, conf: cfg.TpuConf):
+        self.conf = conf
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str) -> None:
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_replace(self) -> bool:
+        return not self.reasons
+
+
+class ExprMeta(BaseMeta):
+    """Wraps one expression node (BaseExprMeta analog)."""
+
+    def __init__(self, expr: ex.Expression, conf: cfg.TpuConf):
+        super().__init__(conf)
+        self.expr = expr
+        self.children = [ExprMeta(c, conf) for c in expr.children]
+
+    def tag(self) -> None:
+        for c in self.children:
+            c.tag()
+        rule = None
+        for klass in type(self.expr).__mro__:
+            rule = _EXPR_RULES.get(klass)
+            if rule is not None:
+                break
+        if rule is None:
+            self.will_not_work(
+                f"expression {type(self.expr).__name__} is not supported")
+        else:
+            if rule.incompat and not self.conf.incompatible_ops and not \
+                    self.conf.is_operator_enabled(rule.conf_key, False):
+                self.will_not_work(
+                    f"{type(self.expr).__name__} is incompatible "
+                    f"({rule.incompat}); enable with "
+                    f"{cfg.INCOMPATIBLE_OPS.key} or {rule.conf_key}")
+            elif not self.conf.is_operator_enabled(rule.conf_key, True):
+                self.will_not_work(
+                    f"{type(self.expr).__name__} disabled by {rule.conf_key}")
+        try:
+            t = self.expr.dtype
+            if t not in SUPPORTED_TYPES and t != dt.NULLTYPE:
+                self.will_not_work(f"unsupported output type {t}")
+        except Exception:
+            pass
+
+    @property
+    def tree_can_replace(self) -> bool:
+        return self.can_replace and all(c.tree_can_replace for c in self.children)
+
+    def collect_reasons(self) -> List[str]:
+        out = list(self.reasons)
+        for c in self.children:
+            out.extend(c.collect_reasons())
+        return out
+
+
+class PlanMeta(BaseMeta):
+    """Wraps one logical plan node (SparkPlanMeta analog)."""
+
+    EXEC_NAMES = {
+        lp.LocalScan: "LocalScanExec", lp.FileScan: "FileSourceScanExec",
+        lp.Project: "ProjectExec", lp.Filter: "FilterExec",
+        lp.Aggregate: "HashAggregateExec", lp.Join: "SortMergeJoinExec",
+        lp.Sort: "SortExec", lp.Limit: "GlobalLimitExec",
+        lp.Union: "UnionExec", lp.Range: "RangeExec",
+        lp.Distinct: "HashAggregateExec", lp.Repartition: "ShuffleExchangeExec",
+        lp.Expand: "ExpandExec", lp.Window: "WindowExec",
+        lp.WriteFile: "DataWritingCommandExec",
+    }
+
+    def __init__(self, plan: lp.LogicalPlan, conf: cfg.TpuConf):
+        super().__init__(conf)
+        self.plan = plan
+        self.children = [PlanMeta(c, conf) for c in plan.children]
+        self.expr_metas = [ExprMeta(e, conf) for e in plan.expressions()]
+
+    @property
+    def exec_name(self) -> str:
+        return self.EXEC_NAMES.get(type(self.plan), self.plan.name)
+
+    def tag(self) -> None:
+        """Children-first tagging walk (RapidsMeta.scala:189-216)."""
+        for c in self.children:
+            c.tag()
+        for e in self.expr_metas:
+            e.tag()
+        if not self.conf.sql_enabled:
+            self.will_not_work(f"{cfg.SQL_ENABLED.key} is false")
+            return
+        key = f"spark.rapids.tpu.sql.exec.{self.exec_name}"
+        if not self.conf.is_operator_enabled(key, True):
+            self.will_not_work(f"{self.exec_name} disabled by {key}")
+        for em in self.expr_metas:
+            if not em.tree_can_replace:
+                for r in em.collect_reasons():
+                    self.will_not_work(r)
+        self._tag_self()
+        # output schema types
+        for f in self.plan.schema.fields:
+            if f.dtype not in SUPPORTED_TYPES:
+                self.will_not_work(
+                    f"unsupported column type {f.dtype} for {f.name}")
+
+    def _tag_self(self) -> None:
+        p = self.plan
+        if isinstance(p, lp.Join):
+            if p.how not in ("inner", "left", "right", "full", "left_semi",
+                             "left_anti", "cross"):
+                self.will_not_work(f"join type {p.how} not supported")
+            if p.condition is not None:
+                from ..cpu.engine import _extract_equi_keys
+                lnames = p.children[0].schema.names()
+                rnames = p.children[1].schema.names()
+                lk, rk, residual = _extract_equi_keys(p.condition, lnames, rnames)
+                if residual is not None and p.how not in ("inner", "cross"):
+                    # conditional joins only for inner (reference:
+                    # GpuHashJoin.tagJoin, shims/spark300/GpuHashJoin.scala:30-42)
+                    self.will_not_work(
+                        "non-equi join condition only supported for inner join")
+        if isinstance(p, lp.FileScan) and p.fmt not in ("parquet", "csv", "orc"):
+            self.will_not_work(f"file format {p.fmt} not supported")
+
+    # -- explain (RapidsMeta.scala:261-295) ---------------------------------
+    def explain(self, all_ops: bool = False, depth: int = 0) -> str:
+        lines = []
+        if self.can_replace:
+            if all_ops:
+                lines.append("  " * depth + f"* {self.exec_name} will run on TPU")
+        else:
+            reasons = "; ".join(self.reasons)
+            lines.append("  " * depth +
+                         f"! {self.exec_name} cannot run on TPU because {reasons}")
+        for c in self.children:
+            sub = c.explain(all_ops, depth + 1)
+            if sub:
+                lines.append(sub)
+        return "\n".join([l for l in lines if l])
+
+
+# ---------------------------------------------------------------------------
+# Conversion: meta tree -> physical exec tree (convertIfNeeded)
+# ---------------------------------------------------------------------------
+
+class Overrides:
+    """The GpuOverrides rule: wrap -> tag -> explain -> convert."""
+
+    def __init__(self, conf: Optional[cfg.TpuConf] = None):
+        self.conf = conf or cfg.TpuConf()
+        self.last_explain: str = ""
+        self.last_meta: Optional[PlanMeta] = None
+
+    def apply(self, plan: lp.LogicalPlan) -> ph.TpuExec:
+        meta = PlanMeta(plan, self.conf)
+        meta.tag()
+        self.last_meta = meta
+        mode = self.conf.explain
+        self.last_explain = meta.explain(all_ops=(mode == "ALL"))
+        if mode != "NONE" and self.last_explain:
+            print(self.last_explain)
+        return self._convert(meta)
+
+    def _convert(self, meta: PlanMeta) -> ph.TpuExec:
+        p = meta.plan
+        if not meta.can_replace:
+            # whole subtree to CPU (the reference would transition per-node;
+            # we fall back at the highest untaggable node and let TPU children
+            # feed it through a transition bridge)
+            if meta.children and all(_subtree_ok(c) for c in meta.children):
+                tpu_children = [self._convert(c) for c in meta.children]
+                return CpuOpBridgeExec(p, tpu_children)
+            return ph.CpuFallbackExec(p)
+        return self._to_exec(meta)
+
+    def _to_exec(self, meta: PlanMeta) -> ph.TpuExec:
+        p = meta.plan
+        kids = [self._convert(c) for c in meta.children]
+        if isinstance(p, lp.LocalScan):
+            return ph.TpuLocalScanExec(p.data, p.schema)
+        if isinstance(p, lp.FileScan):
+            from ..io.scan import TpuFileScanExec
+            return TpuFileScanExec(p, self.conf)
+        if isinstance(p, lp.Project):
+            return ph.TpuProjectExec(kids[0], p.exprs)
+        if isinstance(p, lp.Filter):
+            return ph.TpuFilterExec(kids[0], p.condition)
+        if isinstance(p, lp.Aggregate):
+            return ph.TpuHashAggregateExec(kids[0], p.grouping,
+                                           p.aggregate_exprs)
+        if isinstance(p, lp.Distinct):
+            grouping = [ex.ColumnRef(n).resolve(p.children[0].schema)
+                        for n in p.children[0].schema.names()]
+            return ph.TpuHashAggregateExec(kids[0], grouping, list(grouping))
+        if isinstance(p, lp.Join):
+            return self._convert_join(p, kids)
+        if isinstance(p, lp.Sort):
+            return ph.TpuSortExec(kids[0], p.orders, p.is_global)
+        if isinstance(p, lp.Limit):
+            return ph.TpuLimitExec(kids[0], p.n)
+        if isinstance(p, lp.Union):
+            return ph.TpuUnionExec(*kids)
+        if isinstance(p, lp.Range):
+            return ph.TpuRangeExec(p.start, p.end, p.step, p.num_partitions)
+        if isinstance(p, lp.Repartition):
+            from ..shuffle.exchange import TpuShuffleExchangeExec
+            return TpuShuffleExchangeExec(kids[0], p.num_partitions, p.by)
+        if isinstance(p, lp.Expand):
+            return ph.TpuExpandExec(kids[0], p.projections, p.output_names)
+        if isinstance(p, lp.Window):
+            from .window_exec import TpuWindowExec
+            return TpuWindowExec(kids[0], p.window_exprs)
+        if isinstance(p, lp.WriteFile):
+            from ..io.write import TpuWriteFileExec
+            return TpuWriteFileExec(kids[0], p)
+        raise NotImplementedError(f"no TPU exec for {p.name}")
+
+    def _convert_join(self, p: lp.Join, kids: List[ph.TpuExec]) -> ph.TpuExec:
+        from ..cpu.engine import _extract_equi_keys
+        left, right = kids
+        if p.how == "cross" or p.condition is None:
+            return ph.TpuCrossJoinExec(left, right, p.condition)
+        lnames = p.children[0].schema.names()
+        rnames = p.children[1].schema.names()
+        lk, rk, residual = _extract_equi_keys(p.condition, lnames, rnames)
+        if not lk:
+            return ph.TpuCrossJoinExec(left, right, p.condition)
+        how = p.how
+        if how == "right":
+            # remap: right outer = left outer with sides swapped, then
+            # reorder output columns (GpuHashJoin.scala:112-132 remap)
+            inner = ph.TpuSortMergeJoinExec(right, left, "left", rk, lk,
+                                            None)
+            return _ReorderExec(inner, p.schema,
+                                len(rnames), len(lnames))
+        return ph.TpuSortMergeJoinExec(left, right, how, lk, rk, residual)
+
+
+def _subtree_ok(meta: PlanMeta) -> bool:
+    return meta.can_replace and all(_subtree_ok(c) for c in meta.children)
+
+
+class _ReorderExec(ph.TpuExec):
+    """Column reorder after a swapped right-outer join."""
+
+    def __init__(self, child: ph.TpuExec, schema: dt.Schema,
+                 n_right: int, n_left: int):
+        super().__init__(child)
+        self._schema = schema
+        self.n_right = n_right
+        self.n_left = n_left
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self):
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part):
+        from ..columnar.batch import ColumnarBatch
+        for b in part:
+            cols = b.columns[self.n_right:] + b.columns[:self.n_right]
+            yield ColumnarBatch(self._schema, cols, b.num_rows)
+
+
+class CpuOpBridgeExec(ph.TpuExec):
+    """Runs ONE unsupported logical node on CPU over TPU-computed children
+    (the GpuColumnarToRow -> CPU op -> RowToColumnar sandwich,
+    GpuTransitionOverrides.scala transitions)."""
+
+    def __init__(self, plan: lp.LogicalPlan, tpu_children: List[ph.TpuExec]):
+        super().__init__(*tpu_children)
+        self.plan = plan
+
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    def execute(self):
+        from ..cpu.engine import execute as cpu_execute
+        import copy
+        # materialize TPU children -> arrow -> LocalScan stand-ins
+        node = copy.copy(self.plan)
+        node.children = []
+        for child_exec, child_plan in zip(self.children, self.plan.children):
+            batch = child_exec.execute_collect()
+            scan = lp.LocalScan(batch.to_arrow())
+            scan._schema = child_plan.schema
+            node.children.append(scan)
+        node._schema = None
+        df = cpu_execute(node)
+
+        def gen():
+            yield ph._df_to_batch(df, self.plan.schema)
+        return [gen()]
+
+    def _node_string(self):
+        return f"CpuOpBridgeExec[{self.plan.name}]"

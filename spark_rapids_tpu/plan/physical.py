@@ -1,0 +1,854 @@
+"""Physical columnar operators: the GpuExec layer.
+
+Reference: ``GpuExec.scala:65-96`` (base trait + metrics),
+``basicPhysicalOperators.scala`` (project/filter/range/union/coalesce),
+``aggregate.scala:305-560`` (hash aggregate pipeline), ``GpuSortExec.scala``,
+per-shim ``GpuHashJoin.scala`` (build-side single batch + stream loop),
+``limit.scala``, ``GpuExpandExec.scala``, ``GpuCoalesceBatches.scala``.
+
+Execution model: an exec's ``execute()`` returns a list of partitions, each a
+generator of ``ColumnarBatch``. Single-process here; the shuffle layer
+(shuffle/) exchanges partitions between stages, and parallel/ runs the same
+operators SPMD over a device mesh. Expressions are bound to child output
+ordinals before eval (GpuBindReferences analog).
+
+Dynamic-size protocol (DESIGN.md): shrink/grow ops read the device count at
+batch boundaries and rebucket lazily via CoalesceGoal targets.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar, bucket
+from ..ops import expressions as ex
+from ..ops import kernels as K
+from ..ops import aggregates as agg_k
+from ..ops import joins as join_k
+from . import logical as lp
+
+Partition = Iterator[ColumnarBatch]
+
+
+# ---------------------------------------------------------------------------
+# Reference binding (GpuBindReferences / GpuBoundAttribute.scala)
+# ---------------------------------------------------------------------------
+
+def bind_refs(e: ex.Expression, schema: dt.Schema) -> ex.Expression:
+    def fn(node):
+        if isinstance(node, ex.ColumnRef):
+            i = schema.index_of(node.col_name)
+            f = schema[i]
+            return ex.BoundReference(i, f.dtype, f.nullable, f.name)
+        return None
+    return e.transform(fn)
+
+
+# ---------------------------------------------------------------------------
+# Metrics (GpuMetricNames, GpuExec.scala:27-56)
+# ---------------------------------------------------------------------------
+
+class Metrics(dict):
+    def inc(self, key: str, amount: float = 1) -> None:
+        self[key] = self.get(key, 0) + amount
+
+    def timer(self, key: str):
+        return _Timer(self, key)
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, key: str):
+        self.metrics = metrics
+        self.key = key
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.inc(self.key, time.perf_counter() - self.t0)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Exec base
+# ---------------------------------------------------------------------------
+
+class TpuExec:
+    """Base physical operator (GpuExec trait analog)."""
+
+    def __init__(self, *children: "TpuExec"):
+        self.children = list(children)
+        self.metrics = Metrics()
+
+    @property
+    def schema(self) -> dt.Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def execute(self) -> List[Partition]:
+        raise NotImplementedError
+
+    def execute_collect(self) -> ColumnarBatch:
+        """Materialize all partitions into one batch (driver collect)."""
+        batches: List[ColumnarBatch] = []
+        for part in self.execute():
+            batches.extend(part)
+        return concat_batches(self.schema, batches)
+
+    def _tree_string(self, depth: int = 0) -> str:
+        out = "  " * depth + self._node_string()
+        for c in self.children:
+            out += "\n" + c._tree_string(depth + 1)
+        return out
+
+    def _node_string(self) -> str:
+        return self.name
+
+    def __repr__(self):
+        return self._tree_string()
+
+
+def concat_batches(schema: dt.Schema, batches: List[ColumnarBatch],
+                   target_capacity: Optional[int] = None) -> ColumnarBatch:
+    """Concatenate host-counted batches (GpuCoalesceBatches concat path)."""
+    batches = [b for b in batches if b.num_rows > 0]
+    if not batches:
+        return ColumnarBatch.empty(schema)
+    if len(batches) == 1 and target_capacity is None:
+        return batches[0]
+    total = sum(b.num_rows for b in batches)
+    cap = target_capacity or bucket(total)
+    cols = []
+    for ci in range(len(schema)):
+        cols.append(K.concat_columns([b.columns[ci] for b in batches],
+                                     [b.num_rows for b in batches], cap))
+    return ColumnarBatch(schema, cols, total)
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class TpuLocalScanExec(TpuExec):
+    """In-memory arrow table scan -> device batches (HostColumnarToGpu analog)."""
+
+    def __init__(self, table, schema: dt.Schema, batch_rows: int = 1 << 20,
+                 num_partitions: int = 1):
+        super().__init__()
+        self.table = table
+        self._schema = schema
+        self.batch_rows = batch_rows
+        self.num_partitions = max(1, num_partitions)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        n = self.table.num_rows
+        per_part = max(1, -(-n // self.num_partitions))
+        parts = []
+        for p in range(self.num_partitions):
+            lo = min(p * per_part, n)
+            hi = min(lo + per_part, n)
+            parts.append(self._part_iter(lo, hi))
+        return parts
+
+    def _part_iter(self, lo: int, hi: int) -> Partition:
+        pos = lo
+        while pos < hi:
+            end = min(pos + self.batch_rows, hi)
+            chunk = self.table.slice(pos, end - pos)
+            batch = ColumnarBatch.from_arrow(chunk)
+            self.metrics.inc("numOutputRows", batch.num_rows)
+            self.metrics.inc("numOutputBatches")
+            yield batch
+            pos = end
+        if lo >= hi and lo == 0:
+            return
+
+
+class TpuRangeExec(TpuExec):
+    """range() generated on device (GpuRangeExec, basicPhysicalOperators.scala:187)."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int = 1,
+                 batch_rows: int = 1 << 20):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = max(1, num_partitions)
+        self.batch_rows = batch_rows
+        self._schema = dt.Schema([dt.Field("id", dt.INT64, nullable=False)])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        import jax.numpy as jnp
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per_part = max(1, -(-total // self.num_partitions))
+
+        def part(p):
+            base = p * per_part
+            count = max(0, min(per_part, total - base))
+            pos = 0
+            while pos < count:
+                take = min(self.batch_rows, count - pos)
+                cap = bucket(take)
+                idx = jnp.arange(cap, dtype=jnp.int64)
+                vals = self.start + (base + pos + idx) * self.step
+                live = idx < take
+                col = Column(dt.INT64, jnp.where(live, vals, 0), live)
+                self.metrics.inc("numOutputRows", take)
+                yield ColumnarBatch(self._schema, [col], take)
+                pos += take
+
+        return [part(p) for p in range(self.num_partitions)]
+
+
+# ---------------------------------------------------------------------------
+# Project / Filter
+# ---------------------------------------------------------------------------
+
+class TpuProjectExec(TpuExec):
+    """Columnar projection (GpuProjectExec, basicPhysicalOperators.scala:64)."""
+
+    def __init__(self, child: TpuExec, exprs: List[ex.Expression]):
+        super().__init__(child)
+        self.exprs = [bind_refs(e, child.schema) for e in exprs]
+        self._schema = dt.Schema([
+            dt.Field(ex.output_name(e, i), e.dtype, e.nullable)
+            for i, e in enumerate(exprs)])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        for batch in part:
+            with self.metrics.timer("opTime"):
+                cols = [ex.materialize(e.eval(batch), batch) for e in self.exprs]
+                out = ColumnarBatch(self._schema, cols, batch.num_rows)
+            self.metrics.inc("numOutputRows", out.num_rows)
+            self.metrics.inc("numOutputBatches")
+            yield out
+
+
+class TpuFilterExec(TpuExec):
+    """Columnar filter via compaction (GpuFilterExec + GpuFilter helper,
+    basicPhysicalOperators.scala:98-132). Device count read at the batch
+    boundary per the dynamic-size protocol."""
+
+    def __init__(self, child: TpuExec, condition: ex.Expression):
+        super().__init__(child)
+        self.condition = bind_refs(condition, child.schema)
+        self._schema = child.schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        for batch in part:
+            with self.metrics.timer("opTime"):
+                pred = self.condition.eval(batch)
+                if isinstance(pred, Scalar):
+                    if pred.value is True:
+                        yield batch
+                        continue
+                    else:
+                        continue
+                keep = pred.data & pred.validity & batch.row_mask()
+                cols, count = K.compact_columns(batch.columns, keep)
+                n = int(count)   # host sync — same cadence as cuDF filter
+            if n == 0:
+                continue
+            out = ColumnarBatch(self._schema, cols, n)
+            self.metrics.inc("numOutputRows", n)
+            self.metrics.inc("numOutputBatches")
+            yield out
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concatenate small batches up to a goal (GpuCoalesceBatches). goal:
+    'single' (RequireSingleBatch) or target row count."""
+
+    def __init__(self, child: TpuExec, goal: Any = "single",
+                 target_rows: int = 1 << 22):
+        super().__init__(child)
+        self.goal = goal
+        self.target_rows = target_rows
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        pending: List[ColumnarBatch] = []
+        pending_rows = 0
+        for batch in part:
+            pending.append(batch)
+            pending_rows += batch.num_rows
+            if self.goal != "single" and pending_rows >= self.target_rows:
+                with self.metrics.timer("concatTime"):
+                    yield concat_batches(self.schema, pending)
+                pending, pending_rows = [], 0
+        if pending:
+            with self.metrics.timer("concatTime"):
+                yield concat_batches(self.schema, pending)
+
+
+# ---------------------------------------------------------------------------
+# Aggregate
+# ---------------------------------------------------------------------------
+
+class TpuHashAggregateExec(TpuExec):
+    """Sort-based group-by aggregate (GpuHashAggregateExec pipeline,
+    aggregate.scala:305-560; decomposition per AggregateFunctions.scala).
+
+    mode: 'complete' (this node sees all rows for its groups), 'partial'
+    (update aggregation producing internal sum/count columns), or 'final'
+    (merge partials + result projection). partial+final compose across a
+    hash exchange exactly like the reference's two-phase planning.
+    """
+
+    def __init__(self, child: TpuExec, grouping: List[ex.Expression],
+                 aggregate_exprs: List[ex.Expression], mode: str = "complete"):
+        super().__init__(child)
+        self.mode = mode
+        self.grouping_src = grouping
+        self.aggregate_exprs = aggregate_exprs
+        self.grouping = [bind_refs(e, child.schema) for e in grouping]
+        # collect aggregate leaves across output expressions
+        self.leaves: List[lp.AggregateExpression] = []
+        for e in aggregate_exprs:
+            self.leaves.extend(
+                e.collect(lambda x: isinstance(x, lp.AggregateExpression)))
+        self.bound_leaf_inputs = [
+            bind_refs(l.children[0], child.schema) if l.children else None
+            for l in self.leaves]
+        self._out_schema = dt.Schema([
+            dt.Field(ex.output_name(e, i), e.dtype, e.nullable)
+            for i, e in enumerate(aggregate_exprs)])
+        # internal schema for partial output: key cols + per-leaf update cols
+        if mode == "partial":
+            fields = [dt.Field(f"_k{i}", g.dtype, True)
+                      for i, g in enumerate(grouping)]
+            for i, l in enumerate(self.leaves):
+                for j, (op, t) in enumerate(self._update_cols(l)):
+                    fields.append(dt.Field(f"_a{i}_{j}", t, True))
+            self._out_schema = dt.Schema(fields)
+
+    def _update_cols(self, leaf: lp.AggregateExpression):
+        """(op, dtype) pairs of the update-phase outputs for one aggregate
+        (avg decomposes into sum+count, AggregateFunctions.scala avg)."""
+        t = leaf.children[0].dtype if leaf.children else None
+        if leaf.op == "avg":
+            return [("sum", dt.FLOAT64), ("count", dt.INT64)]
+        if leaf.op in ("count", "count_star"):
+            return [(leaf.op, dt.INT64)]
+        return [(leaf.op, agg_k.result_dtype(leaf.op, t))]
+
+    @property
+    def schema(self):
+        return self._out_schema
+
+    def execute(self) -> List[Partition]:
+        parts = self.children[0].execute()
+        if self.mode == "partial":
+            # update-only aggregation is per-partition (upstream of the
+            # hash exchange, like the reference's partial mode)
+            return [self._agg_partition(p) for p in parts]
+        # complete/final must see every row of a group: merge all input
+        # partitions to one batch (RequireSingleBatch, aggregate.scala final)
+        def merged():
+            batches: List[ColumnarBatch] = []
+            for p in parts:
+                batches.extend(p)
+            yield concat_batches(self.children[0].schema, batches)
+        return [self._agg_partition(merged())]
+
+    def _agg_partition(self, part: Partition) -> Partition:
+        batches = list(part)
+        batch = concat_batches(self.children[0].schema, batches)
+        if self.mode == "final":
+            yield from self._final(batch)
+            return
+        yield from self._update(batch)
+
+    # -- update / complete ---------------------------------------------------
+    def _update(self, batch: ColumnarBatch) -> Partition:
+        with self.metrics.timer("computeAggTime"):
+            cap = batch.capacity
+            keys = [ex.materialize(g.eval(batch), batch) for g in self.grouping]
+            specs: List[agg_k.AggSpec] = []
+            for leaf, bound in zip(self.leaves, self.bound_leaf_inputs):
+                col = ex.materialize(bound.eval(batch), batch) \
+                    if bound is not None else None
+                for (op, _t) in self._update_cols(leaf):
+                    if leaf.op == "avg":
+                        import jax.numpy as jnp
+                        c = col
+                        if op == "sum" and c.dtype != dt.FLOAT64:
+                            c = Column(dt.FLOAT64,
+                                       c.data.astype(jnp.float64), c.validity)
+                        specs.append(agg_k.AggSpec(op, c))
+                    else:
+                        specs.append(agg_k.AggSpec(
+                            op, col, ignore_nulls=leaf.ignore_nulls))
+
+            if not self.grouping:
+                aggs = agg_k.reduce_aggregate(specs, batch.num_rows, cap)
+                n_groups = 1
+                out_keys: List[Column] = []
+            else:
+                out_keys, aggs, ng = agg_k.groupby_aggregate(
+                    keys, specs, batch.num_rows, cap)
+                n_groups = int(ng)   # host sync at stage boundary
+
+        if self.mode == "partial":
+            cols = out_keys + aggs
+            out = ColumnarBatch(self._out_schema, cols, n_groups)
+            self.metrics.inc("numOutputRows", n_groups)
+            yield out
+            return
+        yield self._project_results(out_keys, aggs, n_groups)
+
+    # -- final (merge partials) ---------------------------------------------
+    def _merge_ops(self, leaf: lp.AggregateExpression):
+        if leaf.op == "avg":
+            return ["sum", "sum"]
+        if leaf.op in ("count", "count_star"):
+            return ["sum"]
+        return [leaf.op]
+
+    def _final(self, batch: ColumnarBatch) -> Partition:
+        with self.metrics.timer("computeAggTime"):
+            cap = batch.capacity
+            nk = len(self.grouping_src)
+            keys = batch.columns[:nk]
+            specs = []
+            ci = nk
+            for leaf in self.leaves:
+                for op in self._merge_ops(leaf):
+                    specs.append(agg_k.AggSpec(op, batch.columns[ci],
+                                               ignore_nulls=leaf.ignore_nulls))
+                    ci += 1
+            if not keys:
+                aggs = agg_k.reduce_aggregate(specs, batch.num_rows, cap)
+                n_groups = 1
+                out_keys = []
+            else:
+                out_keys, aggs, ng = agg_k.groupby_aggregate(
+                    keys, specs, batch.num_rows, cap)
+                n_groups = int(ng)
+        yield self._project_results(out_keys, aggs, n_groups)
+
+    # -- result projection ---------------------------------------------------
+    def _project_results(self, out_keys: List[Column], aggs: List[Column],
+                         n_groups: int) -> ColumnarBatch:
+        """Build the output batch by evaluating result expressions over an
+        internal batch of [key cols..., leaf agg cols...] (boundFinal/result
+        projections, aggregate.scala:487-560)."""
+        import jax.numpy as jnp
+        # assemble leaf values: for avg, divide sum/count here
+        leaf_cols: List[Column] = []
+        ai = 0
+        for leaf in self.leaves:
+            ncols = len(self._update_cols(leaf)) if self.mode != "final" else \
+                len(self._merge_ops(leaf))
+            if leaf.op == "avg":
+                s, c = aggs[ai], aggs[ai + 1]
+                valid = s.validity & (c.data > 0)
+                data = jnp.where(valid, s.data / jnp.maximum(
+                    c.data.astype(jnp.float64), 1.0), 0.0)
+                leaf_cols.append(Column(dt.FLOAT64, data, valid))
+            elif leaf.op in ("count", "count_star"):
+                # counts are never NULL: empty/all-null groups read 0
+                c = aggs[ai]
+                live = jnp.arange(c.capacity) < max(n_groups, 1)
+                data = jnp.where(live, jnp.where(c.validity, c.data, 0), 0)
+                leaf_cols.append(Column(dt.INT64, data, live))
+            else:
+                leaf_cols.append(aggs[ai])
+            ai += ncols
+
+        cap = (out_keys[0].capacity if out_keys else
+               (leaf_cols[0].capacity if leaf_cols else 128))
+        internal_fields = [dt.Field(f"_k{i}", self.grouping_src[i].dtype, True)
+                           for i in range(len(out_keys))]
+        internal_fields += [dt.Field(f"_l{i}", l.dtype, True)
+                            for i, l in enumerate(self.leaves)]
+        internal = ColumnarBatch(dt.Schema(internal_fields),
+                                 out_keys + leaf_cols, n_groups)
+
+        # rewrite output exprs: leaves -> bound refs into internal batch
+        out_cols = []
+        for e in self.aggregate_exprs:
+            rewritten = self._rewrite_result(e, len(out_keys))
+            out_cols.append(ex.materialize(rewritten.eval(internal), internal))
+        self.metrics.inc("numOutputRows", n_groups)
+        return ColumnarBatch(self._out_schema, out_cols, n_groups)
+
+    def _rewrite_result(self, e: ex.Expression, nk: int) -> ex.Expression:
+        def fn(node):
+            for i, leaf in enumerate(self.leaves):
+                if node is leaf:
+                    return ex.BoundReference(nk + i, leaf.dtype, True)
+            for gi, g in enumerate(self.grouping_src):
+                if node is g or (
+                        isinstance(node, ex.ColumnRef) and
+                        isinstance(g, ex.ColumnRef) and
+                        node.col_name == g.col_name):
+                    return ex.BoundReference(gi, g.dtype, True)
+            return None
+        return e.transform(fn)
+
+
+# ---------------------------------------------------------------------------
+# Sort / Limit
+# ---------------------------------------------------------------------------
+
+class TpuSortExec(TpuExec):
+    """Device sort (GpuSortExec: cudf orderBy analog). Global sort concatenates
+    the partition's batches (RequireSingleBatch when global, GpuSortExec.scala)."""
+
+    def __init__(self, child: TpuExec, orders: List[lp.SortOrder],
+                 is_global: bool = True):
+        super().__init__(child)
+        self.orders = [lp.SortOrder(bind_refs(o.child, child.schema),
+                                    o.ascending, o.nulls_first)
+                       for o in orders]
+        self.is_global = is_global
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self) -> List[Partition]:
+        return [self._sort(p) for p in self.children[0].execute()]
+
+    def _sort(self, part: Partition) -> Partition:
+        batches = list(part)
+        if not batches:
+            return
+        batch = concat_batches(self.schema, batches)
+        with self.metrics.timer("sortTime"):
+            keys = [K.SortKey(ex.materialize(o.child.eval(batch), batch),
+                              o.ascending, o.nulls_first)
+                    for o in self.orders]
+            idx = K.sort_indices(keys, batch.num_rows, batch.capacity)
+            cols = [K.gather_column(c, idx) for c in batch.columns]
+        self.metrics.inc("numOutputRows", batch.num_rows)
+        yield ColumnarBatch(self.schema, cols, batch.num_rows)
+
+
+class TpuLimitExec(TpuExec):
+    """Local/global limit (limit.scala)."""
+
+    def __init__(self, child: TpuExec, n: int, is_global: bool = True):
+        super().__init__(child)
+        self.n = n
+        self.is_global = is_global
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self) -> List[Partition]:
+        parts = self.children[0].execute()
+        if self.is_global and len(parts) > 1:
+            # global limit: single partition of the first n rows
+            def gen():
+                remaining = self.n
+                for p in parts:
+                    for b in p:
+                        if remaining <= 0:
+                            return
+                        take = min(remaining, b.num_rows)
+                        yield self._slice(b, take)
+                        remaining -= take
+            return [gen()]
+
+        def local(p):
+            remaining = self.n
+            for b in p:
+                if remaining <= 0:
+                    return
+                take = min(remaining, b.num_rows)
+                yield self._slice(b, take)
+                remaining -= take
+        return [local(p) for p in parts]
+
+    def _slice(self, batch: ColumnarBatch, n: int) -> ColumnarBatch:
+        if n >= batch.num_rows:
+            return batch
+        cols = [K.rebucket_column(c, n, bucket(n)) for c in batch.columns]
+        return ColumnarBatch(self.schema, cols, n)
+
+
+class TpuUnionExec(TpuExec):
+    """Union all (GpuUnionExec)."""
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self) -> List[Partition]:
+        parts: List[Partition] = []
+        for c in self.children:
+            parts.extend(self._retag(p) for p in c.execute())
+        return parts
+
+    def _retag(self, p: Partition) -> Partition:
+        for b in p:
+            # align column names to union schema
+            yield ColumnarBatch(self.schema, b.columns, b.num_rows)
+
+
+class TpuExpandExec(TpuExec):
+    """Grouping-sets expand (GpuExpandExec.scala): one output batch per
+    projection list, unioned."""
+
+    def __init__(self, child: TpuExec, projections: List[List[ex.Expression]],
+                 output_names: List[str]):
+        super().__init__(child)
+        self.projections = [[bind_refs(e, child.schema) for e in p]
+                            for p in projections]
+        first = projections[0]
+        self._schema = dt.Schema([
+            dt.Field(n, e.dtype, True)
+            for n, e in zip(output_names, first)])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        for batch in part:
+            for proj in self.projections:
+                cols = [ex.materialize(e.eval(batch), batch) for e in proj]
+                out = ColumnarBatch(self._schema, cols, batch.num_rows)
+                self.metrics.inc("numOutputRows", out.num_rows)
+                yield out
+
+
+# ---------------------------------------------------------------------------
+# Joins
+# ---------------------------------------------------------------------------
+
+class TpuSortMergeJoinExec(TpuExec):
+    """Equality join: build side materialized to a single sorted batch, stream
+    side joined per batch (GpuShuffledHashJoinExec shape, but sort-merge
+    kernels per DESIGN.md §3; build-side-single-batch mirrors
+    GpuHashJoin.scala:193-249's stream loop)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec, how: str,
+                 left_keys: List[ex.Expression], right_keys: List[ex.Expression],
+                 condition: Optional[ex.Expression] = None):
+        super().__init__(left, right)
+        self.how = how
+        self.left_keys = [bind_refs(e, left.schema) for e in left_keys]
+        self.right_keys = [bind_refs(e, right.schema) for e in right_keys]
+        self._out_schema = self._compute_schema()
+        self.condition = bind_refs(condition, self._merged_schema()) \
+            if condition is not None else None
+
+    def _merged_schema(self):
+        return dt.Schema(list(self.children[0].schema.fields) +
+                         list(self.children[1].schema.fields))
+
+    def _compute_schema(self) -> dt.Schema:
+        left, right = self.children[0].schema, self.children[1].schema
+        if self.how in ("left_semi", "left_anti"):
+            return left
+        lf = [dt.Field(f.name, f.dtype, True if self.how == "full" else f.nullable)
+              for f in left.fields]
+        rf = [dt.Field(f.name, f.dtype,
+                       True if self.how in ("left", "full") else f.nullable)
+              for f in right.fields]
+        return dt.Schema(lf + rf)
+
+    @property
+    def schema(self):
+        return self._out_schema
+
+    def execute(self) -> List[Partition]:
+        # build side = right (stream left), matching Spark BuildRight default.
+        build_parts = self.children[1].execute()
+        build_batches: List[ColumnarBatch] = []
+        for p in build_parts:
+            build_batches.extend(p)
+        build = concat_batches(self.children[1].schema, build_batches)
+        return [self._join_part(p, build)
+                for p in self.children[0].execute()]
+
+    def _join_part(self, part: Partition, build: ColumnarBatch) -> Partition:
+        bkey_cols = [ex.materialize(e.eval(build), build)
+                     for e in self.right_keys]
+        if self.how == "full":
+            # full outer needs the whole stream side to know which build rows
+            # went unmatched -> single stream batch (the reference's window/
+            # sort RequireSingleBatch trade, CoalesceGoal lattice)
+            batches = list(part)
+            part = iter([concat_batches(self.children[0].schema, batches)] if
+                        batches else [])
+        for batch in part:
+            with self.metrics.timer("joinTime"):
+                skey_cols = [ex.materialize(e.eval(batch), batch)
+                             for e in self.left_keys]
+                how = self.how if self.how in (
+                    "inner", "left", "left_semi", "left_anti") else (
+                    "left" if self.how == "full" else "inner")
+                m = join_k.join_match(bkey_cols, build.num_rows,
+                                      skey_cols, batch.num_rows, batch.capacity)
+                total = int(m.total_pairs)
+                if how == "left":
+                    counts = np.asarray(m.count)[:batch.num_rows]
+                    total = int(np.maximum(counts, 1).sum())
+                out_cap = bucket(max(total, 1))
+                s_out, b_out, cnt = join_k.join_gather(
+                    m, batch.columns, build.columns, out_cap, how,
+                    n_stream=batch.num_rows)
+                n = int(cnt)
+            if self.how in ("left_semi", "left_anti"):
+                out = ColumnarBatch(self._out_schema, s_out, n)
+            else:
+                out = ColumnarBatch(self._out_schema, s_out + b_out, n)
+            if self.condition is not None and self.how == "inner":
+                # conditional join: post-filter (reference: inner-only
+                # conditional joins via post-join filter)
+                pred = self.condition.eval(out)
+                keep = pred.data & pred.validity & out.row_mask()
+                cols, count = K.compact_columns(out.columns, keep)
+                n = int(count)
+                out = ColumnarBatch(self._out_schema, cols, n)
+            if n > 0:
+                self.metrics.inc("numOutputRows", n)
+                yield out
+            if self.how == "full":
+                # append unmatched build rows with NULL left columns
+                un_cols, ucnt = join_k.unmatched_build_gather(
+                    m, build.columns, build.num_rows)
+                un = int(ucnt)
+                if un > 0:
+                    left_nulls = [
+                        Column.full_null(f.dtype, un_cols[0].capacity)
+                        for f in self.children[0].schema]
+                    uout = ColumnarBatch(self._out_schema,
+                                         left_nulls + un_cols, un)
+                    self.metrics.inc("numOutputRows", un)
+                    yield uout
+
+
+class TpuCrossJoinExec(TpuExec):
+    """Cartesian product (GpuCartesianProductExec)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 condition: Optional[ex.Expression] = None):
+        super().__init__(left, right)
+        self._out_schema = dt.Schema(
+            list(left.schema.fields) + list(right.schema.fields))
+        self.condition = bind_refs(condition, self._out_schema) \
+            if condition is not None else None
+
+    @property
+    def schema(self):
+        return self._out_schema
+
+    def execute(self) -> List[Partition]:
+        right_batches: List[ColumnarBatch] = []
+        for p in self.children[1].execute():
+            right_batches.extend(p)
+        right = concat_batches(self.children[1].schema, right_batches)
+        return [self._map(p, right) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition, right: ColumnarBatch) -> Partition:
+        for batch in part:
+            total = batch.num_rows * right.num_rows
+            cap = bucket(max(total, 1))
+            l_out, r_out, cnt = join_k.cross_join_gather(
+                batch.columns, batch.num_rows, right.columns, right.num_rows,
+                cap)
+            n = int(cnt)
+            out = ColumnarBatch(self._out_schema, l_out + r_out, n)
+            if self.condition is not None:
+                pred = self.condition.eval(out)
+                keep = pred.data & pred.validity & out.row_mask()
+                cols, count = K.compact_columns(out.columns, keep)
+                n = int(count)
+                out = ColumnarBatch(self._out_schema, cols, n)
+            if n > 0:
+                self.metrics.inc("numOutputRows", n)
+                yield out
+
+
+# ---------------------------------------------------------------------------
+# CPU fallback + transitions
+# ---------------------------------------------------------------------------
+
+class CpuFallbackExec(TpuExec):
+    """Executes a logical subtree on the CPU engine (the 'stays on CPU' side
+    of a mixed plan; transition = GpuRowToColumnarExec analog on output)."""
+
+    def __init__(self, plan: lp.LogicalPlan):
+        super().__init__()
+        self.plan = plan
+
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    def execute(self) -> List[Partition]:
+        from ..cpu.engine import execute as cpu_execute
+        df = cpu_execute(self.plan)
+
+        def gen():
+            yield _df_to_batch(df, self.plan.schema)
+        return [gen()]
+
+    def _node_string(self):
+        return f"CpuFallbackExec[{self.plan.name}]"
+
+
+def _df_to_batch(df, schema: dt.Schema) -> ColumnarBatch:
+    cols = []
+    n = len(df)
+    cap = bucket(n)
+    for f in schema:
+        vals = list(df[f.name]) if f.name in df.columns else [None] * n
+        vals = [None if _is_na(v) else v for v in vals]
+        cols.append(Column.from_pylist(vals, f.dtype, capacity=cap))
+    return ColumnarBatch(schema, cols, n)
+
+
+def _is_na(v) -> bool:
+    if v is None:
+        return True
+    try:
+        import pandas as pd
+        return v is pd.NA or (isinstance(v, float) and pd.isna(v) and
+                              not np.isnan(v))
+    except Exception:
+        return False

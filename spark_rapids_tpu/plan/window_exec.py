@@ -1,0 +1,143 @@
+"""TpuWindowExec: window operator (GpuWindowExec.scala analog).
+
+Sorts the (single, RequireSingleBatch like the reference) batch by
+(partition keys, order keys), computes each window expression with the
+segment kernels in ops/window.py, then restores the input row order so window
+columns append positionally.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column
+from ..ops import expressions as ex
+from ..ops import kernels as K
+from ..ops import window as W
+from . import logical as lp
+from .physical import Partition, TpuExec, bind_refs, concat_batches
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, child: TpuExec, window_exprs: List[Tuple[str, W.WindowExpression]]):
+        super().__init__(child)
+        self.window_exprs = window_exprs
+        fields = list(child.schema.fields)
+        for name, w in window_exprs:
+            fields.append(dt.Field(name, w.dtype, True))
+        self._schema = dt.Schema(fields)
+        # bind references inside function + spec against child schema
+        cs = child.schema
+        self._bound = []
+        for name, w in window_exprs:
+            fn = bind_refs(w.function, cs)
+            part = [bind_refs(e, cs) for e in w.spec.partition_by]
+            orders = [lp.SortOrder(bind_refs(o.child, cs), o.ascending,
+                                   o.nulls_first) for o in w.spec.order_by]
+            self._bound.append((name, fn, part, orders, w.spec.frame))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        batches = list(part)
+        if not batches:
+            return
+        batch = concat_batches(self.children[0].schema, batches)
+        cap = batch.capacity
+        n = batch.num_rows
+        out_cols = list(batch.columns)
+        for (name, fn, part_exprs, orders, frame) in self._bound:
+            out_cols.append(self._compute_one(batch, fn, part_exprs, orders,
+                                              frame))
+        self.metrics.inc("numOutputRows", n)
+        yield ColumnarBatch(self._schema, out_cols, n)
+
+    def _compute_one(self, batch: ColumnarBatch, fn, part_exprs, orders,
+                     frame) -> Column:
+        cap = batch.capacity
+        n = batch.num_rows
+        pkeys = [ex.materialize(e.eval(batch), batch) for e in part_exprs]
+        okeys = [(ex.materialize(o.child.eval(batch), batch), o) for o in orders]
+        sort_keys = [K.SortKey(c) for c in pkeys] + \
+            [K.SortKey(c, o.ascending, o.nulls_first) for c, o in okeys]
+        if sort_keys:
+            order = K.sort_indices(sort_keys, n, cap)
+        else:
+            order = jnp.arange(cap, dtype=jnp.int32)
+        live = jnp.arange(cap) < n
+        sorted_pkeys = [K.gather_column(c, order) for c in pkeys]
+        starts = K.segment_starts_from_sorted_keys(sorted_pkeys, n, cap) \
+            if sorted_pkeys else (jnp.arange(cap) == 0) & live
+        seg_ids = K.segment_ids(starts)
+
+        result = self._fn_on_sorted(batch, fn, okeys, order, starts, seg_ids,
+                                    live, frame, cap)
+        # scatter back to input order: inv_perm
+        inv = jnp.zeros(cap, dtype=jnp.int32).at[order].set(
+            jnp.arange(cap, dtype=jnp.int32))
+        return K.gather_column(result, inv, out_valid=live)
+
+    def _fn_on_sorted(self, batch, fn, okeys, order, starts, seg_ids, live,
+                      frame, cap) -> Column:
+        if isinstance(fn, W.RowNumber):
+            data = W.row_number_k(seg_ids, starts, cap)
+            return Column(dt.INT32, jnp.where(live, data, 0), live)
+        if isinstance(fn, (W.Rank, W.DenseRank)):
+            changed = self._order_changed(okeys, order, cap)
+            data = W.rank_k(seg_ids, starts, changed, cap,
+                            dense=isinstance(fn, W.DenseRank))
+            return Column(dt.INT32, jnp.where(live, data, 0), live)
+        if isinstance(fn, W.Lead):  # Lead and Lag (subclass)
+            col = ex.materialize(fn.children[0].eval(batch), batch)
+            scol = K.gather_column(col, order)
+            off = fn.offset if not isinstance(fn, W.Lag) else -fn.offset
+            return W.shift_in_segment(scol, seg_ids, off, fn.default, cap)
+        if isinstance(fn, lp.AggregateExpression):
+            col = None
+            if fn.children:
+                col = K.gather_column(
+                    ex.materialize(fn.children[0].eval(batch), batch), order)
+            if frame is None or frame.is_whole_partition or not okeys:
+                return W.whole_partition_agg(fn.op, col, seg_ids, live, cap,
+                                             fn.ignore_nulls)
+            if frame.is_unbounded_to_current:
+                if fn.op == "count_star":
+                    return W.running_agg("count_star",
+                                         Column(dt.BOOL, live, live),
+                                         seg_ids, starts, live, cap)
+                return W.running_agg(fn.op, col, seg_ids, starts, live, cap)
+            raise NotImplementedError(
+                f"window frame {frame} not supported (row frames beyond "
+                "UNBOUNDED..CURRENT pending)")
+        raise NotImplementedError(f"window function {type(fn).__name__}")
+
+    def _order_changed(self, okeys, order, cap) -> jnp.ndarray:
+        changed = jnp.zeros(cap, dtype=jnp.bool_)
+        for c, _o in okeys:
+            sc = K.gather_column(c, order)
+            prev_v = jnp.concatenate([sc.validity[:1], sc.validity[:-1]])
+            vdiff = sc.validity != prev_v
+            if sc.dtype == dt.STRING:
+                prev_d = jnp.concatenate([sc.data[:1], sc.data[:-1]])
+                ddiff = jnp.any(sc.data != prev_d, axis=1) | \
+                    (sc.lengths != jnp.concatenate([sc.lengths[:1],
+                                                    sc.lengths[:-1]]))
+            else:
+                prev_d = jnp.concatenate([sc.data[:1], sc.data[:-1]])
+                if sc.dtype.is_floating:
+                    both_nan = jnp.isnan(sc.data) & jnp.isnan(prev_d)
+                    ddiff = (sc.data != prev_d) & ~both_nan
+                else:
+                    ddiff = sc.data != prev_d
+            changed = changed | vdiff | (ddiff & sc.validity & prev_v)
+        idx = jnp.arange(cap)
+        return changed & (idx > 0)

@@ -1,0 +1,92 @@
+"""Shuffle exchange: repartition batches between stages.
+
+Reference: ``GpuShuffleExchangeExec`` (SURVEY.md §2.6) builds a
+GpuShuffleDependency with a GpuPartitioning and moves partition slices through
+the shuffle manager; ``RapidsCachingWriter`` keeps slices in the spillable
+device store instead of writing shuffle files
+(RapidsShuffleInternalManager.scala:73-192).
+
+This local exchange does the same single-process: map side splits each batch
+with a partitioner and registers the slices as spillable buffers keyed by
+(map partition, reduce partition); reduce side pulls and concatenates its
+slices. The multi-host data plane (ICI all_to_all / DCN transfer server)
+lives in parallel/ and shuffle/transport.py."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..exec.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY, BufferCatalog,
+                          SpillableColumnarBatch)
+from ..ops import expressions as ex
+from ..plan.physical import Partition, TpuExec, bind_refs, concat_batches
+from .partitioning import (HashPartitioner, RoundRobinPartitioner,
+                           SinglePartitioner, TpuPartitioner)
+
+
+class LocalShuffle:
+    """In-process shuffle state: (reduce partition) -> list of spillable
+    slices (ShuffleBufferCatalog analog, scoped to one exchange)."""
+
+    def __init__(self, num_partitions: int, catalog: Optional[BufferCatalog] = None):
+        self.num_partitions = num_partitions
+        self.catalog = catalog or BufferCatalog.get()
+        self.slices: Dict[int, List[SpillableColumnarBatch]] = {
+            p: [] for p in range(num_partitions)}
+
+    def write(self, partitioner: TpuPartitioner, batch: ColumnarBatch) -> None:
+        for p, piece in enumerate(partitioner.split(batch)):
+            if piece.num_rows > 0:
+                self.slices[p].append(SpillableColumnarBatch(
+                    piece, OUTPUT_FOR_SHUFFLE_PRIORITY, self.catalog))
+
+    def read(self, p: int, schema: dt.Schema) -> Partition:
+        pending = self.slices[p]
+        batches = []
+        for s in pending:
+            batches.append(s.get_batch())
+            s.close()
+        if batches:
+            yield concat_batches(schema, batches)
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """Repartition(n) / repartition(n, cols) exchange."""
+
+    def __init__(self, child: TpuExec, num_partitions: int,
+                 by: Optional[List[ex.Expression]] = None):
+        super().__init__(child)
+        self.num_partitions = max(1, num_partitions)
+        self.by = [bind_refs(e, child.schema) for e in by] if by else None
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _make_partitioner(self) -> TpuPartitioner:
+        if self.num_partitions == 1:
+            return SinglePartitioner()
+        if self.by:
+            return HashPartitioner(self.num_partitions, self.by)
+        return RoundRobinPartitioner(self.num_partitions)
+
+    def execute(self) -> List[Partition]:
+        shuffle = LocalShuffle(self.num_partitions)
+        partitioner = self._make_partitioner()
+        with self.metrics.timer("shuffleWriteTime"):
+            for part in self.children[0].execute():
+                for batch in part:
+                    shuffle.write(partitioner, batch)
+                    self.metrics.inc("dataSize", batch.device_size_bytes())
+        return [shuffle.read(p, self.schema)
+                for p in range(self.num_partitions)]
+
+
+class TpuHashExchangeExec(TpuShuffleExchangeExec):
+    """Hash exchange for aggregate/join key distribution (partial->final)."""
+
+    def __init__(self, child: TpuExec, num_partitions: int,
+                 keys: List[ex.Expression]):
+        super().__init__(child, num_partitions, by=keys)

@@ -1,0 +1,42 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of testing distributed behavior without a real
+cluster (SURVEY.md §4: local-mode + mocks, never multi-node in CI). The env vars
+MUST be set before jax initializes its backends, so this module sets them at
+import time (pytest imports conftest before any test module imports jax).
+"""
+
+import os
+
+# FORCE cpu (not setdefault): the CI/axon environment pre-sets JAX_PLATFORMS
+# to the real TPU, where float64 is emulated and loses ULPs — unit tests
+# validate semantics on the virtual CPU mesh (SURVEY.md §4 implication (e));
+# the bench runs on the real chip. The axon sitecustomize registers the TPU
+# backend regardless of env, so ALSO pin jax.config below.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, ("workers",))

@@ -1,0 +1,101 @@
+"""Golden-compare harness: run the same query on the CPU (pandas) engine and
+the TPU engine and diff results.
+
+Direct analog of the reference's core correctness strategy
+(SparkQueryCompareTestSuite.withCpuSparkSession/withGpuSparkSession,
+tests/.../SparkQueryCompareTestSuite.scala:153-161,314-363; pytest side
+asserts.assert_gpu_and_cpu_are_equal_collect, integration_tests asserts.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Optional
+
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _norm_cell(v: Any) -> Any:
+    import numpy as np
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    try:
+        import pandas as pd
+        if v is pd.NaT or v is pd.NA:
+            return None
+    except Exception:
+        pass
+    return v
+
+
+def _sort_key(row):
+    return tuple(
+        (v is None,
+         "nan" if isinstance(v, float) and math.isnan(v) else
+         (repr(v) if not isinstance(v, (int, float, bool)) else ""),
+         v if isinstance(v, (int, float)) and not (
+             isinstance(v, float) and math.isnan(v)) else 0)
+        for v in row)
+
+
+def _compare_rows(cpu_rows: List[tuple], tpu_rows: List[tuple],
+                  approx: Optional[float], ignore_order: bool) -> None:
+    assert len(cpu_rows) == len(tpu_rows), (
+        f"row count mismatch: cpu={len(cpu_rows)} tpu={len(tpu_rows)}\n"
+        f"cpu: {cpu_rows[:10]}\ntpu: {tpu_rows[:10]}")
+    if ignore_order:
+        cpu_rows = sorted(cpu_rows, key=_sort_key)
+        tpu_rows = sorted(tpu_rows, key=_sort_key)
+    for ri, (cr, tr) in enumerate(zip(cpu_rows, tpu_rows)):
+        assert len(cr) == len(tr), f"row {ri}: arity {len(cr)} vs {len(tr)}"
+        for ci, (cv, tv) in enumerate(zip(cr, tr)):
+            cv, tv = _norm_cell(cv), _norm_cell(tv)
+            if cv is None or tv is None:
+                assert cv is None and tv is None, \
+                    f"row {ri} col {ci}: cpu={cv!r} tpu={tv!r}"
+                continue
+            if isinstance(cv, float) and isinstance(tv, float):
+                if math.isnan(cv) or math.isnan(tv):
+                    assert math.isnan(cv) and math.isnan(tv), \
+                        f"row {ri} col {ci}: cpu={cv!r} tpu={tv!r}"
+                    continue
+                if approx is not None:
+                    tol = approx * max(abs(cv), abs(tv), 1e-300)
+                    assert abs(cv - tv) <= max(tol, 1e-12), \
+                        f"row {ri} col {ci}: cpu={cv!r} tpu={tv!r}"
+                    continue
+            assert cv == tv or (isinstance(cv, float) and cv == tv), \
+                f"row {ri} col {ci}: cpu={cv!r} tpu={tv!r}"
+
+
+def assert_tpu_and_cpu_equal(build_df: Callable[[TpuSession], Any],
+                             approx: Optional[float] = None,
+                             ignore_order: bool = True,
+                             conf: Optional[dict] = None,
+                             expect_fallback: Optional[List[str]] = None):
+    """Run ``build_df(session)`` twice — once forced through the CPU engine,
+    once on the TPU engine — and compare collected rows."""
+    settings = {"spark.rapids.tpu.sql.explain": "NONE"}
+    settings.update(conf or {})
+    session = TpuSession.builder.config(dict(settings)).getOrCreate()
+
+    # CPU run: execute the logical plan directly on the pandas engine
+    df = build_df(session)
+    from spark_rapids_tpu.cpu.engine import execute as cpu_execute
+    cpu_df = cpu_execute(df._analyzed())
+    cpu_rows = [tuple(r) for r in cpu_df.itertuples(index=False, name=None)]
+
+    # TPU run
+    tpu_rows = df.collect()
+    if expect_fallback is None:
+        session.assert_on_tpu(allowed_fallbacks=())
+    else:
+        session.assert_on_tpu(allowed_fallbacks=expect_fallback)
+    _compare_rows(cpu_rows, tpu_rows, approx, ignore_order)
+    return tpu_rows

@@ -1,0 +1,151 @@
+"""Group-by / reduction kernel tests against pandas-style oracles.
+
+Reference analog: HashAggregatesSuite (SURVEY.md §4 ring 1).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.ops.aggregates import (AggSpec, groupby_aggregate,
+                                             reduce_aggregate)
+
+
+def _col(vals, dtype):
+    return Column.from_pylist(vals, dtype)
+
+
+def _run_groupby(keys, specs, n):
+    cap = keys[0].capacity
+    out_keys, out_aggs, n_groups = groupby_aggregate(keys, specs, n, cap)
+    g = int(n_groups)
+    return ([k.to_pylist(g) for k in out_keys],
+            [a.to_pylist(g) for a in out_aggs])
+
+
+def test_groupby_sum_count():
+    k = _col([1, 2, 1, 2, 1, None], dt.INT64)
+    v = _col([10, 20, 30, None, 50, 60], dt.INT64)
+    keys, aggs = _run_groupby(
+        [k], [AggSpec("sum", v), AggSpec("count", v), AggSpec("count_star", None)], 6)
+    # groups sorted: NULL first, then 1, 2
+    assert keys[0] == [None, 1, 2]
+    assert aggs[0] == [60, 90, 20]
+    assert aggs[1] == [1, 3, 1]
+    assert aggs[2] == [1, 3, 2]
+
+
+def test_groupby_min_max_avg():
+    k = _col(["a", "b", "a", "b"], dt.STRING)
+    v = _col([3.0, None, 1.0, 7.5], dt.FLOAT64)
+    keys, aggs = _run_groupby(
+        [k], [AggSpec("min", v), AggSpec("max", v), AggSpec("avg", v)], 4)
+    assert keys[0] == ["a", "b"]
+    assert aggs[0] == [1.0, 7.5]
+    assert aggs[1] == [3.0, 7.5]
+    assert aggs[2] == [2.0, 7.5]
+
+
+def test_groupby_all_null_group():
+    k = _col([1, 1, 2], dt.INT32)
+    v = _col([None, None, 5], dt.INT64)
+    keys, aggs = _run_groupby(
+        [k], [AggSpec("sum", v), AggSpec("count", v), AggSpec("min", v)], 3)
+    assert keys[0] == [1, 2]
+    assert aggs[0] == [None, 5]
+    assert aggs[1] == [0, 1]
+    assert aggs[2] == [None, 5]
+
+
+def test_groupby_string_minmax():
+    k = _col([1, 1, 1], dt.INT32)
+    v = _col(["pear", "apple", None], dt.STRING)
+    keys, aggs = _run_groupby([k], [AggSpec("min", v), AggSpec("max", v)], 3)
+    assert aggs[0] == ["apple"]
+    assert aggs[1] == ["pear"]
+
+
+def test_groupby_float_nan():
+    nan = float("nan")
+    k = _col([1, 1, 2, 2], dt.INT32)
+    v = _col([nan, 2.0, 3.0, 4.0], dt.FLOAT64)
+    keys, aggs = _run_groupby([k], [AggSpec("min", v), AggSpec("max", v)], 4)
+    assert aggs[0][0] == 2.0          # min skips NaN (NaN is largest)
+    assert math.isnan(aggs[1][0])     # max of group with NaN = NaN
+    assert aggs[0][1] == 3.0 and aggs[1][1] == 4.0
+
+
+def test_groupby_first_last():
+    k = _col([1, 1, 1, 2], dt.INT32)
+    v = _col([None, 20, 30, 40], dt.INT64)
+    keys, aggs = _run_groupby(
+        [k], [AggSpec("first", v, ignore_nulls=True),
+              AggSpec("first", v, ignore_nulls=False),
+              AggSpec("last", v)], 4)
+    assert aggs[0] == [20, 40]
+    assert aggs[1] == [None, 40]
+    assert aggs[2] == [30, 40]
+
+
+def test_groupby_multi_key():
+    k1 = _col([1, 1, 2, 1], dt.INT32)
+    k2 = _col(["x", "y", "x", "x"], dt.STRING)
+    v = _col([1, 2, 3, 4], dt.INT64)
+    keys, aggs = _run_groupby([k1, k2], [AggSpec("sum", v)], 4)
+    assert keys[0] == [1, 1, 2]
+    assert keys[1] == ["x", "y", "x"]
+    assert aggs[0] == [5, 2, 3]
+
+
+def test_groupby_bool_minmax():
+    k = _col([1, 1, 2], dt.INT32)
+    v = _col([True, False, True], dt.BOOL)
+    keys, aggs = _run_groupby([k], [AggSpec("min", v), AggSpec("max", v)], 3)
+    assert aggs[0] == [False, True]
+    assert aggs[1] == [True, True]
+
+
+def test_reduce_no_groups():
+    v = _col([1, 2, None, 4], dt.INT64)
+    out = reduce_aggregate(
+        [AggSpec("sum", v), AggSpec("count", v), AggSpec("avg", v),
+         AggSpec("min", v), AggSpec("max", v)], 4, v.capacity)
+    assert [c.to_pylist(1)[0] for c in out] == [7, 3, 7 / 3, 1, 4]
+
+
+def test_reduce_empty_input():
+    v = Column.full_null(dt.INT64, 128)
+    out = reduce_aggregate(
+        [AggSpec("sum", v), AggSpec("count", v), AggSpec("count_star", None)],
+        0, 128)
+    assert out[0].to_pylist(1) == [None]
+    assert out[1].to_pylist(1) == [0]
+    assert out[2].to_pylist(1) == [0]
+
+
+def test_groupby_large_random_vs_pandas():
+    import pandas as pd
+    rng = np.random.default_rng(42)
+    n = 1000
+    k = rng.integers(0, 50, n)
+    v = rng.normal(size=n)
+    null_mask = rng.random(n) < 0.1
+    kcol = _col(list(k), dt.INT64)
+    vcol = Column.from_pylist(
+        [None if m else float(x) for m, x in zip(null_mask, v)], dt.FLOAT64)
+    keys, aggs = _run_groupby(
+        [kcol], [AggSpec("sum", vcol), AggSpec("count", vcol),
+                 AggSpec("min", vcol), AggSpec("max", vcol)], n)
+    df = pd.DataFrame({"k": k, "v": [None if m else x for m, x in zip(null_mask, v)]})
+    g = df.groupby("k")["v"]
+    expected = g.agg(["sum", "count", "min", "max"]).reset_index()
+    assert keys[0] == list(expected["k"])
+    # float sum order differs from pandas (the reference gates this behind
+    # spark.rapids.sql.variableFloatAgg.enabled) — epsilon compare
+    np.testing.assert_allclose(aggs[0], expected["sum"], rtol=1e-9)
+    assert aggs[1] == list(expected["count"])
+    np.testing.assert_allclose(aggs[2], expected["min"])
+    np.testing.assert_allclose(aggs[3], expected["max"])

@@ -1,0 +1,97 @@
+"""Columnar container tests: Column/ColumnarBatch round-trips, bucketing, nulls.
+
+Reference analog: GpuColumnVector / batch conversion tests plus FuzzerUtils-style
+round trips (SURVEY.md §4 ring 1).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, Scalar, bucket
+
+
+def test_bucket():
+    assert bucket(0) == 128
+    assert bucket(1) == 128
+    assert bucket(128) == 128
+    assert bucket(129) == 256
+    assert bucket(1000) == 1024
+
+
+def test_numeric_roundtrip():
+    vals = [1, 2, None, -4, 5]
+    col = Column.from_pylist(vals, dt.INT64)
+    assert col.capacity == 128
+    assert col.to_pylist(5) == vals
+
+
+def test_float_nan_stays_valid():
+    vals = [1.0, float("nan"), None]
+    col = Column.from_pylist(vals, dt.FLOAT64)
+    out = col.to_pylist(3)
+    assert out[0] == 1.0
+    assert np.isnan(out[1])
+    assert out[2] is None
+
+
+def test_string_roundtrip():
+    vals = ["hello", "", None, "world!", "a"]
+    col = Column.from_pylist(vals, dt.STRING)
+    assert col.to_pylist(5) == vals
+    assert col.data.shape[1] == 8  # MIN_STRING_WIDTH bucket
+
+
+def test_string_unicode():
+    vals = ["héllo", "日本語", None]
+    col = Column.from_pylist(vals, dt.STRING)
+    assert col.to_pylist(3) == vals
+
+
+def test_batch_from_pydict_and_arrow():
+    b = ColumnarBatch.from_pydict({
+        "i": [1, 2, 3], "f": [1.5, None, 2.5], "s": ["x", "y", None]})
+    assert b.num_rows == 3
+    assert b.schema.names() == ["i", "f", "s"]
+    tbl = b.to_arrow()
+    b2 = ColumnarBatch.from_arrow(tbl)
+    assert b2.to_pydict() == b.to_pydict()
+
+
+def test_batch_from_arrow_types():
+    tbl = pa.table({
+        "b": pa.array([True, None, False]),
+        "i32": pa.array([1, 2, 3], type=pa.int32()),
+        "d": pa.array([0, 1, None], type=pa.date32()),
+        "ts": pa.array([0, 1_000_000, None], type=pa.timestamp("us")),
+    })
+    b = ColumnarBatch.from_arrow(tbl)
+    assert b.schema["b"].dtype == dt.BOOL
+    assert b.schema["i32"].dtype == dt.INT32
+    assert b.schema["d"].dtype == dt.DATE
+    assert b.schema["ts"].dtype == dt.TIMESTAMP
+    assert b.column("d").to_pylist(3) == [0, 1, None]
+    assert b.column("ts").to_pylist(3) == [0, 1_000_000, None]
+
+
+def test_scalar_column():
+    col = Column.from_scalar(Scalar(7, dt.INT32), 5, 128)
+    assert col.to_pylist(5) == [7] * 5
+    null = Column.from_scalar(Scalar(None, dt.INT64), 3, 128)
+    assert null.to_pylist(3) == [None] * 3
+
+
+def test_padding_is_invalid_and_zeroed():
+    col = Column.from_pylist([9, 9], dt.INT64)
+    assert not bool(np.asarray(col.validity)[2:].any())
+    assert not np.asarray(col.data)[2:].any()
+
+
+def test_type_promotion():
+    assert dt.promote(dt.INT32, dt.INT64) == dt.INT64
+    assert dt.promote(dt.INT64, dt.FLOAT32) == dt.FLOAT32
+    assert dt.promote(dt.INT8, dt.BOOL) == dt.INT8
+    with pytest.raises(ValueError):
+        dt.promote(dt.STRING, dt.INT32)

@@ -1,0 +1,110 @@
+"""Datetime expression tests against python datetime oracles."""
+
+import datetime
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Scalar
+from spark_rapids_tpu.ops import datetime as D
+from spark_rapids_tpu.ops.expressions import col, lit
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(y, m, d):
+    return (datetime.date(y, m, d) - EPOCH).days
+
+
+def _batch_dates(dates):
+    sch = dt.Schema([("d", dt.DATE)])
+    vals = [None if x is None else _days(*x) for x in dates]
+    return ColumnarBatch.from_pydict({"d": vals}, schema=sch)
+
+
+def _eval(expr, batch):
+    expr = expr.transform(
+        lambda e: e.resolve(batch.schema) if hasattr(e, "resolve") else None)
+    out = expr.eval(batch)
+    if isinstance(out, Scalar):
+        return out.value
+    return out.to_pylist(batch.num_rows)
+
+
+def test_ymd_extraction():
+    b = _batch_dates([(2020, 2, 29), (1969, 12, 31), (2000, 1, 1), None])
+    assert _eval(D.Year(col("d")), b) == [2020, 1969, 2000, None]
+    assert _eval(D.Month(col("d")), b) == [2, 12, 1, None]
+    assert _eval(D.DayOfMonth(col("d")), b) == [29, 31, 1, None]
+
+
+def test_ymd_wide_range():
+    dates = [(1583, 1, 1), (1899, 3, 15), (1970, 1, 1), (2038, 12, 31), (2400, 2, 29)]
+    b = _batch_dates(dates)
+    assert _eval(D.Year(col("d")), b) == [y for y, _, _ in dates]
+    assert _eval(D.Month(col("d")), b) == [m for _, m, _ in dates]
+    assert _eval(D.DayOfMonth(col("d")), b) == [d for _, _, d in dates]
+
+
+def test_dayofweek_quarter_doy():
+    # 2024-07-04 is a Thursday: Spark dayofweek=5 (Sun=1), weekday=3 (Mon=0)
+    b = _batch_dates([(2024, 7, 4), (2024, 1, 1)])
+    assert _eval(D.DayOfWeek(col("d")), b) == [5, 2]
+    assert _eval(D.WeekDay(col("d")), b) == [3, 0]
+    assert _eval(D.Quarter(col("d")), b) == [3, 1]
+    assert _eval(D.DayOfYear(col("d")), b) == [186, 1]
+
+
+def test_last_day_add_months():
+    b = _batch_dates([(2024, 1, 31), (2023, 2, 3)])
+    out = _eval(D.LastDay(col("d")), b)
+    assert out == [_days(2024, 1, 31), _days(2023, 2, 28)]
+    out2 = _eval(D.AddMonths(col("d"), lit(1)), b)
+    assert out2 == [_days(2024, 2, 29), _days(2023, 3, 3)]
+
+
+def test_date_add_sub_diff():
+    b = _batch_dates([(2020, 1, 1), None])
+    assert _eval(D.DateAdd(col("d"), lit(31)), b) == [_days(2020, 2, 1), None]
+    assert _eval(D.DateSub(col("d"), lit(1)), b) == [_days(2019, 12, 31), None]
+    b2 = ColumnarBatch.from_pydict(
+        {"a": [_days(2020, 3, 1)], "b": [_days(2020, 2, 28)]},
+        schema=dt.Schema([("a", dt.DATE), ("b", dt.DATE)]))
+    assert _eval(D.DateDiff(col("a"), col("b")), b2) == [2]
+
+
+def test_timestamp_parts():
+    ts = int(datetime.datetime(2021, 6, 15, 13, 45, 59).timestamp())  # UTC env
+    micros = ts * 1_000_000
+    sch = dt.Schema([("t", dt.TIMESTAMP)])
+    b = ColumnarBatch.from_pydict({"t": [micros, None]}, schema=sch)
+    assert _eval(D.Hour(col("t")), b) == [13, None]
+    assert _eval(D.Minute(col("t")), b) == [45, None]
+    assert _eval(D.Second(col("t")), b) == [59, None]
+    assert _eval(D.Year(col("t")), b) == [2021, None]
+
+
+def test_pre_epoch_timestamp_parts():
+    # 1969-12-31 23:59:58.5 UTC — floor semantics
+    micros = -1_500_000
+    sch = dt.Schema([("t", dt.TIMESTAMP)])
+    b = ColumnarBatch.from_pydict({"t": [micros]}, schema=sch)
+    assert _eval(D.Hour(col("t")), b) == [23]
+    assert _eval(D.Minute(col("t")), b) == [59]
+    assert _eval(D.Second(col("t")), b) == [58]
+
+
+def test_unix_timestamp_roundtrip():
+    sch = dt.Schema([("t", dt.TIMESTAMP)])
+    b = ColumnarBatch.from_pydict({"t": [1_623_764_759_000_000, None]}, schema=sch)
+    assert _eval(D.UnixTimestamp(col("t")), b) == [1_623_764_759, None]
+    sch2 = dt.Schema([("s", dt.INT64)])
+    b2 = ColumnarBatch.from_pydict({"s": [1_623_764_759]}, schema=sch2)
+    assert _eval(D.FromUnixTime(col("s")), b2) == [1_623_764_759_000_000]
+
+
+def test_to_date():
+    sch = dt.Schema([("t", dt.TIMESTAMP)])
+    b = ColumnarBatch.from_pydict(
+        {"t": [86_400_000_000 + 3600_000_000, -1]}, schema=sch)
+    # floor: 1970-01-02 and 1969-12-31
+    assert _eval(D.ToDate(col("t")), b) == [1, -1]

@@ -1,0 +1,194 @@
+"""End-to-end golden-compare tests: the integration-test ring analog
+(SURVEY.md §4 ring 2: joins / hash_aggregate / sort / repart domains)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+
+from golden import assert_tpu_and_cpu_equal
+
+
+def _seeded(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "i": [None if rng.random() < 0.1 else int(x)
+              for x in rng.integers(-100, 100, n)],
+        "j": [int(x) for x in rng.integers(0, 10, n)],
+        "f": [None if rng.random() < 0.1 else float(x)
+              for x in rng.normal(0, 50, n)],
+        "s": [None if rng.random() < 0.1 else
+              ["apple", "pear", "kiwi", "banana", "fig"][x]
+              for x in rng.integers(0, 5, n)],
+    }
+
+
+def test_project_arithmetic():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .select((col("i") + 1).alias("a"), (col("i") * col("j")).alias("m"),
+                (col("f") / 2).alias("h"), (-col("i")).alias("n")),
+        approx=1e-12)
+
+
+def test_filter_compound_predicate():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .filter((col("i") > 0) & (col("j") < 5) | col("s").isNull())
+        .select("i", "j", "s"))
+
+
+def test_conditional_exprs():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .select(F.when(col("i") > 0, lit("pos")).when(col("i") < 0, lit("neg"))
+                .otherwise(lit("zero-or-null")).alias("sign"),
+                F.coalesce(col("i"), col("j")).alias("c"),
+                F.greatest(col("i"), col("j")).alias("g")))
+
+
+def test_cast_matrix():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .select(col("i").cast("double").alias("d"),
+                col("f").cast("int").alias("fi"),
+                col("j").cast("string").alias("js"),
+                col("i").cast("boolean").alias("ib")))
+
+
+def test_groupby_aggregates():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .groupBy("j").agg(F.sum("i").alias("si"), F.count("i").alias("ci"),
+                          F.avg("f").alias("af"), F.min("s").alias("mins"),
+                          F.max("f").alias("maxf"),
+                          F.count("*").alias("cstar")),
+        approx=1e-9)
+
+
+def test_groupby_string_key():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .groupBy("s").agg(F.sum("j").alias("sj")))
+
+
+def test_reduction_no_keys():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .agg(F.sum("i").alias("si"), F.max("f").alias("mf"),
+             F.count("*").alias("n")),
+        approx=1e-9)
+
+
+def test_sort_multi_key():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .orderBy(col("j").asc(), col("f").desc(), col("s").asc()),
+        ignore_order=False, approx=1e-12)
+
+
+def test_limit_after_sort():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .orderBy(col("i").asc_nulls_last()).limit(17),
+        ignore_order=False)
+
+
+def test_inner_join():
+    def q(s):
+        left = s.createDataFrame(_seeded(100, seed=1))
+        right = s.createDataFrame(
+            {"j": list(range(10)), "name": [f"grp{x}" for x in range(10)]})
+        return left.join(right, on="j", how="inner").select("i", "j", "name")
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_left_join_with_nulls():
+    def q(s):
+        left = s.createDataFrame({"k": [1, 2, None, 4], "v": [10, 20, 30, 40]})
+        right = s.createDataFrame({"k": [1, 4, 5], "w": ["a", "b", "c"]})
+        return left.join(right, on="k", how="left").select("k", "v", "w")
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_semi_anti_join():
+    def semi(s):
+        left = s.createDataFrame(_seeded(80, 3))
+        right = s.createDataFrame({"j": [1, 2, 3]})
+        return left.join(right, on="j", how="left_semi").select("i", "j")
+    assert_tpu_and_cpu_equal(semi)
+
+    def anti(s):
+        left = s.createDataFrame(_seeded(80, 3))
+        right = s.createDataFrame({"j": [1, 2, 3]})
+        return left.join(right, on="j", how="left_anti").select("i", "j")
+    assert_tpu_and_cpu_equal(anti)
+
+
+def test_full_outer_join():
+    def q(s):
+        left = s.createDataFrame({"k": [1, 2, 3], "v": [10, 20, 30]})
+        right = s.createDataFrame({"k": [2, 3, 4], "w": [200, 300, 400]})
+        return left.join(right, on=(col("k") == col("k")), how="full")
+    # using explicit condition on same-named cols is ambiguous; use distinct names
+    def q2(s):
+        left = s.createDataFrame({"a": [1, 2, 3], "v": [10, 20, 30]})
+        right = s.createDataFrame({"b": [2, 3, 4], "w": [200, 300, 400]})
+        return left.join(right, on=(col("a") == col("b")), how="full")
+    assert_tpu_and_cpu_equal(q2)
+
+
+def test_union_distinct():
+    def q(s):
+        a = s.createDataFrame({"x": [1, 2, 3, 3]})
+        b = s.createDataFrame({"x": [3, 4, None]})
+        return a.union(b).distinct()
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_string_functions():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .select(F.length(col("s")).alias("len"),
+                F.substring(col("s"), 2, 3).alias("sub"),
+                F.concat(col("s"), lit("-"), col("s")).alias("cc"),
+                col("s").contains("an").alias("has"),
+                col("s").like("%ea%").alias("lk"),
+                F.trim(F.lpad(col("s"), 8, " ")).alias("tp")))
+
+
+def test_expand_like_grouping():
+    # distinct on computed column exercise
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .select((col("j") % 3).alias("g")).distinct())
+
+
+def test_range():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.range(0, 1000, 7).select((col("id") * 2).alias("x")),
+        ignore_order=False)
+
+
+def test_repartition_preserves_rows():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .repartition(4, col("j")).select("i", "j"))
+
+
+def test_with_column_chain():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .withColumn("d", col("i") * 2)
+        .withColumn("e", col("d") + col("j"))
+        .drop("f")
+        .filter(col("e").isNotNull()))
+
+
+def test_count_action():
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.config("spark.rapids.tpu.sql.explain", "NONE").getOrCreate()
+    df = s.createDataFrame({"x": [1, 2, None, 4]})
+    assert df.count() == 4
+    assert df.filter(col("x").isNotNull()).count() == 3

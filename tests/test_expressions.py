@@ -1,0 +1,185 @@
+"""Expression eval tests: arithmetic/predicates/conditionals/cast/math with
+Spark null semantics. Reference analog: ProjectExprSuite / CastOpSuite
+(SURVEY.md §4 ring 1) asserting against known Spark behavior.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column, Scalar
+from spark_rapids_tpu.ops import arithmetic as A
+from spark_rapids_tpu.ops import cast as C
+from spark_rapids_tpu.ops import conditionals as cond
+from spark_rapids_tpu.ops import math_ops as M
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.expressions import col, lit
+
+
+def _batch(**cols):
+    return ColumnarBatch.from_pydict(cols)
+
+
+def _eval(expr, batch):
+    expr = expr.transform(
+        lambda e: e.resolve(batch.schema) if hasattr(e, "resolve") else None)
+    out = expr.eval(batch)
+    if isinstance(out, Scalar):
+        return out.value
+    return out.to_pylist(batch.num_rows)
+
+
+def test_add_nulls():
+    b = _batch(x=[1, 2, None], y=[10, None, 30])
+    assert _eval(A.Add(col("x"), col("y")), b) == [11, None, None]
+
+
+def test_divide_by_zero_null():
+    b = _batch(x=[10.0, 5.0, 1.0], y=[2.0, 0.0, 4.0])
+    assert _eval(A.Divide(col("x"), col("y")), b) == [5.0, None, 0.25]
+
+
+def test_remainder_and_pmod():
+    b = _batch(x=[7, -7, 7], y=[3, 3, 0])
+    assert _eval(A.Remainder(col("x"), col("y")), b) == [1, -1, None]
+    assert _eval(A.Pmod(col("x"), col("y")), b) == [1, 2, None]
+
+
+def test_unary_minus_abs():
+    b = _batch(x=[1, -2, None])
+    assert _eval(A.UnaryMinus(col("x")), b) == [-1, 2, None]
+    assert _eval(A.Abs(col("x")), b) == [1, 2, None]
+
+
+def test_comparisons():
+    b = _batch(x=[1, 2, None], y=[2, 2, 2])
+    assert _eval(P.LessThan(col("x"), col("y")), b) == [True, False, None]
+    assert _eval(P.EqualTo(col("x"), col("y")), b) == [False, True, None]
+    assert _eval(P.GreaterThanOrEqual(col("x"), col("y")), b) == [False, True, None]
+
+
+def test_float_nan_comparison():
+    nan = float("nan")
+    b = _batch(x=[nan, 1.0, nan], y=[nan, nan, 1.0])
+    assert _eval(P.EqualTo(col("x"), col("y")), b) == [True, False, False]
+    # NaN is greater than everything
+    assert _eval(P.GreaterThan(col("x"), col("y")), b) == [False, False, True]
+
+
+def test_string_comparison():
+    b = _batch(s=["apple", "pear", None])
+    assert _eval(P.EqualTo(col("s"), lit("pear")), b) == [False, True, None]
+    assert _eval(P.LessThan(col("s"), lit("banana")), b) == [True, False, None]
+
+
+def test_kleene_and_or():
+    b = _batch(x=[True, True, False, None], y=[None, False, None, None])
+    assert _eval(P.And(col("x"), col("y")), b) == [None, False, False, None]
+    assert _eval(P.Or(col("x"), col("y")), b) == [True, True, None, None]
+
+
+def test_is_null_not():
+    b = _batch(x=[1, None, 3])
+    assert _eval(P.IsNull(col("x")), b) == [False, True, False]
+    assert _eval(P.IsNotNull(col("x")), b) == [True, False, True]
+    bb = _batch(p=[True, False, None])
+    assert _eval(P.Not(col("p")), bb) == [False, True, None]
+
+
+def test_in():
+    b = _batch(x=[1, 2, 3, None])
+    assert _eval(P.In(col("x"), [1, 3]), b) == [True, False, True, None]
+    # NULL in list: non-matches become NULL
+    assert _eval(P.In(col("x"), [1, None]), b) == [True, None, None, None]
+
+
+def test_in_strings():
+    b = _batch(s=["a", "b", None])
+    assert _eval(P.In(col("s"), ["a", "c"]), b) == [True, False, None]
+
+
+def test_if_case_when():
+    b = _batch(x=[1, 5, None])
+    e = cond.If(P.GreaterThan(col("x"), lit(2)), lit(100), lit(-100))
+    assert _eval(e, b) == [-100, 100, -100]  # NULL predicate -> else branch
+    cw = cond.CaseWhen(
+        [(P.EqualTo(col("x"), lit(1)), lit("one")),
+         (P.EqualTo(col("x"), lit(5)), lit("five"))], lit("other"))
+    assert _eval(cw, b) == ["one", "five", "other"]
+
+
+def test_coalesce_nvl_nullif():
+    b = _batch(x=[None, 2, None], y=[1, 20, None])
+    assert _eval(cond.Coalesce(col("x"), col("y")), b) == [1, 2, None]
+    assert _eval(cond.NullIf(col("y"), lit(20)), b) == [1, None, None]
+
+
+def test_least_greatest_skip_nulls():
+    b = _batch(x=[1, None, None], y=[3, 5, None])
+    assert _eval(cond.Greatest(col("x"), col("y")), b) == [3, 5, None]
+    assert _eval(cond.Least(col("x"), col("y")), b) == [1, 5, None]
+
+
+def test_cast_numeric():
+    b = _batch(x=[1.9, -1.9, None])
+    assert _eval(C.Cast(col("x"), dt.INT32), b) == [1, -1, None]
+    b2 = _batch(i=[1, 0, None])
+    assert _eval(C.Cast(col("i"), dt.BOOL), b2) == [True, False, None]
+
+
+def test_cast_float_to_int_saturates():
+    b = _batch(x=[1e300, -1e300, float("nan")])
+    assert _eval(C.Cast(col("x"), dt.INT64), b) == [
+        (1 << 63) - 1, -(1 << 63), 0]
+
+
+def test_cast_int_narrowing_wraps():
+    b = _batch(x=[300, -300, 127])
+    out = _eval(C.Cast(col("x"), dt.INT8), b)
+    assert out == [44, -44, 127]  # Java byte truncation
+
+
+def test_cast_string_to_int():
+    b = _batch(s=["42", " 7 ", "abc", None])
+    assert _eval(C.Cast(col("s"), dt.INT32), b) == [42, 7, None, None]
+
+
+def test_cast_int_to_string():
+    b = _batch(x=[42, -1, None])
+    assert _eval(C.Cast(col("x"), dt.STRING), b) == ["42", "-1", None]
+
+
+def test_math_ops():
+    b = _batch(x=[1.0, math.e, -1.0, None])
+    out = _eval(M.Log(col("x")), b)
+    assert out[0] == 0.0
+    assert abs(out[1] - 1.0) < 1e-12
+    assert out[2] is None  # log of negative -> NULL
+    assert out[3] is None
+    b2 = _batch(x=[4.0, 2.25])
+    assert _eval(M.Sqrt(col("x")), b2) == [2.0, 1.5]
+
+
+def test_floor_ceil_round():
+    b = _batch(x=[1.5, -1.5, 2.5])
+    assert _eval(M.Floor(col("x")), b) == [1, -2, 2]
+    assert _eval(M.Ceil(col("x")), b) == [2, -1, 3]
+    # Spark round = HALF_UP
+    assert _eval(M.Round(col("x"), 0), b) == [2.0, -2.0, 3.0]
+
+
+def test_pow():
+    # approximate: XLA lowers pow to exp(y*log(x)) (reference marks pow
+    # "incompat"/approximate_float for the same class of reason)
+    b = _batch(x=[2.0, 3.0], y=[10.0, 0.0])
+    out = _eval(M.Pow(col("x"), col("y")), b)
+    assert out == pytest.approx([1024.0, 1.0], rel=1e-12)
+
+
+def test_scalar_folding():
+    b = _batch(x=[1])
+    assert _eval(A.Add(lit(2), lit(3)), b) == 5
+    assert _eval(A.Divide(lit(1.0), lit(0.0)), b) is None

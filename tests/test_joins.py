@@ -1,0 +1,153 @@
+"""Sort-merge join kernel tests against pandas merge oracles.
+
+Reference analog: join integration tests + GpuHashJoin tag/remap behavior
+(SURVEY.md §2.4, §4).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.column import Column, bucket
+from spark_rapids_tpu.ops.joins import (cross_join_gather, join_gather,
+                                        join_match, unmatched_build_gather)
+
+
+def _col(vals, dtype):
+    return Column.from_pylist(vals, dtype)
+
+
+def _join(build_keys, build_cols, n_build, stream_keys, stream_cols, n_stream,
+          how="inner"):
+    m = join_match(build_keys, n_build, stream_keys, n_stream,
+                   stream_keys[0].capacity)
+    total = int(m.total_pairs)
+    if how == "left":
+        total = int(np.sum(np.maximum(np.asarray(m.count)[:n_stream], 1)))
+    cap = bucket(max(total, 1))
+    s_out, b_out, cnt = join_gather(m, stream_cols, build_cols, cap, how,
+                                    n_stream=n_stream)
+    n = int(cnt)
+    return ([c.to_pylist(n) for c in s_out], [c.to_pylist(n) for c in b_out], m)
+
+
+def _rows(*cols):
+    return sorted(zip(*cols), key=lambda r: tuple(
+        (x is None, x if x is not None else 0) for x in r))
+
+
+def test_inner_join_basic():
+    bk = _col([1, 2, 2, 3], dt.INT64)
+    bv = _col(["b1", "b2a", "b2b", "b3"], dt.STRING)
+    sk = _col([2, 1, 4, 2], dt.INT64)
+    sv = _col([100, 200, 300, 400], dt.INT64)
+    s_out, b_out, m = _join([bk], [bv], 4, [sk], [sk, sv], 4, "inner")
+    got = _rows(s_out[1], b_out[0])
+    assert got == _rows([100, 100, 200, 400, 400], ["b2a", "b2b", "b1", "b2a", "b2b"])
+
+
+def test_null_keys_never_match():
+    bk = _col([1, None], dt.INT64)
+    bv = _col([10, 20], dt.INT64)
+    sk = _col([1, None], dt.INT64)
+    sv = _col([100, 200], dt.INT64)
+    s_out, b_out, _ = _join([bk], [bv], 2, [sk], [sv], 2, "inner")
+    assert s_out[0] == [100]
+    assert b_out[0] == [10]
+
+
+def test_left_join():
+    bk = _col([1, 2], dt.INT64)
+    bv = _col([10, 20], dt.INT64)
+    sk = _col([2, 5, None], dt.INT64)
+    sv = _col([100, 200, 300], dt.INT64)
+    s_out, b_out, _ = _join([bk], [bv], 2, [sk], [sk, sv], 3, "left")
+    got = _rows(s_out[1], b_out[0])
+    assert got == _rows([100, 200, 300], [20, None, None])
+
+
+def test_semi_anti_join():
+    bk = _col([1, 2, 2], dt.INT64)
+    sk = _col([2, 3, None, 1], dt.INT64)
+    sv = _col([100, 200, 300, 400], dt.INT64)
+    m = join_match([bk], 3, [sk], 4, sk.capacity)
+    s_out, _, cnt = join_gather(m, [sv], [], 128, "left_semi", n_stream=4)
+    assert sorted(s_out[0].to_pylist(int(cnt))) == [100, 400]
+    s_out, _, cnt = join_gather(m, [sv], [], 128, "left_anti", n_stream=4)
+    assert sorted(s_out[0].to_pylist(int(cnt))) == [200, 300]
+
+
+def test_full_outer_pieces():
+    bk = _col([1, 9, None], dt.INT64)
+    bv = _col([10, 90, 99], dt.INT64)
+    sk = _col([1, 5], dt.INT64)
+    m = join_match([bk], 3, [sk], 2, sk.capacity)
+    un, cnt = unmatched_build_gather(m, [bv], 3)
+    # build rows 9 and NULL-key row are unmatched
+    assert sorted(un[0].to_pylist(int(cnt))) == [90, 99]
+
+
+def test_string_key_join():
+    bk = _col(["apple", "pear", None], dt.STRING)
+    bv = _col([1, 2, 3], dt.INT64)
+    sk = _col(["pear", "apple", "kiwi", None], dt.STRING)
+    sv = _col([10, 20, 30, 40], dt.INT64)
+    s_out, b_out, _ = _join([bk], [bv], 3, [sk], [sv], 4, "inner")
+    got = _rows(s_out[0], b_out[0])
+    assert got == _rows([10, 20], [2, 1])
+
+
+def test_multi_key_join():
+    bk1 = _col([1, 1, 2], dt.INT64)
+    bk2 = _col(["x", "y", "x"], dt.STRING)
+    bv = _col([11, 12, 21], dt.INT64)
+    sk1 = _col([1, 2, 1], dt.INT64)
+    sk2 = _col(["y", "x", "z"], dt.STRING)
+    sv = _col([100, 200, 300], dt.INT64)
+    s_out, b_out, _ = _join([bk1, bk2], [bv], 3, [sk1, sk2], [sv], 3, "inner")
+    got = _rows(s_out[0], b_out[0])
+    assert got == _rows([100, 200], [12, 21])
+
+
+def test_float_key_join_nan_matches_nan():
+    nan = float("nan")
+    bk = _col([1.0, nan], dt.FLOAT64)
+    bv = _col([1, 2], dt.INT64)
+    sk = _col([nan, 1.0, 2.0], dt.FLOAT64)
+    sv = _col([10, 20, 30], dt.INT64)
+    s_out, b_out, _ = _join([bk], [bv], 2, [sk], [sv], 3, "inner")
+    got = _rows(s_out[0], b_out[0])
+    # Spark: NaN == NaN in joins
+    assert got == _rows([10, 20], [2, 1])
+
+
+def test_cross_join():
+    lk = _col([1, 2], dt.INT64)
+    rk = _col([10, 20, 30], dt.INT64)
+    l_out, r_out, cnt = cross_join_gather([lk], 2, [rk], 3, 128)
+    n = int(cnt)
+    assert n == 6
+    pairs = sorted(zip(l_out[0].to_pylist(n), r_out[0].to_pylist(n)))
+    assert pairs == [(1, 10), (1, 20), (1, 30), (2, 10), (2, 20), (2, 30)]
+
+
+def test_join_random_vs_pandas():
+    rng = np.random.default_rng(7)
+    n_b, n_s = 200, 300
+    bk = rng.integers(0, 60, n_b)
+    bv = rng.integers(0, 1000, n_b)
+    sk = rng.integers(0, 80, n_s)
+    sv = rng.integers(0, 1000, n_s)
+    bkc, bvc = _col(list(bk), dt.INT64), _col(list(bv), dt.INT64)
+    skc, svc = _col(list(sk), dt.INT64), _col(list(sv), dt.INT64)
+
+    for how in ("inner", "left"):
+        s_out, b_out, _ = _join([bkc], [bvc], n_b, [skc], [skc, svc], n_s, how)
+        got = _rows(s_out[0], s_out[1], b_out[0])
+        df_b = pd.DataFrame({"k": bk, "bv": bv})
+        df_s = pd.DataFrame({"k": sk, "sv": sv})
+        merged = df_s.merge(df_b, on="k", how=how)
+        exp = _rows(list(merged["k"]), list(merged["sv"]),
+                    [None if pd.isna(x) else int(x) for x in merged["bv"]])
+        assert got == exp

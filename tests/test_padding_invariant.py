@@ -1,0 +1,71 @@
+"""Regression tests: every expression output must keep padding rows invalid and
+zeroed (DESIGN.md §1 invariant), so filters over predicate outputs can't leak
+padding rows as live data.
+"""
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.ops import kernels as K
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.expressions import col, lit
+
+
+def _resolve(expr, batch):
+    return expr.transform(
+        lambda e: e.resolve(batch.schema) if hasattr(e, "resolve") else None)
+
+
+def _assert_padding_clean(column, num_rows):
+    valid = np.asarray(column.validity)
+    data = np.asarray(column.data)
+    assert not valid[num_rows:].any(), "padding rows must be invalid"
+    assert not data[num_rows:].any(), "padding rows must be zeroed"
+
+
+def test_not_is_null_filter_does_not_leak_padding():
+    b = ColumnarBatch.from_pydict({"x": [1, None, 3]})
+    pred = _resolve(P.Not(P.IsNull(col("x"))), b)
+    out = pred.eval(b)
+    _assert_padding_clean(out, b.num_rows)
+    keep = np.asarray(out.data) & np.asarray(out.validity)
+    import jax.numpy as jnp
+    [compacted], count = K.compact_columns([b.column("x")], jnp.asarray(keep))
+    assert int(count) == 2
+    assert compacted.to_pylist(2) == [1, 3]
+
+
+def test_predicate_padding_clean():
+    b = ColumnarBatch.from_pydict({"x": [1.0, None, float("nan")]})
+    for expr in [P.IsNull(col("x")), P.IsNotNull(col("x")), P.IsNaN(col("x")),
+                 P.EqualNullSafe(col("x"), lit(1.0)),
+                 P.Not(P.EqualNullSafe(col("x"), col("x")))]:
+        out = _resolve(expr, b).eval(b)
+        _assert_padding_clean(out, b.num_rows)
+
+
+def test_from_pydict_respects_schema_order():
+    schema = dt.Schema([("a", dt.INT64), ("b", dt.INT64)])
+    b = ColumnarBatch.from_pydict({"b": [10, 20], "a": [1, 2]}, schema=schema)
+    assert b.to_pydict() == {"a": [1, 2], "b": [10, 20]}
+
+
+def test_cast_timestamp_honors_utc_offset():
+    from spark_rapids_tpu.ops.cast import _parse_value
+    base = _parse_value("2020-01-01 00:00:00", dt.TIMESTAMP)
+    offset = _parse_value("2020-01-01 00:00:00+05:00", dt.TIMESTAMP)
+    assert base - offset == 5 * 3600 * 1_000_000
+
+
+def test_nullif_semantics():
+    from spark_rapids_tpu.ops import conditionals as cond
+    b = ColumnarBatch.from_pydict({"y": [1, 20, None], "z": ["a", "", None]})
+    out = _resolve(cond.NullIf(col("y"), lit(20)), b).eval(b)
+    assert out.to_pylist(3) == [1, None, None]
+    # null b never matches (nullif(a, NULL) = a)
+    out2 = _resolve(cond.NullIf(col("y"), lit(None, dt.INT64)), b).eval(b)
+    assert out2.to_pylist(3) == [1, 20, None]
+    # string path: empty string vs null must not be conflated
+    out3 = _resolve(cond.NullIf(col("z"), lit("")), b).eval(b)
+    assert out3.to_pylist(3) == ["a", None, None]

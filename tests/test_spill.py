@@ -1,0 +1,130 @@
+"""Spill store tests: tier transitions, budgets, priorities, rematerialization.
+
+Reference analog: RapidsDeviceMemoryStoreSuite / RapidsHostMemoryStoreSuite /
+RapidsDiskStoreSuite / RapidsBufferCatalogSuite / SpillableColumnarBatchSuite
+(SURVEY.md §4 ring 1).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.spill import (ACTIVE_ON_DECK_PRIORITY,
+                                         OUTPUT_FOR_SHUFFLE_PRIORITY,
+                                         BufferCatalog, SpillableColumnarBatch,
+                                         StorageTier)
+
+
+@pytest.fixture
+def catalog(tmp_path):
+    return BufferCatalog(device_budget=1 << 20, host_budget=1 << 20,
+                         spill_dir=str(tmp_path))
+
+
+def _batch(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict({
+        "a": rng.integers(0, 1000, n),
+        "b": rng.normal(size=n),
+        "s": [f"row-{i}" for i in range(n)],
+    })
+
+
+def test_register_and_acquire_roundtrip(catalog):
+    b = _batch()
+    bid = catalog.register_batch(b)
+    out = catalog.acquire_batch(bid)
+    assert out.to_pydict() == b.to_pydict()
+
+
+def test_spill_to_host_and_back(catalog):
+    b = _batch()
+    bid = catalog.register_batch(b)
+    buf = catalog.buffers[bid]
+    moved = buf.spill_to_host()
+    assert moved > 0
+    assert buf.tier == StorageTier.HOST
+    assert catalog.acquire_batch(bid).to_pydict() == b.to_pydict()
+
+
+def test_spill_to_disk_and_back(catalog, tmp_path):
+    b = _batch()
+    bid = catalog.register_batch(b)
+    buf = catalog.buffers[bid]
+    buf.spill_to_disk(str(tmp_path))
+    assert buf.tier == StorageTier.DISK
+    assert catalog.acquire_batch(bid).to_pydict() == b.to_pydict()
+
+
+def test_budget_triggers_spill(tmp_path):
+    one = _batch(1000).device_size_bytes()
+    cat = BufferCatalog(device_budget=3 * one, host_budget=10 << 20,
+                        spill_dir=str(tmp_path))
+    ids = [cat.register_batch(_batch(1000, seed=i)) for i in range(5)]
+    assert cat.device_bytes <= 3 * one
+    assert any(cat.buffers[i].tier != StorageTier.DEVICE for i in ids)
+    # all batches still readable
+    for i in ids:
+        assert cat.acquire_batch(i).num_rows == 1000
+
+
+def test_priority_order_spills_shuffle_first(tmp_path):
+    cat = BufferCatalog(device_budget=10 << 20, host_budget=10 << 20,
+                        spill_dir=str(tmp_path))
+    shuffle_id = cat.register_batch(_batch(500, 1), OUTPUT_FOR_SHUFFLE_PRIORITY)
+    active_id = cat.register_batch(_batch(500, 2), ACTIVE_ON_DECK_PRIORITY)
+    cat._spill_device_to(cat.device_bytes - 1)  # force spilling one buffer
+    assert cat.buffers[shuffle_id].tier == StorageTier.HOST
+    assert cat.buffers[active_id].tier == StorageTier.DEVICE
+
+
+def test_host_budget_cascades_to_disk(tmp_path):
+    one = _batch(1000).device_size_bytes()
+    cat = BufferCatalog(device_budget=2 * one, host_budget=2 * one,
+                        spill_dir=str(tmp_path))
+    ids = [cat.register_batch(_batch(1000, seed=i)) for i in range(6)]
+    tiers = {cat.buffers[i].tier for i in ids}
+    assert StorageTier.DISK in tiers
+    for i in ids:
+        assert cat.acquire_batch(i).num_rows == 1000
+
+
+def test_reserve_spills_ahead(tmp_path):
+    one = _batch(1000).device_size_bytes()
+    cat = BufferCatalog(device_budget=3 * one, host_budget=10 << 20,
+                        spill_dir=str(tmp_path))
+    cat.register_batch(_batch(1000, 1), OUTPUT_FOR_SHUFFLE_PRIORITY)
+    used = cat.device_bytes
+    cat.reserve(3 * one - used // 2)  # needs more than remaining
+    assert cat.device_bytes <= used // 2 + 1
+
+
+def test_spillable_batch_close_frees(catalog):
+    b = _batch()
+    with SpillableColumnarBatch(b, catalog=catalog) as sb:
+        assert sb.get_batch().num_rows == 100
+        bid = sb._id
+        assert bid in catalog.buffers
+    assert bid not in catalog.buffers
+
+
+def test_remove_deletes_disk_file(catalog, tmp_path):
+    b = _batch()
+    bid = catalog.register_batch(b)
+    catalog.buffers[bid].spill_to_disk(str(tmp_path))
+    path = catalog.buffers[bid]._disk_path
+    import os
+    assert os.path.exists(path)
+    catalog.remove(bid)
+    assert not os.path.exists(path)
+
+
+def test_semaphore():
+    from spark_rapids_tpu.exec.device import TpuSemaphore
+    sem = TpuSemaphore(2)
+    sem.acquire_if_necessary()
+    sem.acquire_if_necessary()  # idempotent same-thread
+    assert sem._sem._value == 1
+    sem.release_if_necessary()
+    sem.release_if_necessary()
+    assert sem._sem._value == 2

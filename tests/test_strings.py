@@ -1,0 +1,172 @@
+"""String expression tests. Reference analog: string suites + stringFunctions
+semantics (SURVEY.md §2.3)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Scalar
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.ops.expressions import col, lit
+
+
+def _batch(**cols):
+    return ColumnarBatch.from_pydict(cols)
+
+
+def _eval(expr, batch):
+    expr = expr.transform(
+        lambda e: e.resolve(batch.schema) if hasattr(e, "resolve") else None)
+    out = expr.eval(batch)
+    if isinstance(out, Scalar):
+        return out.value
+    return out.to_pylist(batch.num_rows)
+
+
+def test_length_chars_not_bytes():
+    b = _batch(s=["hello", "", None, "héllo", "日本語"])
+    assert _eval(S.Length(col("s")), b) == [5, 0, None, 5, 3]
+
+
+def test_upper_lower():
+    b = _batch(s=["MiXeD", "abc", None])
+    assert _eval(S.Upper(col("s")), b) == ["MIXED", "ABC", None]
+    assert _eval(S.Lower(col("s")), b) == ["mixed", "abc", None]
+
+
+def test_initcap():
+    b = _batch(s=["hello world", "ABC def", None])
+    assert _eval(S.InitCap(col("s")), b) == ["Hello World", "Abc Def", None]
+
+
+def test_substring():
+    b = _batch(s=["hello", "hi", None])
+    assert _eval(S.Substring(col("s"), lit(2), lit(3)), b) == ["ell", "i", None]
+    assert _eval(S.Substring(col("s"), lit(0), lit(2)), b) == ["he", "hi", None]
+    assert _eval(S.Substring(col("s"), lit(-3), lit(2)), b) == ["ll", "hi", None]
+
+
+def test_substring_multibyte():
+    b = _batch(s=["héllo"])
+    assert _eval(S.Substring(col("s"), lit(2), lit(2)), b) == ["él"]
+
+
+def test_concat():
+    b = _batch(a=["x", "y", None], c=["1", "2", "3"])
+    assert _eval(S.ConcatStr(col("a"), lit("-"), col("c")), b) == \
+        ["x-1", "y-2", None]
+
+
+def test_contains_starts_ends():
+    b = _batch(s=["foobar", "barfoo", "baz", None])
+    assert _eval(S.Contains(col("s"), lit("foo")), b) == [True, True, False, None]
+    assert _eval(S.StartsWith(col("s"), lit("foo")), b) == [True, False, False, None]
+    assert _eval(S.EndsWith(col("s"), lit("foo")), b) == [False, True, False, None]
+
+
+def test_like():
+    b = _batch(s=["apple", "application", "grape", None])
+    assert _eval(S.Like(col("s"), "app%"), b) == [True, True, False, None]
+    assert _eval(S.Like(col("s"), "%ple"), b) == [True, False, False, None]
+    assert _eval(S.Like(col("s"), "%pl%"), b) == [True, True, False, None]
+    assert _eval(S.Like(col("s"), "apple"), b) == [True, False, False, None]
+    # underscore = exactly one char (host path)
+    assert _eval(S.Like(col("s"), "appl_"), b) == [True, False, False, None]
+
+
+def test_trim():
+    b = _batch(s=["  hi  ", "hi", "   ", None])
+    assert _eval(S.StringTrim(col("s")), b) == ["hi", "hi", "", None]
+    assert _eval(S.StringTrimLeft(col("s")), b) == ["hi  ", "hi", "", None]
+    assert _eval(S.StringTrimRight(col("s")), b) == ["  hi", "hi", "", None]
+
+
+def test_pad():
+    b = _batch(s=["ab", "abcdef", None])
+    assert _eval(S.StringLPad(col("s"), 4, "*"), b) == ["**ab", "abcd", None]
+    assert _eval(S.StringRPad(col("s"), 4, "*"), b) == ["ab**", "abcd", None]
+
+
+def test_locate():
+    b = _batch(s=["foobar", "barbar", "xyz", None])
+    assert _eval(S.StringLocate(lit("bar"), col("s")), b) == [4, 1, 0, None]
+
+
+def test_replace():
+    b = _batch(s=["aXbXc", "nope", None])
+    assert _eval(S.StringReplace(col("s"), "X", "--"), b) == \
+        ["a--b--c", "nope", None]
+
+
+def test_regexp_extract_host():
+    b = _batch(s=["a123b", "xyz", None])
+    assert _eval(S.RegExpExtractHost(col("s"), r"([0-9]+)", 1), b) == \
+        ["123", "", None]
+
+
+def test_murmur3_matches_spark_reference_values():
+    """Bit-compat check against a host reimplementation of Spark's
+    Murmur3_x86_32 (hashInt/hashLong/hashUnsafeBytes, seed 42) — the algorithm
+    Spark's Murmur3Hash expression and HashPartitioning use."""
+    from spark_rapids_tpu.ops.hashing import Murmur3Hash
+    b = ColumnarBatch.from_pydict({"i": [0, 42, -1]},
+                                  schema=dt.Schema([("i", dt.INT32)]))
+    out = _eval(Murmur3Hash(col("i")), b)
+    assert out == [_ref_int(0), _ref_int(42), _ref_int(-1)]
+    assert out == [933211791, 29417773, -1604776387]
+
+
+def test_murmur3_long_and_string():
+    from spark_rapids_tpu.ops.hashing import Murmur3Hash
+    b = _batch(l=[0, 42], s=["", "abc"])
+    out = _eval(Murmur3Hash(col("l")), b)
+    assert out == [-1670924195, 1316951768]
+    out_s = _eval(Murmur3Hash(col("s")), b)
+    assert out_s == [_ref_bytes(b""), _ref_bytes(b"abc")]
+    assert out_s == [142593372, 1322437556]
+
+
+_M = 0xFFFFFFFF
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def _mixk1(k1):
+    k1 = (k1 * 0xCC9E2D51) & _M
+    return (_rotl(k1, 15) * 0x1B873593) & _M
+
+
+def _mixh1(h1, k1):
+    h1 ^= k1
+    return (_rotl(h1, 13) * 5 + 0xE6546B64) & _M
+
+
+def _fmix(h1, ln):
+    h1 ^= ln
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M
+    return h1 ^ (h1 >> 16)
+
+
+def _s32(x):
+    return x - (1 << 32) if x >= 1 << 31 else x
+
+
+def _ref_int(v, seed=42):
+    return _s32(_fmix(_mixh1(seed, _mixk1(v & _M)), 4))
+
+
+def _ref_bytes(bs, seed=42):
+    h1 = seed
+    n = len(bs)
+    for i in range(0, n // 4 * 4, 4):
+        k1 = bs[i] | bs[i + 1] << 8 | bs[i + 2] << 16 | bs[i + 3] << 24
+        h1 = _mixh1(h1, _mixk1(k1))
+    for i in range(n // 4 * 4, n):
+        b = bs[i] - 256 if bs[i] >= 128 else bs[i]
+        h1 = _mixh1(h1, _mixk1(b & _M))
+    return _s32(_fmix(h1, n))

@@ -1,0 +1,135 @@
+"""Window function tests: device kernels vs CPU window engine.
+
+Reference analog: WindowFunctionSuite (SURVEY.md §4 ring 1).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.ops import window as W
+from spark_rapids_tpu.plan import logical as lp
+
+
+def _win_df(session, window_exprs):
+    df = session.createDataFrame({
+        "p": [1, 1, 1, 2, 2, None],
+        "o": [3, 1, 2, 10, 5, 7],
+        "v": [10.0, 20.0, None, 40.0, 50.0, 60.0],
+    })
+    plan = lp.Window(df._plan, window_exprs)
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    return DataFrame(plan, session)
+
+
+def _session():
+    return TpuSession.builder.config(
+        "spark.rapids.tpu.sql.explain", "NONE").getOrCreate()
+
+
+def _spec(partition=("p",), order=("o",), frame=None):
+    from spark_rapids_tpu.ops.expressions import ColumnRef
+    return W.WindowSpec(
+        [ColumnRef(c) for c in partition],
+        [lp.SortOrder(ColumnRef(c)) for c in order],
+        frame)
+
+
+def test_row_number():
+    s = _session()
+    df = _win_df(s, [("rn", W.WindowExpression(W.RowNumber(), _spec()))])
+    rows = sorted(df.collect(), key=lambda r: (r[0] is None, r[0] or 0, r[1]))
+    # partition 1 ordered by o: o=1 -> 1, o=2 -> 2, o=3 -> 3
+    by_po = {(r[0], r[1]): r[3] for r in rows}
+    assert by_po[(1, 1)] == 1 and by_po[(1, 2)] == 2 and by_po[(1, 3)] == 3
+    assert by_po[(2, 5)] == 1 and by_po[(2, 10)] == 2
+    assert by_po[(None, 7)] == 1
+
+
+def test_rank_dense_rank():
+    s = _session()
+    df = s.createDataFrame({"p": [1, 1, 1, 1], "o": [1, 2, 2, 3]})
+    plan = lp.Window(df._plan, [
+        ("rk", W.WindowExpression(W.Rank(), _spec())),
+        ("dr", W.WindowExpression(W.DenseRank(), _spec())),
+    ])
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    out = sorted(DataFrame(plan, s).collect())
+    assert [(r[2], r[3]) for r in out] == [(1, 1), (2, 2), (2, 2), (4, 3)]
+
+
+def test_lead_lag():
+    s = _session()
+    df = _win_df(s, [
+        ("ld", W.WindowExpression(W.Lead(
+            __import__("spark_rapids_tpu.ops.expressions",
+                       fromlist=["ColumnRef"]).ColumnRef("v"), 1), _spec())),
+        ("lg", W.WindowExpression(W.Lag(
+            __import__("spark_rapids_tpu.ops.expressions",
+                       fromlist=["ColumnRef"]).ColumnRef("v"), 1, -1.0),
+            _spec())),
+    ])
+    rows = df.collect()
+    by_po = {(r[0], r[1]): (r[3], r[4]) for r in rows}
+    # partition 1 by o: (o=1,v=20) -> lead=v(o=2)=None, lag=default -1
+    assert by_po[(1, 1)] == (None, -1.0)
+    assert by_po[(1, 2)] == (10.0, 20.0)
+    assert by_po[(1, 3)] == (None, None)
+
+
+def test_running_and_whole_aggregates():
+    s = _session()
+    from spark_rapids_tpu.ops.expressions import ColumnRef
+    df = _win_df(s, [
+        ("run_sum", W.WindowExpression(
+            lp.AggregateExpression("sum", ColumnRef("v")),
+            _spec(frame=W.WindowFrame(None, 0)))),
+        ("tot", W.WindowExpression(
+            lp.AggregateExpression("sum", ColumnRef("v")),
+            W.WindowSpec([ColumnRef("p")], [], None))),
+    ])
+    rows = df.collect()
+    by_po = {(r[0], r[1]): (r[3], r[4]) for r in rows}
+    assert by_po[(1, 1)] == (20.0, 30.0)
+    assert by_po[(1, 2)] == (20.0, 30.0)  # v None at o=2: running stays 20
+    assert by_po[(1, 3)] == (30.0, 30.0)
+    assert by_po[(2, 5)] == (50.0, 90.0)
+    assert by_po[(2, 10)] == (90.0, 90.0)
+
+
+def test_window_vs_cpu_random():
+    s = _session()
+    from spark_rapids_tpu.ops.expressions import ColumnRef
+    rng = np.random.default_rng(3)
+    n = 300
+    df = s.createDataFrame({
+        "p": [int(x) for x in rng.integers(0, 12, n)],
+        "o": [int(x) for x in rng.integers(0, 1000, n)],
+        "v": [None if rng.random() < 0.1 else float(x)
+              for x in rng.normal(0, 10, n)],
+    })
+    plan = lp.Window(df._plan, [
+        ("rn", W.WindowExpression(W.RowNumber(), _spec())),
+        ("rs", W.WindowExpression(
+            lp.AggregateExpression("sum", ColumnRef("v")),
+            _spec(frame=W.WindowFrame(None, 0)))),
+        ("mx", W.WindowExpression(
+            lp.AggregateExpression("max", ColumnRef("v")),
+            W.WindowSpec([ColumnRef("p")], [], None))),
+    ])
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    wdf = DataFrame(plan, s)
+    from spark_rapids_tpu.cpu.engine import execute as cpu_execute
+    cpu = cpu_execute(wdf._analyzed())
+    tpu = wdf.collect()
+    cpu_rows = sorted(
+        [tuple(r) for r in cpu.itertuples(index=False, name=None)])
+    tpu_rows = sorted(tpu)
+    assert len(cpu_rows) == len(tpu_rows)
+    for cr, tr in zip(cpu_rows, tpu_rows):
+        for cv, tv in zip(cr, tr):
+            if isinstance(cv, float) and isinstance(tv, float):
+                assert abs(cv - tv) < 1e-9
+            else:
+                assert cv == tv, (cr, tr)
