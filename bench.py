@@ -33,41 +33,64 @@ def build_inputs(n_rows: int, cap: int):
 
 
 def bench_tpu(n_rows: int, cap: int, iters: int = 10) -> float:
+    """Two-phase fused pipeline, the TpuHashAggregateExec shape:
+    jit1: filter -> project -> sort -> segment structure (+ group count sync)
+    jit2 (static K): MXU one-hot-matmul reductions + key gather.
+    """
     import jax
     import jax.numpy as jnp
     from spark_rapids_tpu.columnar import dtypes as dt
-    from spark_rapids_tpu.columnar.column import Column
+    from spark_rapids_tpu.columnar.column import Column, bucket
     from spark_rapids_tpu.ops import kernels as K
     from spark_rapids_tpu.ops import aggregates as agg_k
 
     keys, key_valid, vals, val_valid, flags = build_inputs(n_rows, cap)
 
-    def fused_stage(keys, key_valid, vals, val_valid, flags, num_rows):
+    def phase1(keys, key_valid, vals, val_valid, flags, num_rows):
         live = jnp.arange(cap) < num_rows
         keep = live & flags & val_valid & (vals > 0)
         cols = [Column(dt.INT64, keys, key_valid),
                 Column(dt.FLOAT64, vals, val_valid)]
-        compacted, count = K.compact_columns(cols, keep)
-        kcol, vcol = compacted
-        projected = Column(dt.FLOAT64, vcol.data * 2.0 + 1.0, vcol.validity)
-        out_keys, out_aggs, n_groups = agg_k.groupby_aggregate(
-            [kcol], [agg_k.AggSpec("sum", projected),
-                     agg_k.AggSpec("count", projected),
-                     agg_k.AggSpec("max", projected)], count, cap)
-        return (out_keys[0].data, out_aggs[0].data, out_aggs[1].data,
-                out_aggs[2].data, n_groups)
+        (kcol, vcol), count = K.compact_columns(cols, keep)
+        proj = Column(dt.FLOAT64, vcol.data * 2.0 + 1.0, vcol.validity)
+        order = K.sort_indices([K.SortKey(kcol)], count, cap)
+        sk = K.gather_column(kcol, order)
+        sv = K.gather_column(proj, order)
+        live2 = jnp.arange(cap) < count
+        starts = K.segment_starts_from_sorted_keys([sk], count, cap)
+        seg_ids = K.segment_ids(starts)
+        start_perm, _ = K.compaction_indices(starts)
+        n_groups = jnp.sum(starts).astype(jnp.int32)
+        return (sk.data, sk.validity, sv.data, sv.validity, seg_ids,
+                start_perm, live2, n_groups)
 
-    fn = jax.jit(fused_stage)
+    def phase2(Kb, skd, skv, svd, svv, seg_ids, start_perm, live2):
+        vcol = Column(dt.FLOAT64, svd, svv)
+        s = agg_k.segment_aggregate_matmul(
+            agg_k.AggSpec("sum", vcol), seg_ids, live2, Kb)
+        c = agg_k.segment_aggregate_matmul(
+            agg_k.AggSpec("count", vcol), seg_ids, live2, Kb)
+        a = agg_k.segment_aggregate_matmul(
+            agg_k.AggSpec("avg", vcol), seg_ids, live2, Kb)
+        gkeys = skd[start_perm[:Kb]]
+        return gkeys, s.data, c.data, a.data
+
+    f1 = jax.jit(phase1)
+    f2 = jax.jit(phase2, static_argnums=0)
     args = (jnp.asarray(keys), jnp.asarray(key_valid), jnp.asarray(vals),
             jnp.asarray(val_valid), jnp.asarray(flags), jnp.int32(n_rows))
-    # compile + warm (block_until_ready is unreliable over the device tunnel;
-    # a host scalar fetch is the only true completion barrier)
-    out = fn(*args)
-    _ = int(out[-1])
+
+    def run_once():
+        out1 = f1(*args)
+        ng = int(out1[-1])              # host sync (the n_groups read the
+        Kb = bucket(max(ng, 1))         # exec performs at every agg boundary)
+        out2 = f2(Kb, *out1[:-1])
+        return int(np.asarray(out2[2][0])), ng
+
+    run_once()  # compile + warm both phases
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
-        _ = int(out[-1])   # force completion via host fetch
+        run_once()
     dt_s = (time.perf_counter() - t0) / iters
     return n_rows / dt_s
 

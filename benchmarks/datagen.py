@@ -1,0 +1,108 @@
+"""TPC-H-like data generator (seeded, pure numpy).
+
+Analog of the reference's benchmark datasets (TpchLikeSpark.scala /
+integration_tests data_gen.py seeded generators, SURVEY.md §4/§6). Scale
+factor 1 ~= 6M lineitem rows / 1.5M orders, matching TPC-H row ratios;
+columns cover the types the queries exercise (ints, floats, dates, strings).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+LINEITEM_PER_SF = 6_000_000
+ORDERS_PER_SF = 1_500_000
+CUSTOMER_PER_SF = 150_000
+PART_PER_SF = 200_000
+SUPPLIER_PER_SF = 10_000
+
+_EPOCH_1992 = 8035     # days 1970-01-01 -> 1992-01-01
+_DATE_RANGE = 2556     # ~7 years of order dates
+
+RETURN_FLAGS = ["A", "N", "R"]
+LINE_STATUS = ["F", "O"]
+SHIP_MODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+
+
+def gen_lineitem(sf: float, seed: int = 42) -> Dict[str, np.ndarray]:
+    n = int(LINEITEM_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    n_orders = max(int(ORDERS_PER_SF * sf), 1)
+    quantity = rng.integers(1, 51, n).astype(np.int64)
+    extendedprice = np.round(rng.uniform(900, 105_000, n), 2)
+    discount = np.round(rng.uniform(0.0, 0.1, n), 2)
+    tax = np.round(rng.uniform(0.0, 0.08, n), 2)
+    shipdate = (_EPOCH_1992 + rng.integers(0, _DATE_RANGE, n)).astype(np.int32)
+    return {
+        "l_orderkey": rng.integers(1, n_orders + 1, n).astype(np.int64),
+        "l_partkey": rng.integers(1, int(PART_PER_SF * sf) + 2, n).astype(np.int64),
+        "l_suppkey": rng.integers(1, int(SUPPLIER_PER_SF * sf) + 2, n).astype(np.int64),
+        "l_quantity": quantity,
+        "l_extendedprice": extendedprice,
+        "l_discount": discount,
+        "l_tax": tax,
+        "l_returnflag": np.array(RETURN_FLAGS)[rng.integers(0, 3, n)],
+        "l_linestatus": np.array(LINE_STATUS)[rng.integers(0, 2, n)],
+        "l_shipdate": shipdate,
+        "l_commitdate": (shipdate + rng.integers(-30, 30, n)).astype(np.int32),
+        "l_receiptdate": (shipdate + rng.integers(1, 30, n)).astype(np.int32),
+        "l_shipmode": np.array(SHIP_MODES)[rng.integers(0, len(SHIP_MODES), n)],
+    }
+
+
+def gen_orders(sf: float, seed: int = 43) -> Dict[str, np.ndarray]:
+    n = int(ORDERS_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    return {
+        "o_orderkey": np.arange(1, n + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, int(CUSTOMER_PER_SF * sf) + 2, n).astype(np.int64),
+        "o_orderstatus": np.array(["F", "O", "P"])[rng.integers(0, 3, n)],
+        "o_totalprice": np.round(rng.uniform(850, 560_000, n), 2),
+        "o_orderdate": (_EPOCH_1992 + rng.integers(0, _DATE_RANGE - 151, n)
+                        ).astype(np.int32),
+        "o_orderpriority": np.array(PRIORITIES)[rng.integers(0, 5, n)],
+        "o_shippriority": np.zeros(n, dtype=np.int64),
+    }
+
+
+def gen_customer(sf: float, seed: int = 44) -> Dict[str, np.ndarray]:
+    n = int(CUSTOMER_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    return {
+        "c_custkey": np.arange(1, n + 1, dtype=np.int64),
+        "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n + 1)]),
+        "c_nationkey": rng.integers(0, 25, n).astype(np.int64),
+        "c_acctbal": np.round(rng.uniform(-999, 9999, n), 2),
+        "c_mktsegment": np.array(SEGMENTS)[rng.integers(0, 5, n)],
+    }
+
+
+def to_arrow(cols: Dict[str, np.ndarray]):
+    import pyarrow as pa
+    arrays = {}
+    for k, v in cols.items():
+        if v.dtype == np.int32 and (k.endswith("date")):
+            arrays[k] = pa.array(v, type=pa.date32())
+        else:
+            arrays[k] = pa.array(v)
+    return pa.table(arrays)
+
+
+def register_tables(session, sf: float):
+    """Create the TPC-H-like DataFrames (and temp views) on a session."""
+    tables = {
+        "lineitem": to_arrow(gen_lineitem(sf)),
+        "orders": to_arrow(gen_orders(sf)),
+        "customer": to_arrow(gen_customer(sf)),
+    }
+    dfs = {}
+    for name, tbl in tables.items():
+        df = session.createDataFrame(tbl)
+        df.createOrReplaceTempView(name)
+        dfs[name] = df
+    return dfs

@@ -1,0 +1,85 @@
+"""TPC-H-like query definitions on the DataFrame API.
+
+Analog of TpchLikeSpark.scala's query objects (reference
+integration_tests/.../tpch/). Each query takes the dict of DataFrames from
+datagen.register_tables and returns a DataFrame.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+
+# 1995-09-01 / 1994-01-01 / 1995-01-01 as days since epoch
+_D_1994_01_01 = 8766
+_D_1995_01_01 = 9131
+_D_1995_03_15 = 9204
+_D_1995_09_01 = 9374
+_D_1998_09_02 = 10471
+
+
+def q1(t):
+    """Pricing summary report: the scan -> filter -> wide aggregate."""
+    l = t["lineitem"]
+    disc_price = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    charge = disc_price * (lit(1.0) + col("l_tax"))
+    return (l.filter(col("l_shipdate") <= lit(_D_1998_09_02))
+            .groupBy("l_returnflag", "l_linestatus")
+            .agg(F.sum("l_quantity").alias("sum_qty"),
+                 F.sum("l_extendedprice").alias("sum_base_price"),
+                 F.sum(disc_price).alias("sum_disc_price"),
+                 F.sum(charge).alias("sum_charge"),
+                 F.avg("l_quantity").alias("avg_qty"),
+                 F.avg("l_extendedprice").alias("avg_price"),
+                 F.avg("l_discount").alias("avg_disc"),
+                 F.count("*").alias("count_order"))
+            .orderBy("l_returnflag", "l_linestatus"))
+
+
+def q3(t):
+    """Shipping priority: 3-way join + aggregate + top-N."""
+    c = t["customer"].filter(col("c_mktsegment") == lit("BUILDING"))
+    o = t["orders"].filter(col("o_orderdate") < lit(_D_1995_03_15))
+    l = t["lineitem"].filter(col("l_shipdate") > lit(_D_1995_03_15))
+    revenue = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    return (c.join(o, on=(col("c_custkey") == col("o_custkey")))
+            .join(l, on=(col("o_orderkey") == col("l_orderkey")))
+            .groupBy("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(F.sum(revenue).alias("revenue"))
+            .orderBy(col("revenue").desc(), col("o_orderdate").asc())
+            .limit(10))
+
+
+def q6(t):
+    """Forecasting revenue change: tight filter + global sum."""
+    l = t["lineitem"]
+    return (l.filter((col("l_shipdate") >= lit(_D_1994_01_01)) &
+                     (col("l_shipdate") < lit(_D_1995_01_01)) &
+                     (col("l_discount") >= lit(0.05)) &
+                     (col("l_discount") <= lit(0.07)) &
+                     (col("l_quantity") < lit(24)))
+            .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
+
+
+def q12(t):
+    """Shipmode priority: join + conditional aggregation."""
+    l = t["lineitem"].filter(
+        col("l_shipmode").isin("MAIL", "SHIP") &
+        (col("l_commitdate") < col("l_receiptdate")) &
+        (col("l_shipdate") < col("l_commitdate")) &
+        (col("l_receiptdate") >= lit(_D_1994_01_01)) &
+        (col("l_receiptdate") < lit(_D_1995_01_01)))
+    o = t["orders"]
+    high = F.when(col("o_orderpriority").isin("1-URGENT", "2-HIGH"), lit(1)) \
+        .otherwise(lit(0))
+    low = F.when(~col("o_orderpriority").isin("1-URGENT", "2-HIGH"), lit(1)) \
+        .otherwise(lit(0))
+    return (o.join(l, on=(col("o_orderkey") == col("l_orderkey")))
+            .groupBy("l_shipmode")
+            .agg(F.sum(high).alias("high_line_count"),
+                 F.sum(low).alias("low_line_count"))
+            .orderBy("l_shipmode"))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q12": q12}

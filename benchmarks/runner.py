@@ -1,0 +1,101 @@
+"""BenchmarkRunner: run TPC-H-like queries, write JSON reports.
+
+Analog of the reference's BenchmarkRunner / BenchUtils
+(integration_tests/.../BenchmarkRunner.scala, tests/common/BenchUtils.scala;
+docs/benchmarks.md): per-query iterations with cold/hot timings, collected row
+counts, plan summaries, optional CPU-engine result verification with epsilon
+(BenchUtils.compareResults epsilon=1e-4).
+
+Usage: python -m benchmarks.runner --sf 0.01 --queries q1,q6 --iterations 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict, List, Optional
+
+from . import datagen, queries as Q
+
+
+def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
+                  iterations: int = 2, verify: bool = False,
+                  output: Optional[str] = None) -> Dict:
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession.builder.config(
+        "spark.rapids.tpu.sql.explain", "NONE").getOrCreate()
+
+    t_gen0 = time.perf_counter()
+    tables = datagen.register_tables(session, sf)
+    gen_s = time.perf_counter() - t_gen0
+
+    report: Dict = {"sf": sf, "datagen_s": round(gen_s, 3), "queries": {}}
+    names = query_names or list(Q.QUERIES)
+    for name in names:
+        qfn = Q.QUERIES[name]
+        timings = []
+        rows = 0
+        for it in range(iterations):
+            t0 = time.perf_counter()
+            df = qfn(tables)
+            batch = df.collect_batch()
+            rows = batch.num_rows
+            timings.append(round(time.perf_counter() - t0, 4))
+        entry = {
+            "rows": rows,
+            "cold_s": timings[0],
+            "hot_s": min(timings[1:]) if len(timings) > 1 else timings[0],
+            "timings_s": timings,
+        }
+        if verify:
+            entry["verified"] = _verify(session, qfn(tables))
+        report["queries"][name] = entry
+    if output:
+        with open(output, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def _verify(session, df, epsilon: float = 1e-4) -> bool:
+    """CPU-engine compare (BenchUtils.compareResults analog)."""
+    import math
+    from spark_rapids_tpu.cpu.engine import execute as cpu_execute
+    cpu = cpu_execute(df._analyzed())
+    cpu_rows = sorted((tuple(r) for r in
+                       cpu.itertuples(index=False, name=None)), key=repr)
+    tpu_rows = sorted(df.collect(), key=repr)
+    if len(cpu_rows) != len(tpu_rows):
+        return False
+    for cr, tr in zip(cpu_rows, tpu_rows):
+        for cv, tv in zip(cr, tr):
+            if cv is None or tv is None:
+                if cv is not tv:
+                    return False
+                continue
+            if isinstance(cv, float) and isinstance(tv, float):
+                if math.isnan(cv) != math.isnan(tv):
+                    return False
+                if not math.isnan(cv) and \
+                        abs(cv - tv) > epsilon * max(abs(cv), abs(tv), 1.0):
+                    return False
+            elif cv != tv:
+                return False
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--queries", type=str, default=",".join(Q.QUERIES))
+    ap.add_argument("--iterations", type=int, default=2)
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--output", type=str, default=None)
+    args = ap.parse_args()
+    report = run_benchmark(args.sf, args.queries.split(","), args.iterations,
+                           args.verify, args.output)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

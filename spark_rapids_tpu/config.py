@@ -299,6 +299,12 @@ READER_TYPE = _conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
 ).string_conf.check(lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED")
                     ).create_with_default("COALESCING")
 
+MATMUL_AGG = _conf("spark.rapids.tpu.sql.agg.matmul.enabled").doc(
+    "MXU one-hot-matmul segment reductions for group-by sum/count/avg: "
+    "'auto' (accelerator only), 'true', or 'false'. Float sums differ from "
+    "sequential order at ~1e-5 rel — the variableFloatAgg trade "
+    "(ref: RapidsConf.scala variableFloatAgg)").string_conf.create_with_default("auto")
+
 READER_THREADS = _conf("spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads").doc(
     "Background decode threads for the MULTITHREADED reader "
     "(ref: RapidsConf.scala:548)").integer_conf.create_with_default(4)

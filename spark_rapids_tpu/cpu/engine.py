@@ -408,6 +408,10 @@ def _cast_value(v, src: dt.DType, dst: dt.DType):
         return int(v) * 1_000_000
     if dst.is_integral and src == dt.TIMESTAMP:
         return _wrap_int(int(v // 1_000_000), dst)
+    if dst == dt.DATE and src.is_integral:
+        return _wrap_int(int(v), dt.INT32)   # day-number reinterpret
+    if dst.is_integral and src == dt.DATE:
+        return _wrap_int(int(v), dst)
     raise NotImplementedError(f"cpu cast {src} -> {dst}")
 
 

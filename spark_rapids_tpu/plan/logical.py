@@ -422,6 +422,14 @@ def _coerce(e: ex.Expression) -> ex.Expression:
                 nl = Cast(l, target) if lt == dt.STRING else l
                 nr = Cast(r, target) if rt == dt.STRING else r
                 return node.with_children([nl, nr])
+            if (lt in (dt.DATE, dt.TIMESTAMP) and rt.is_integral) or \
+                    (rt in (dt.DATE, dt.TIMESTAMP) and lt.is_integral):
+                # int literal vs date/timestamp: reinterpret the int side
+                # (dates store int32 days, timestamps int64 micros)
+                target = lt if lt in (dt.DATE, dt.TIMESTAMP) else rt
+                nl = l if lt == target else Cast(l, target)
+                nr = r if rt == target else Cast(r, target)
+                return node.with_children([nl, nr])
             raise AnalysisError(f"cannot coerce {lt} vs {rt} in {node!r}")
         if isinstance(node, ar.Divide):
             l, r = node.children

@@ -35,6 +35,22 @@ from . import logical as lp
 Partition = Iterator[ColumnarBatch]
 
 
+def _matmul_agg_enabled() -> bool:
+    """MXU matmul segment reductions: 'auto' enables on accelerator backends
+    only — float agg results differ from sequential sums at ~1e-5 rel (the
+    reference's variableFloatAgg stance); golden-compare tests run on the
+    exact CPU path."""
+    from .. import config as cfg
+    mode = str(cfg.TpuConf().get_key(
+        "spark.rapids.tpu.sql.agg.matmul.enabled", "auto")).lower()
+    if mode in ("true", "1"):
+        return True
+    if mode in ("false", "0"):
+        return False
+    import jax
+    return jax.devices()[0].platform != "cpu"
+
+
 # ---------------------------------------------------------------------------
 # Reference binding (GpuBindReferences / GpuBoundAttribute.scala)
 # ---------------------------------------------------------------------------
@@ -420,9 +436,9 @@ class TpuHashAggregateExec(TpuExec):
                 n_groups = 1
                 out_keys: List[Column] = []
             else:
-                out_keys, aggs, ng = agg_k.groupby_aggregate(
-                    keys, specs, batch.num_rows, cap)
-                n_groups = int(ng)   # host sync at stage boundary
+                out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
+                    keys, specs, batch.num_rows, cap,
+                    allow_matmul=_matmul_agg_enabled())
 
         if self.mode == "partial":
             cols = out_keys + aggs
@@ -457,9 +473,9 @@ class TpuHashAggregateExec(TpuExec):
                 n_groups = 1
                 out_keys = []
             else:
-                out_keys, aggs, ng = agg_k.groupby_aggregate(
-                    keys, specs, batch.num_rows, cap)
-                n_groups = int(ng)
+                out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
+                    keys, specs, batch.num_rows, cap,
+                    allow_matmul=_matmul_agg_enabled())
         yield self._project_results(out_keys, aggs, n_groups)
 
     # -- result projection ---------------------------------------------------
