@@ -31,39 +31,31 @@ from ..columnar.column import Column
 from . import kernels as K
 
 
-def _encode_key_words(col: Column) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
-    """(words most-significant-first, row-is-usable) for one join key column.
-
-    Equality of the word vectors == SQL join-key equality (NaNs unified, nulls
-    excluded via the usable mask).
-    """
-    if col.dtype == dt.STRING:
-        packed = K.pack_string_words(col.data, col.lengths)
-        words = [packed[:, i] for i in range(packed.shape[1])]
-        words.append(col.lengths.astype(jnp.uint32))
-    else:
-        words = K.encode_orderable_words(col.data, col.dtype)
-        words = [w if w.dtype.kind == "u" else w for w in words]
-    return words, col.validity
+def _widen_string(col: Column, width: int) -> Column:
+    """Zero-pad a string column's byte matrix to ``width`` (order-preserving)."""
+    cur = col.data.shape[1]
+    if cur >= width:
+        return col
+    data = jnp.pad(col.data, ((0, 0), (0, width - cur)))
+    return Column(col.dtype, data, col.validity, col.lengths)
 
 
 def _normalize_words(cols: Sequence[Column]) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
-    """Stack all key columns' words into one most-significant-first list.
-    Invalid (NULL) rows are marked unusable."""
+    """Stack all key columns' sort-key words into one most-significant-first
+    list, plus the row-is-usable (all keys non-NULL) mask.
+
+    Uses EXACTLY the encoding ``sort_indices`` sorts by (``_key_arrays``:
+    null-rank word + value words), so the binary search's lexicographic order
+    matches the build side's sorted order — including NULL rows, which sort
+    first and carry zeroed data words. Word equality == SQL join-key equality
+    for usable rows: NaNs unified by the NaN-rank word, -0.0 == 0.0 by native
+    float compare, f64 compared at full precision.
+    """
     all_words: List[jnp.ndarray] = []
     usable = None
     for c in cols:
-        words, valid = _encode_key_words(c)
-        for w in words:
-            # floats produce float value-words; bitcast to sortable uint for
-            # equality/compare purposes via the total-order encoding
-            if w.dtype.kind == "f":
-                bits = jax.lax.bitcast_convert_type(
-                    w.astype(jnp.float32), jnp.uint32)
-                sign = bits >> 31
-                w = jnp.where(sign == 1, ~bits, bits | jnp.uint32(0x8000_0000))
-            all_words.append(w)
-        usable = valid if usable is None else (usable & valid)
+        all_words.extend(K._key_arrays(K.SortKey(c)))
+        usable = c.validity if usable is None else (usable & c.validity)
     return all_words, usable
 
 
@@ -123,6 +115,15 @@ def join_match(build_keys: Sequence[Column], n_build,
                stream_capacity: int) -> JoinMatch:
     """Phase 1: sort build side, find per-stream-row match ranges + counts."""
     build_cap = build_keys[0].capacity
+    # string key pairs must encode to the same number of words: widen both
+    # sides' byte matrices to the pair's max padded width (order-preserving)
+    build_keys = list(build_keys)
+    stream_keys = list(stream_keys)
+    for i, (b, s) in enumerate(zip(build_keys, stream_keys)):
+        if b.dtype == dt.STRING and s.dtype == dt.STRING:
+            width = max(b.data.shape[1], s.data.shape[1])
+            build_keys[i] = _widen_string(b, width)
+            stream_keys[i] = _widen_string(s, width)
     order = K.sort_indices([K.SortKey(c) for c in build_keys], n_build, build_cap)
     sorted_build = [K.gather_column(c, order) for c in build_keys]
     b_words, b_usable = _normalize_words(sorted_build)

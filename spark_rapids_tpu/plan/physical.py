@@ -717,19 +717,21 @@ class TpuSortMergeJoinExec(TpuExec):
         for p in build_parts:
             build_batches.extend(p)
         build = concat_batches(self.children[1].schema, build_batches)
-        return [self._join_part(p, build)
-                for p in self.children[0].execute()]
+        stream_parts = self.children[0].execute()
+        if self.how == "full":
+            # unmatched-build accounting happens inside one join pass, so full
+            # outer needs the ENTIRE stream side in a single partition — a
+            # per-partition pass would re-emit matched build rows as unmatched
+            all_batches = [b for p in stream_parts for b in p]
+            merged = concat_batches(self.children[0].schema, all_batches)
+            stream_parts = [iter([merged])]
+        return [self._join_part(p, build) for p in stream_parts]
 
     def _join_part(self, part: Partition, build: ColumnarBatch) -> Partition:
+        # full outer: execute() has already merged the whole stream side into
+        # this one partition as a single (possibly empty) batch
         bkey_cols = [ex.materialize(e.eval(build), build)
                      for e in self.right_keys]
-        if self.how == "full":
-            # full outer needs the whole stream side to know which build rows
-            # went unmatched -> single stream batch (the reference's window/
-            # sort RequireSingleBatch trade, CoalesceGoal lattice)
-            batches = list(part)
-            part = iter([concat_batches(self.children[0].schema, batches)] if
-                        batches else [])
         for batch in part:
             with self.metrics.timer("joinTime"):
                 skey_cols = [ex.materialize(e.eval(batch), batch)

@@ -192,3 +192,32 @@ def test_count_action():
     df = s.createDataFrame({"x": [1, 2, None, 4]})
     assert df.count() == 4
     assert df.filter(col("x").isNotNull()).count() == 3
+
+
+def test_full_outer_join_multi_partition_stream():
+    """Full outer with a repartitioned stream side: unmatched build rows must
+    be emitted exactly once globally, not once per stream partition."""
+    def q(s):
+        left = s.createDataFrame(
+            {"a": [1, 2, 3, 4], "v": [10, 20, 30, 40]}).repartition(2, col("a"))
+        right = s.createDataFrame({"b": [1, 9], "w": ["X", "Y"]})
+        return left.join(right, on=(col("a") == col("b")), how="full")
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_full_outer_join_empty_stream_side():
+    def q(s):
+        left = s.createDataFrame({"a": [1, 2], "v": [10, 20]}).filter(
+            col("a") > 100)
+        right = s.createDataFrame({"b": [2, 4], "w": ["X", "Y"]})
+        return left.join(right, on=(col("a") == col("b")), how="full")
+    assert_tpu_and_cpu_equal(q)
+
+
+def test_join_null_keys_in_build_side():
+    def q(s):
+        left = s.createDataFrame({"a": [0, -5, 7], "v": [10, 20, 30]})
+        right = s.createDataFrame(
+            {"b": [None, -5, 0, 3], "w": [100, 200, 300, 400]})
+        return left.join(right, on=(col("a") == col("b")), how="inner")
+    assert_tpu_and_cpu_equal(q)

@@ -151,3 +151,69 @@ def test_join_random_vs_pandas():
         exp = _rows(list(merged["k"]), list(merged["sv"]),
                     [None if pd.isna(x) else int(x) for x in merged["bv"]])
         assert got == exp
+
+
+def test_null_build_keys_all_types():
+    """NULL build keys must never match (they sort first with zeroed data
+    words — the search must rank them below every usable probe key)."""
+    bk = _col([None, -5, 0, 3], dt.INT64)
+    bv = _col([100, 200, 300, 400], dt.INT64)
+    sk = _col([0, -5], dt.INT64)
+    sv = _col([10, 20], dt.INT64)
+    s_out, b_out, m = _join([bk], [bv], 4, [sk], [sv], 2, "inner")
+    got = _rows(s_out[0], b_out[0])
+    assert got == _rows([10, 20], [300, 200])
+
+    # semi/anti against build side containing NULL keys
+    s_out, _, _ = _join([bk], [bv], 4, [sk], [sv], 2, "left_semi")
+    assert sorted(s_out[0]) == [10, 20]
+    sk2 = _col([7, -5, None], dt.INT64)
+    sv2 = _col([1, 2, 3], dt.INT64)
+    s_out, _, _ = _join([bk], [bv], 4, [sk2], [sv2], 3, "left_anti")
+    assert sorted(s_out[0]) == [1, 3]
+
+
+def test_null_build_keys_left_and_unmatched():
+    bk = _col([None, 2], dt.INT64)
+    bv = _col([111, 222], dt.INT64)
+    sk = _col([2, 9], dt.INT64)
+    sv = _col([10, 20], dt.INT64)
+    s_out, b_out, m = _join([bk], [bv], 2, [sk], [sv], 2, "left")
+    got = _rows(s_out[0], b_out[0])
+    assert got == _rows([10, 20], [222, None])
+    # full-outer composition: the NULL-key build row is unmatched
+    un_cols, ucnt = unmatched_build_gather(m, [bv], 2)
+    assert un_cols[0].to_pylist(int(ucnt)) == [111]
+
+
+def test_float64_keys_full_precision():
+    """f64 keys differing only beyond f32 precision must not join."""
+    a = 1.0
+    b = 1.0 + 2.0 ** -40          # == a when rounded to f32
+    bk = _col([a, b], dt.FLOAT64)
+    bv = _col([1, 2], dt.INT64)
+    sk = _col([a], dt.FLOAT64)
+    sv = _col([10], dt.INT64)
+    s_out, b_out, _ = _join([bk], [bv], 2, [sk], [sv], 1, "inner")
+    assert b_out[0] == [1]
+
+
+def test_negative_zero_joins_positive_zero():
+    bk = _col([-0.0, 5.0], dt.FLOAT64)
+    bv = _col([1, 2], dt.INT64)
+    sk = _col([0.0], dt.FLOAT64)
+    sv = _col([10], dt.INT64)
+    s_out, b_out, _ = _join([bk], [bv], 2, [sk], [sv], 1, "inner")
+    assert b_out[0] == [1]
+
+
+def test_string_keys_different_widths():
+    """Build/stream string key columns with different padded byte widths."""
+    bk = _col(["apple", "fig"], dt.STRING)
+    bv = _col([1, 2], dt.INT64)
+    sk = _col(["a-much-longer-string-key-here", "apple", "fig"], dt.STRING)
+    sv = _col([10, 20, 30], dt.INT64)
+    assert bk.data.shape[1] != sk.data.shape[1]
+    s_out, b_out, _ = _join([bk], [bv], 2, [sk], [sv], 3, "inner")
+    got = _rows(s_out[0], b_out[0])
+    assert got == _rows([20, 30], [1, 2])
