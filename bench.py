@@ -1,26 +1,39 @@
 """Benchmark: fused columnar SQL pipeline throughput on the TPU chip.
 
-Measures the flagship whole-stage pipeline (filter -> project -> sort-based
-group-by aggregate, DESIGN.md §2) on device over a ~8M-row batch — the
-scan+filter+project+agg hot path of SURVEY.md §3.3 (BASELINE.md milestone
-config 1/2). The same pipeline runs on pandas host CPU as the baseline, so
+Measures the flagship whole-stage pipeline — filter -> project -> group-by
+aggregate (sum/count/avg) — over a 64M-row batch, the scan+filter+project+agg
+hot path of SURVEY.md §3.3 (BASELINE.md milestone config 1/2). The group-by
+rides the dense-range MXU path (ops/aggregates.py groupby_dense): no sort, no
+compaction — elementwise passes plus chunked one-hot matmuls on the systolic
+array. The key range (the static slot count) comes from input statistics, the
+same information a parquet scan gets for free from row-group min/max stats.
+
+The identical query runs on single-core pandas as the baseline, so
 ``vs_baseline`` is the TPU speedup over single-core pandas (the reference
 repo publishes no numeric GPU baselines — BASELINE.md: "chart image only").
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Methodology: iterations are dispatched back-to-back and ALL results are
+forced at the end (inputs varied per iteration to defeat any caching), i.e.
+steady-state throughput with the device pipeline kept full — the execution
+cadence of a scan feeding consecutive batches. A per-iteration host sync
+would instead measure the tunnel's fixed round-trip latency.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 import json
-import sys
 import time
 
 import numpy as np
+
+K_SLOTS = 2048          # static slot bucket for 1024 distinct keys (+null)
+N_KEYS = 1024
 
 
 def build_inputs(n_rows: int, cap: int):
     rng = np.random.default_rng(42)
     keys = np.zeros(cap, dtype=np.int64)
-    keys[:n_rows] = rng.integers(0, 1024, n_rows)
+    keys[:n_rows] = rng.integers(0, N_KEYS, n_rows)
     key_valid = np.zeros(cap, dtype=bool)
     key_valid[:n_rows] = True
     vals = np.zeros(cap, dtype=np.float64)
@@ -32,70 +45,51 @@ def build_inputs(n_rows: int, cap: int):
     return keys, key_valid, vals, val_valid, flags
 
 
-def bench_tpu(n_rows: int, cap: int, iters: int = 10) -> float:
-    """Two-phase fused pipeline, the TpuHashAggregateExec shape:
-    jit1: filter -> project -> sort -> segment structure (+ group count sync)
-    jit2 (static K): MXU one-hot-matmul reductions + key gather.
-    """
+def bench_tpu(n_rows: int, cap: int, iters: int = 8):
+    """One fused jit per iteration: filter -> project -> dense MXU group-by.
+    Returns (rows_per_s, sample result arrays for validation)."""
     import jax
     import jax.numpy as jnp
     from spark_rapids_tpu.columnar import dtypes as dt
-    from spark_rapids_tpu.columnar.column import Column, bucket
-    from spark_rapids_tpu.ops import kernels as K
+    from spark_rapids_tpu.columnar.column import Column
     from spark_rapids_tpu.ops import aggregates as agg_k
 
     keys, key_valid, vals, val_valid, flags = build_inputs(n_rows, cap)
 
-    def phase1(keys, key_valid, vals, val_valid, flags, num_rows):
+    def fused(keys, key_valid, vals, val_valid, flags, num_rows):
         live = jnp.arange(cap) < num_rows
         keep = live & flags & val_valid & (vals > 0)
-        cols = [Column(dt.INT64, keys, key_valid),
-                Column(dt.FLOAT64, vals, val_valid)]
-        (kcol, vcol), count = K.compact_columns(cols, keep)
-        proj = Column(dt.FLOAT64, vcol.data * 2.0 + 1.0, vcol.validity)
-        order = K.sort_indices([K.SortKey(kcol)], count, cap)
-        sk = K.gather_column(kcol, order)
-        sv = K.gather_column(proj, order)
-        live2 = jnp.arange(cap) < count
-        starts = K.segment_starts_from_sorted_keys([sk], count, cap)
-        seg_ids = K.segment_ids(starts)
-        start_perm, _ = K.compaction_indices(starts)
-        n_groups = jnp.sum(starts).astype(jnp.int32)
-        return (sk.data, sk.validity, sv.data, sv.validity, seg_ids,
-                start_perm, live2, n_groups)
+        kcol = Column(dt.INT64, keys, key_valid)
+        proj = Column(dt.FLOAT64, vals * 2.0 + 1.0, val_valid)
+        rmin = jnp.min(jnp.where(keep & key_valid, keys,
+                                 jnp.iinfo(jnp.int64).max))
+        rmin = jnp.where(jnp.any(keep & key_valid), rmin, 0)
+        out_keys, out_aggs, n_groups = agg_k.groupby_dense(
+            kcol, [agg_k.AggSpec("sum", proj),
+                   agg_k.AggSpec("count", proj),
+                   agg_k.AggSpec("avg", proj)],
+            num_rows, K_SLOTS, rmin, extra_mask=keep)
+        return (out_keys[0].data, out_keys[0].validity,
+                out_aggs[0].data, out_aggs[1].data, out_aggs[2].data,
+                n_groups)
 
-    def phase2(Kb, skd, skv, svd, svv, seg_ids, start_perm, live2):
-        vcol = Column(dt.FLOAT64, svd, svv)
-        s = agg_k.segment_aggregate_matmul(
-            agg_k.AggSpec("sum", vcol), seg_ids, live2, Kb)
-        c = agg_k.segment_aggregate_matmul(
-            agg_k.AggSpec("count", vcol), seg_ids, live2, Kb)
-        a = agg_k.segment_aggregate_matmul(
-            agg_k.AggSpec("avg", vcol), seg_ids, live2, Kb)
-        gkeys = skd[start_perm[:Kb]]
-        return gkeys, s.data, c.data, a.data
-
-    f1 = jax.jit(phase1)
-    f2 = jax.jit(phase2, static_argnums=0)
+    f = jax.jit(fused)
     args = (jnp.asarray(keys), jnp.asarray(key_valid), jnp.asarray(vals),
-            jnp.asarray(val_valid), jnp.asarray(flags), jnp.int32(n_rows))
+            jnp.asarray(val_valid), jnp.asarray(flags))
+    jax.block_until_ready(args)
 
-    def run_once():
-        out1 = f1(*args)
-        ng = int(out1[-1])              # host sync (the n_groups read the
-        Kb = bucket(max(ng, 1))         # exec performs at every agg boundary)
-        out2 = f2(Kb, *out1[:-1])
-        return int(np.asarray(out2[2][0])), ng
+    warm = f(*args, jnp.int32(n_rows))
+    sample = [np.asarray(x) for x in warm]        # forces compile + run
 
-    run_once()  # compile + warm both phases
     t0 = time.perf_counter()
-    for _ in range(iters):
-        run_once()
+    outs = [f(*args, jnp.int32(n_rows - i)) for i in range(iters)]
+    for o in outs:                                 # force EVERY iteration
+        np.asarray(o[3])
     dt_s = (time.perf_counter() - t0) / iters
-    return n_rows / dt_s
+    return n_rows / dt_s, sample
 
 
-def bench_pandas(n_rows: int, cap: int, iters: int = 3) -> float:
+def bench_pandas(n_rows: int, cap: int, iters: int = 2):
     import pandas as pd
     keys, key_valid, vals, val_valid, flags = build_inputs(n_rows, cap)
     df = pd.DataFrame({
@@ -106,27 +100,58 @@ def bench_pandas(n_rows: int, cap: int, iters: int = 3) -> float:
     for _ in range(iters):
         sub = df[df["flag"] & (df["v"] > 0)]
         proj = sub.assign(p=sub["v"] * 2.0 + 1.0)
-        _ = proj.groupby("k")["p"].agg(["sum", "count", "max"])
+        res = proj.groupby("k")["p"].agg(["sum", "count", "mean"])
     dt_s = (time.perf_counter() - t0) / iters
-    return n_rows / dt_s
+    return n_rows / dt_s, res
+
+
+def validate(sample, pd_res):
+    """The two engines must agree on the sample run (counts exact, sums/avgs
+    to float-agg tolerance, same group set) — a bench that drifts from the
+    oracle is void."""
+    gk, gkv, gsum, gcnt, gavg, ng = sample
+    ng = int(ng)
+    got = {int(k): (s, int(c), a)
+           for k, kv, s, c, a in zip(gk[:ng], gkv[:ng], gsum[:ng],
+                                     gcnt[:ng], gavg[:ng]) if kv}
+    assert ng == len(got) == len(pd_res), (ng, len(got), len(pd_res))
+    for k, row in pd_res.iterrows():
+        s, c, a = got[int(k)]
+        assert c == int(row["count"]), (k, c, row["count"])
+        assert abs(s - row["sum"]) <= 1e-6 * max(1.0, abs(row["sum"])), \
+            (k, s, row["sum"])
+        assert abs(a - row["mean"]) <= 1e-6 * max(1.0, abs(row["mean"])), \
+            (k, a, row["mean"])
+    return len(got)
 
 
 def main():
-    n_rows = 8_000_000
-    cap = 1 << 23
     import jax
     platform = jax.devices()[0].platform
     if platform == "cpu":
         # smaller size when benching without an accelerator (CI sanity)
-        n_rows = 1_000_000
-        cap = 1 << 20
-    tpu_rows_per_s = bench_tpu(n_rows, cap)
-    cpu_rows_per_s = bench_pandas(n_rows, cap)
+        n_rows, cap = 1_000_000, 1 << 20
+    else:
+        n_rows, cap = 64_000_000, 1 << 26
+
+    tpu_rows_per_s, sample = bench_tpu(n_rows, cap)
+    cpu_rows_per_s, pd_res = bench_pandas(n_rows, cap)
+    n_groups = validate(sample, pd_res)
+
+    bytes_per_row = 8 + 1 + 8 + 1 + 1            # key, kvalid, val, vvalid, flag
+    gbytes_per_s = tpu_rows_per_s * bytes_per_row / 1e9
+    # one-hot matmul flops: rows x slots x 2 (mul+add) x 3 features + count
+    tflops = tpu_rows_per_s * K_SLOTS * 2 * 4 / 1e12
     print(json.dumps({
         "metric": "fused filter+project+groupby throughput",
         "value": round(tpu_rows_per_s / 1e6, 2),
         "unit": "Mrows/s",
         "vs_baseline": round(tpu_rows_per_s / cpu_rows_per_s, 2),
+        "rows": n_rows,
+        "groups": n_groups,
+        "input_gb_per_s": round(gbytes_per_s, 2),
+        "matmul_tflops": round(tflops, 2),
+        "baseline_mrows_per_s": round(cpu_rows_per_s / 1e6, 2),
     }))
 
 

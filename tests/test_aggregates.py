@@ -149,3 +149,145 @@ def test_groupby_large_random_vs_pandas():
     assert aggs[1] == list(expected["count"])
     np.testing.assert_allclose(aggs[2], expected["min"])
     np.testing.assert_allclose(aggs[3], expected["max"])
+
+
+# ---------------------------------------------------------------------------
+# Dense-range MXU group-by
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu.ops.aggregates import (  # noqa: E402
+    dense_key_stats, groupby_aggregate_fast, groupby_dense)
+
+
+def _run_dense(key, specs, n, extra_mask=None):
+    rmin, decision = dense_key_stats(key, n, extra_mask)
+    span = int(np.asarray(decision)[0])
+    from spark_rapids_tpu.columnar.column import bucket
+    Kb = bucket(span + 2, 128)
+    out_keys, out_aggs, ng = groupby_dense(key, specs, n, Kb, rmin,
+                                           extra_mask=extra_mask)
+    g = int(ng)
+    return ([k.to_pylist(g) for k in out_keys],
+            [a.to_pylist(g) for a in out_aggs])
+
+
+def test_dense_groupby_matches_sort_path():
+    rng = np.random.default_rng(11)
+    n = 500
+    kv = [None if rng.random() < 0.08 else int(x)
+          for x in rng.integers(-40, 40, n)]
+    vv = [None if rng.random() < 0.1 else float(x)
+          for x in rng.normal(0, 10, n)]
+    k = _col(kv, dt.INT64)
+    v = _col(vv, dt.FLOAT64)
+    iv = _col([None if x is None else int(x * 7) for x in kv], dt.INT64)
+    specs = [AggSpec("sum", v), AggSpec("count", v), AggSpec("avg", v),
+             AggSpec("min", v), AggSpec("max", v), AggSpec("count_star", None),
+             AggSpec("sum", iv), AggSpec("first", v), AggSpec("last", v)]
+    dk, da = _run_dense(k, specs, n)
+    sk, sa = _run_groupby([k], specs, n)
+    # dense output: keys ascending with NULL group LAST; sort path: NULL first
+    if sk[0] and sk[0][0] is None:
+        sk = [col[1:] + col[:1] for col in sk]
+        sa = [col[1:] + col[:1] for col in sa]
+    assert dk[0] == sk[0]
+    for i, (got, exp) in enumerate(zip(da, sa)):
+        for a, b in zip(got, exp):
+            if isinstance(a, float) and isinstance(b, float):
+                # float sums ride f32 hi/lo + f64 chunk accumulation:
+                # ~1e-6 abs per-chunk rounding (reference epsilon is 1e-4)
+                assert a == pytest.approx(b, rel=2e-6, abs=2e-6), (i, a, b)
+            else:
+                assert a == b, (i, specs[i].op, got, exp)
+
+
+def test_dense_int64_sum_bit_exact():
+    big = 3_000_000_000_000_000_000
+    k = _col([5, 5, 6, 6], dt.INT64)
+    v = _col([big, big, -big, 17], dt.INT64)
+    keys, aggs = _run_dense(k, [AggSpec("sum", v)], 4)
+    assert keys[0] == [5, 6]
+    # 2*big overflows int64 and must wrap exactly like Spark bigint
+    import numpy as _np
+    exp0 = int(_np.int64(_np.uint64(big * 2 % (1 << 64))))
+    assert aggs[0] == [exp0, -big + 17]
+
+
+def test_dense_negative_keys_and_null_group():
+    k = _col([-3, -1, None, -3], dt.INT32)
+    v = _col([1.0, 2.0, 3.0, 4.0], dt.FLOAT64)
+    keys, aggs = _run_dense(k, [AggSpec("sum", v)], 4)
+    assert keys[0] == [-3, -1, None]
+    assert aggs[0] == [5.0, 2.0, 3.0]
+
+
+def test_dense_extra_mask_filter_fold():
+    k = _col([1, 2, 1, 2], dt.INT64)
+    v = _col([10.0, 20.0, 30.0, 40.0], dt.FLOAT64)
+    import jax.numpy as jnp
+    mask = jnp.asarray([True, False, True, False] + [False] * (k.capacity - 4))
+    keys, aggs = _run_dense(k, [AggSpec("sum", v)], 4, extra_mask=mask)
+    assert keys[0] == [1]
+    assert aggs[0] == [40.0]
+
+
+def test_dense_all_null_keys():
+    k = _col([None, None], dt.INT64)
+    v = _col([1.0, 2.0], dt.FLOAT64)
+    keys, aggs = _run_dense(k, [AggSpec("sum", v)], 2)
+    assert keys[0] == [None]
+    assert aggs[0] == [3.0]
+
+
+def test_dense_empty_input():
+    k = _col([], dt.INT64)
+    v = _col([], dt.FLOAT64)
+    keys, aggs = _run_dense(k, [AggSpec("sum", v)], 0)
+    assert keys[0] == []
+    assert aggs[0] == []
+
+
+def test_groupby_fast_dispatches_dense_and_matches():
+    """groupby_aggregate_fast with a dense int key must agree with the
+    explicitly non-matmul sort path on random data."""
+    rng = np.random.default_rng(23)
+    n = 800
+    kv = [None if rng.random() < 0.05 else int(x)
+          for x in rng.integers(0, 200, n)]
+    vv = [None if rng.random() < 0.1 else float(x)
+          for x in rng.normal(0, 100, n)]
+    k = _col(kv, dt.INT64)
+    v = _col(vv, dt.FLOAT64)
+    specs = [AggSpec("sum", v), AggSpec("avg", v), AggSpec("count", v),
+             AggSpec("min", v), AggSpec("max", v)]
+    cap = k.capacity
+    fk, fa, fn = groupby_aggregate_fast([k], specs, n, cap, allow_matmul=True)
+    gk, ga, gn = groupby_aggregate_fast([k], specs, n, cap, allow_matmul=False)
+    assert fn == gn
+    fkeys = fk[0].to_pylist(fn)
+    gkeys = gk[0].to_pylist(gn)
+    fmap = {kk: tuple(a.to_pylist(fn)[i] for a in fa)
+            for i, kk in enumerate(fkeys)}
+    gmap = {kk: tuple(a.to_pylist(gn)[i] for a in ga)
+            for i, kk in enumerate(gkeys)}
+    assert set(fmap) == set(gmap)
+    for kk in fmap:
+        for a, b in zip(fmap[kk], gmap[kk]):
+            if isinstance(a, float) and isinstance(b, float):
+                assert a == pytest.approx(b, rel=2e-6, abs=2e-6)
+            else:
+                assert a == b
+
+
+def test_dense_dispatch_falls_back_on_f32_unsafe_floats():
+    """Values beyond the f32-safe range (or inf) must not ride the hi/lo
+    matmul split; the dispatch falls back to the exact f64 sort path."""
+    k = _col([1, 1, 2, 2], dt.INT64)
+    v = _col([1e40, 3.0, float("inf"), 5.0], dt.FLOAT64)
+    fk, fa, fn = groupby_aggregate_fast([k], [AggSpec("sum", v)],
+                                        4, k.capacity, allow_matmul=True)
+    keys = fk[0].to_pylist(fn)
+    sums = fa[0].to_pylist(fn)
+    got = dict(zip(keys, sums))
+    assert got[1] == 1e40 + 3.0
+    assert got[2] == float("inf")
