@@ -500,12 +500,19 @@ def groupby_dense(key_col: Column, specs: Sequence[AggSpec], num_rows,
                                c.data, m))
             plans.append(("int_sum", ni, ci))
         elif op in ("sum", "avg"):
+            # NaN contributions are excluded from the matmul features (0*NaN
+            # would poison every slot in the chunk) and re-introduced per
+            # slot via a NaN-count feature: any NaN in a group -> NaN result
             def hilo(c=col, m=contrib):
                 d = c.data.astype(jnp.float64)
-                hi = d.astype(jnp.float32)
-                lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+                nan = jnp.isnan(d)
+                hi = jnp.where(nan, 0.0, d).astype(jnp.float32)
+                lo = (jnp.where(nan, 0.0, d)
+                      - hi.astype(jnp.float64)).astype(jnp.float32)
                 z = jnp.float32(0)
-                return [jnp.where(m, hi, z), jnp.where(m, lo, z)]
+                mnn = m & ~nan
+                return [jnp.where(mnn, hi, z), jnp.where(mnn, lo, z),
+                        (m & nan).astype(jnp.float32)]
             hl = add_feats(("hilo", cid), hilo)
             plans.append((op, hl, ci))
         else:
@@ -534,6 +541,7 @@ def groupby_dense(key_col: Column, specs: Sequence[AggSpec], num_rows,
         else:                                     # sum / avg on floats
             hl, ci = plan[1], plan[2]
             s = acc[:, hl] + acc[:, hl + 1]
+            s = jnp.where(acc[:, hl + 2] > 0, jnp.nan, s)   # NaN contribs
             cnt = acc[:, ci]
             has = cnt > 0
             if kind == "sum":
@@ -689,12 +697,18 @@ def _dense_spec_supported(spec: AggSpec) -> bool:
 
 def groupby_aggregate_fast(key_cols: Sequence[Column], specs: Sequence[AggSpec],
                            num_rows: int, capacity: int,
-                           allow_matmul: bool = True
+                           allow_matmul: bool = True,
+                           dense_state: Optional[dict] = None
                            ) -> Tuple[List[Column], List[Column], int]:
     """Eager (host-driven) group-by: dispatches the dense-range MXU path when
     a single integral key spans a small range (one cheap stats sync), else
     sorts, syncs the group count, and uses MXU matmul reductions when the
     group-count bucket is small enough; otherwise the traced sort path.
+
+    ``dense_state`` is an optional caller-held memo dict: once a batch's key
+    span disqualifies the dense path, ``dense_state["enabled"]`` flips False
+    so later batches of the same operator skip the stats pass entirely
+    (key domains are stable across a stream; the flag never flips back).
 
     Returns host-int group count (callers outside jit). The host sync here is
     the same one TpuHashAggregateExec already performs on n_groups.
@@ -706,6 +720,7 @@ def groupby_aggregate_fast(key_cols: Sequence[Column], specs: Sequence[AggSpec],
                   and s.column.dtype.is_floating]
     f32_safe = None        # unknown until a stats sync measures the values
     if (allow_matmul and len(key_cols) == 1
+            and (dense_state is None or dense_state.get("enabled", True))
             and dense_supported_key(key_cols[0])
             and all(_dense_spec_supported(s) for s in specs)):
         rmin_d, decision = dense_key_stats(key_cols[0], num_rows,
@@ -718,6 +733,8 @@ def groupby_aggregate_fast(key_cols: Sequence[Column], specs: Sequence[AggSpec],
             out_keys, out_aggs, ngd = groupby_dense(
                 key_cols[0], specs, num_rows, Kb, rmin_d)
             return out_keys, out_aggs, int(ngd)
+        if span + 2 > DENSE_MAX_SLOTS and dense_state is not None:
+            dense_state["enabled"] = False
 
     sort_keys = [K.SortKey(c) for c in key_cols]
     order = K.sort_indices(sort_keys, num_rows, capacity)

@@ -261,7 +261,30 @@ class Overrides:
         self.last_explain = meta.explain(all_ops=(mode == "ALL"))
         if mode != "NONE" and self.last_explain:
             print(self.last_explain)
-        return self._convert(meta)
+        return self._insert_coalesce(self._convert(meta))
+
+    def _insert_coalesce(self, node: ph.TpuExec) -> ph.TpuExec:
+        """Transition pass: insert TpuCoalesceBatchesExec per the op's
+        children coalesce goals (GpuTransitionOverrides.scala:118-244)."""
+        for i, child in enumerate(node.children):
+            child = self._insert_coalesce(child)
+            goal = node.children_coalesce_goal(i)
+            if goal is not None and not isinstance(
+                    child, ph.TpuCoalesceBatchesExec):
+                # size from the CHILD's schema: those are the rows being
+                # concatenated toward batchSizeBytes
+                child = ph.TpuCoalesceBatchesExec(
+                    child, goal=goal,
+                    target_rows=self._target_batch_rows(child.schema))
+            node.children[i] = child
+        return node
+
+    def _target_batch_rows(self, schema) -> int:
+        """Rows per batch approximating the configured batchSizeBytes."""
+        row_bytes = 0
+        for f in schema:
+            row_bytes += (f.dtype.byte_width or 32) + 1
+        return max(1 << 14, self.conf.batch_size_bytes // max(row_bytes, 1))
 
     def _convert(self, meta: PlanMeta) -> ph.TpuExec:
         p = meta.plan

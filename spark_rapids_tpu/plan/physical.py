@@ -110,6 +110,14 @@ class TpuExec:
     def name(self) -> str:
         return type(self).__name__
 
+    def children_coalesce_goal(self, i: int):
+        """Per-child batch goal (CoalesceGoal lattice,
+        GpuCoalesceBatches.scala:117-130): None (no requirement), "target"
+        (concat small batches toward the configured batch size), or "single"
+        (RequireSingleBatch: the op needs the whole partition in one batch).
+        The transition pass inserts TpuCoalesceBatchesExec accordingly."""
+        return None
+
     def execute(self) -> List[Partition]:
         raise NotImplementedError
 
@@ -131,6 +139,49 @@ class TpuExec:
 
     def __repr__(self):
         return self._tree_string()
+
+
+def _task_begin() -> None:
+    """Device admission at task (partition evaluation) start: the semaphore
+    bounds concurrently-executing device tasks. Ordering contract preserved
+    from the reference (GpuSemaphore.scala:74-78): acquire after host-side
+    input is ready, before device work."""
+    from ..exec.device import TpuSemaphore
+    TpuSemaphore.get().acquire_if_necessary()
+
+
+def _reserve(nbytes: int) -> None:
+    """Admission-check ~nbytes of imminent device materialization against the
+    spill catalog (DeviceMemoryEventHandler.onAllocFailure analog): spills
+    lower-priority buffers until the allocation fits the budget."""
+    from ..exec.spill import BufferCatalog
+    BufferCatalog.get().reserve(nbytes)
+
+
+def accumulate_spillable(parts) -> List["SpillableColumnarBatch"]:
+    """Drain partitions into spillable handles: accumulated build/sort inputs
+    must not pin HBM while more batches stream in (SpillableColumnarBatch
+    treatment of build sides, GpuShuffledHashJoinExec / GpuSortExec)."""
+    from ..exec.spill import SpillableColumnarBatch
+    out: List[SpillableColumnarBatch] = []
+    for p in parts:
+        for b in p:
+            if b.num_rows > 0:
+                out.append(SpillableColumnarBatch(b))
+    return out
+
+
+def concat_spillable(schema: dt.Schema,
+                     spillables: List["SpillableColumnarBatch"]
+                     ) -> ColumnarBatch:
+    """Materialize accumulated spillables and concatenate, reserving device
+    room for inputs + output first."""
+    total = sum(s.size_bytes for s in spillables)
+    _reserve(2 * total)
+    batches = [s.get_batch() for s in spillables]
+    for s in spillables:
+        s.close()
+    return concat_batches(schema, batches)
 
 
 def concat_batches(schema: dt.Schema, batches: List[ColumnarBatch],
@@ -181,9 +232,16 @@ class TpuLocalScanExec(TpuExec):
 
     def _part_iter(self, lo: int, hi: int) -> Partition:
         pos = lo
+        first = True
         while pos < hi:
             end = min(pos + self.batch_rows, hi)
             chunk = self.table.slice(pos, end - pos)
+            if first:
+                # semaphore ordering contract: acquire after host-side input
+                # is ready, before the device upload (GpuSemaphore.scala:74)
+                _task_begin()
+                first = False
+            _reserve(chunk.nbytes * 2)
             batch = ColumnarBatch.from_arrow(chunk)
             self.metrics.inc("numOutputRows", batch.num_rows)
             self.metrics.inc("numOutputBatches")
@@ -352,26 +410,39 @@ class TpuHashAggregateExec(TpuExec):
         self.mode = mode
         self.grouping_src = grouping
         self.aggregate_exprs = aggregate_exprs
-        self.grouping = [bind_refs(e, child.schema) for e in grouping]
+        self._dense_state = {}   # dense-dispatch memo shared across batches
         # collect aggregate leaves across output expressions
         self.leaves: List[lp.AggregateExpression] = []
         for e in aggregate_exprs:
             self.leaves.extend(
                 e.collect(lambda x: isinstance(x, lp.AggregateExpression)))
-        self.bound_leaf_inputs = [
-            bind_refs(l.children[0], child.schema) if l.children else None
-            for l in self.leaves]
+        if mode == "final":
+            # the child emits the internal partial schema: keys then update
+            # cols, positionally — original names do not exist downstream
+            self.grouping = [ex.BoundReference(i, g.dtype, True)
+                             for i, g in enumerate(grouping)]
+            self.bound_leaf_inputs = [None] * len(self.leaves)
+        else:
+            self.grouping = [bind_refs(e, child.schema) for e in grouping]
+            self.bound_leaf_inputs = [
+                bind_refs(l.children[0], child.schema) if l.children else None
+                for l in self.leaves]
         self._out_schema = dt.Schema([
             dt.Field(ex.output_name(e, i), e.dtype, e.nullable)
             for i, e in enumerate(aggregate_exprs)])
-        # internal schema for partial output: key cols + per-leaf update cols
         if mode == "partial":
-            fields = [dt.Field(f"_k{i}", g.dtype, True)
-                      for i, g in enumerate(grouping)]
-            for i, l in enumerate(self.leaves):
-                for j, (op, t) in enumerate(self._update_cols(l)):
-                    fields.append(dt.Field(f"_a{i}_{j}", t, True))
-            self._out_schema = dt.Schema(fields)
+            self._out_schema = self._partial_schema()
+
+    def _partial_schema(self) -> dt.Schema:
+        """Internal partial-form schema: key cols + per-leaf update cols
+        (identical construction in the upstream partial and downstream final
+        execs, so the exchange carries a consistent internal schema)."""
+        fields = [dt.Field(f"_k{i}", g.dtype, True)
+                  for i, g in enumerate(self.grouping_src)]
+        for i, l in enumerate(self.leaves):
+            for j, (op, t) in enumerate(self._update_cols(l)):
+                fields.append(dt.Field(f"_a{i}_{j}", t, True))
+        return dt.Schema(fields)
 
     def _update_cols(self, leaf: lp.AggregateExpression):
         """(op, dtype) pairs of the update-phase outputs for one aggregate
@@ -387,66 +458,93 @@ class TpuHashAggregateExec(TpuExec):
     def schema(self):
         return self._out_schema
 
+    def children_coalesce_goal(self, i: int):
+        # stream per batch, but small scan batches waste per-batch dispatch:
+        # coalesce toward the target batch size (the reference's TargetSize)
+        return "target"
+
     def execute(self) -> List[Partition]:
         parts = self.children[0].execute()
         if self.mode == "partial":
             # update-only aggregation is per-partition (upstream of the
             # hash exchange, like the reference's partial mode)
-            return [self._agg_partition(p) for p in parts]
-        # complete/final must see every row of a group: merge all input
-        # partitions to one batch (RequireSingleBatch, aggregate.scala final)
-        def merged():
-            batches: List[ColumnarBatch] = []
+            return [self._stream_merge(p, project=False) for p in parts]
+        # complete/final must see every row of a group: all partitions feed
+        # ONE streaming update+merge loop (aggregate.scala:427-485) whose
+        # state is one spillable partial batch — never a concat of the input
+        def stream():
             for p in parts:
-                batches.extend(p)
-            yield concat_batches(self.children[0].schema, batches)
-        return [self._agg_partition(merged())]
+                yield from p
+        return [self._stream_merge(stream(), project=(self.mode != "partial"))]
 
-    def _agg_partition(self, part: Partition) -> Partition:
-        batches = list(part)
-        batch = concat_batches(self.children[0].schema, batches)
-        if self.mode == "final":
-            yield from self._final(batch)
-            return
-        yield from self._update(batch)
+    # -- streaming update + merge loop ---------------------------------------
+    def _stream_merge(self, batches, project: bool) -> Partition:
+        """Per-batch update-agg, concat with the running partial, merge-agg
+        (the reference's hot loop, aggregate.scala:427-485). The running
+        partial lives in the spill catalog between batches, so aggregation
+        state never exceeds one partial batch + one input batch of HBM."""
+        from ..exec.spill import SpillableColumnarBatch
+        pschema = self._partial_schema()
+        running = None
+        for batch in batches:
+            # semaphore ordering contract: acquire only once the first input
+            # batch exists (upstream host IO done), GpuSemaphore.scala:74-78
+            _task_begin()
+            _reserve(batch.device_size_bytes())
+            with self.metrics.timer("computeAggTime"):
+                pb = batch if self.mode == "final" else \
+                    self._update_partial_batch(batch)
+                if running is None:
+                    running = SpillableColumnarBatch(pb)
+                    continue
+                prev = running.get_batch()
+                running.close()
+                _reserve(prev.device_size_bytes() + pb.device_size_bytes())
+                merged_in = concat_batches(pschema, [prev, pb])
+                running = SpillableColumnarBatch(
+                    self._merge_to_partial(merged_in))
+        if running is None:
+            final_in = ColumnarBatch.empty(pschema)
+        else:
+            final_in = running.get_batch()
+            running.close()
+        if project:
+            yield from self._final(final_in)
+        else:
+            self.metrics.inc("numOutputRows", final_in.num_rows)
+            yield final_in
 
-    # -- update / complete ---------------------------------------------------
-    def _update(self, batch: ColumnarBatch) -> Partition:
-        with self.metrics.timer("computeAggTime"):
-            cap = batch.capacity
-            keys = [ex.materialize(g.eval(batch), batch) for g in self.grouping]
-            specs: List[agg_k.AggSpec] = []
-            for leaf, bound in zip(self.leaves, self.bound_leaf_inputs):
-                col = ex.materialize(bound.eval(batch), batch) \
-                    if bound is not None else None
-                for (op, _t) in self._update_cols(leaf):
-                    if leaf.op == "avg":
-                        import jax.numpy as jnp
-                        c = col
-                        if op == "sum" and c.dtype != dt.FLOAT64:
-                            c = Column(dt.FLOAT64,
-                                       c.data.astype(jnp.float64), c.validity)
-                        specs.append(agg_k.AggSpec(op, c))
-                    else:
-                        specs.append(agg_k.AggSpec(
-                            op, col, ignore_nulls=leaf.ignore_nulls))
+    # -- update (per input batch) --------------------------------------------
+    def _build_update_specs(self, batch: ColumnarBatch):
+        keys = [ex.materialize(g.eval(batch), batch) for g in self.grouping]
+        specs: List[agg_k.AggSpec] = []
+        for leaf, bound in zip(self.leaves, self.bound_leaf_inputs):
+            col = ex.materialize(bound.eval(batch), batch) \
+                if bound is not None else None
+            for (op, _t) in self._update_cols(leaf):
+                if leaf.op == "avg":
+                    import jax.numpy as jnp
+                    c = col
+                    if op == "sum" and c.dtype != dt.FLOAT64:
+                        c = Column(dt.FLOAT64,
+                                   c.data.astype(jnp.float64), c.validity)
+                    specs.append(agg_k.AggSpec(op, c))
+                else:
+                    specs.append(agg_k.AggSpec(
+                        op, col, ignore_nulls=leaf.ignore_nulls))
+        return keys, specs
 
-            if not self.grouping:
-                aggs = agg_k.reduce_aggregate(specs, batch.num_rows, cap)
-                n_groups = 1
-                out_keys: List[Column] = []
-            else:
-                out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
-                    keys, specs, batch.num_rows, cap,
-                    allow_matmul=_matmul_agg_enabled())
-
-        if self.mode == "partial":
-            cols = out_keys + aggs
-            out = ColumnarBatch(self._out_schema, cols, n_groups)
-            self.metrics.inc("numOutputRows", n_groups)
-            yield out
-            return
-        yield self._project_results(out_keys, aggs, n_groups)
+    def _update_partial_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Update-phase aggregation of one input batch into partial form."""
+        keys, specs = self._build_update_specs(batch)
+        cap = batch.capacity
+        if not self.grouping:
+            aggs = agg_k.reduce_aggregate(specs, batch.num_rows, cap)
+            return ColumnarBatch(self._partial_schema(), aggs, 1)
+        out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
+            keys, specs, batch.num_rows, cap,
+            allow_matmul=_matmul_agg_enabled(), dense_state=self._dense_state)
+        return ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups)
 
     # -- final (merge partials) ---------------------------------------------
     def _merge_ops(self, leaf: lp.AggregateExpression):
@@ -456,26 +554,44 @@ class TpuHashAggregateExec(TpuExec):
             return ["sum"]
         return [leaf.op]
 
+    def _merge_specs(self, batch: ColumnarBatch):
+        nk = len(self.grouping_src)
+        keys = list(batch.columns[:nk])
+        specs: List[agg_k.AggSpec] = []
+        ci = nk
+        for leaf in self.leaves:
+            for op in self._merge_ops(leaf):
+                specs.append(agg_k.AggSpec(op, batch.columns[ci],
+                                           ignore_nulls=leaf.ignore_nulls))
+                ci += 1
+        return keys, specs
+
+    def _merge_to_partial(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Merge-phase aggregation of concatenated partials back to one row
+        per group (the merge half of the CudfAggregate update/merge pairs)."""
+        keys, specs = self._merge_specs(batch)
+        if not keys:
+            aggs = agg_k.reduce_aggregate(specs, batch.num_rows,
+                                          batch.capacity)
+            return ColumnarBatch(self._partial_schema(), aggs, 1)
+        out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
+            keys, specs, batch.num_rows, batch.capacity,
+            allow_matmul=_matmul_agg_enabled(), dense_state=self._dense_state)
+        return ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups)
+
     def _final(self, batch: ColumnarBatch) -> Partition:
         with self.metrics.timer("computeAggTime"):
-            cap = batch.capacity
-            nk = len(self.grouping_src)
-            keys = batch.columns[:nk]
-            specs = []
-            ci = nk
-            for leaf in self.leaves:
-                for op in self._merge_ops(leaf):
-                    specs.append(agg_k.AggSpec(op, batch.columns[ci],
-                                               ignore_nulls=leaf.ignore_nulls))
-                    ci += 1
+            keys, specs = self._merge_specs(batch)
             if not keys:
-                aggs = agg_k.reduce_aggregate(specs, batch.num_rows, cap)
+                aggs = agg_k.reduce_aggregate(specs, batch.num_rows,
+                                              batch.capacity)
                 n_groups = 1
                 out_keys = []
             else:
                 out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
-                    keys, specs, batch.num_rows, cap,
-                    allow_matmul=_matmul_agg_enabled())
+                    keys, specs, batch.num_rows, batch.capacity,
+                    allow_matmul=_matmul_agg_enabled(),
+                    dense_state=self._dense_state)
         yield self._project_results(out_keys, aggs, n_groups)
 
     # -- result projection ---------------------------------------------------
@@ -559,14 +675,24 @@ class TpuSortExec(TpuExec):
     def schema(self):
         return self.children[0].schema
 
+    def children_coalesce_goal(self, i: int):
+        # device sort needs the whole partition in one batch
+        # (RequireSingleBatch when global, GpuSortExec.scala)
+        return "single"
+
     def execute(self) -> List[Partition]:
         return [self._sort(p) for p in self.children[0].execute()]
 
     def _sort(self, part: Partition) -> Partition:
-        batches = list(part)
-        if not batches:
+        from ..exec.spill import SpillableColumnarBatch
+        spillables = []
+        for b in part:
+            if b.num_rows:
+                _task_begin()        # after first host-side input is ready
+                spillables.append(SpillableColumnarBatch(b))
+        if not spillables:
             return
-        batch = concat_batches(self.schema, batches)
+        batch = concat_spillable(self.schema, spillables)
         with self.metrics.timer("sortTime"):
             keys = [K.SortKey(ex.materialize(o.child.eval(batch), batch),
                               o.ascending, o.nulls_first)
@@ -710,26 +836,32 @@ class TpuSortMergeJoinExec(TpuExec):
     def schema(self):
         return self._out_schema
 
+    def children_coalesce_goal(self, i: int):
+        # build side is materialized to a single batch; stream side benefits
+        # from target-size batches (GpuShuffledHashJoinExec goals)
+        return "target" if i == 0 else "single"
+
     def execute(self) -> List[Partition]:
         # build side = right (stream left), matching Spark BuildRight default.
-        build_parts = self.children[1].execute()
-        build_batches: List[ColumnarBatch] = []
-        for p in build_parts:
-            build_batches.extend(p)
-        build = concat_batches(self.children[1].schema, build_batches)
+        # Accumulated build batches are spillable until the single-batch
+        # concat (the reference holds its build side spillable the same way).
+        build = concat_spillable(
+            self.children[1].schema,
+            accumulate_spillable(self.children[1].execute()))
         stream_parts = self.children[0].execute()
         if self.how == "full":
             # unmatched-build accounting happens inside one join pass, so full
             # outer needs the ENTIRE stream side in a single partition — a
             # per-partition pass would re-emit matched build rows as unmatched
-            all_batches = [b for p in stream_parts for b in p]
-            merged = concat_batches(self.children[0].schema, all_batches)
+            merged = concat_spillable(self.children[0].schema,
+                                      accumulate_spillable(stream_parts))
             stream_parts = [iter([merged])]
         return [self._join_part(p, build) for p in stream_parts]
 
     def _join_part(self, part: Partition, build: ColumnarBatch) -> Partition:
         # full outer: execute() has already merged the whole stream side into
         # this one partition as a single (possibly empty) batch
+        _task_begin()
         bkey_cols = [ex.materialize(e.eval(build), build)
                      for e in self.right_keys]
         for batch in part:
@@ -796,10 +928,9 @@ class TpuCrossJoinExec(TpuExec):
         return self._out_schema
 
     def execute(self) -> List[Partition]:
-        right_batches: List[ColumnarBatch] = []
-        for p in self.children[1].execute():
-            right_batches.extend(p)
-        right = concat_batches(self.children[1].schema, right_batches)
+        right = concat_spillable(
+            self.children[1].schema,
+            accumulate_spillable(self.children[1].execute()))
         return [self._map(p, right) for p in self.children[0].execute()]
 
     def _map(self, part: Partition, right: ColumnarBatch) -> Partition:

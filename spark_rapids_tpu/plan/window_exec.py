@@ -44,6 +44,11 @@ class TpuWindowExec(TpuExec):
     def schema(self):
         return self._schema
 
+    def children_coalesce_goal(self, i: int):
+        # window partitions must be grouped within one batch
+        # (GpuWindowExec RequireSingleBatch)
+        return "single"
+
     def execute(self) -> List[Partition]:
         return [self._map(p) for p in self.children[0].execute()]
 

@@ -291,3 +291,14 @@ def test_dense_dispatch_falls_back_on_f32_unsafe_floats():
     got = dict(zip(keys, sums))
     assert got[1] == 1e40 + 3.0
     assert got[2] == float("inf")
+
+
+def test_dense_nan_poisons_only_its_group():
+    """A NaN value must make only ITS group's sum/avg NaN, not every group."""
+    nan = float("nan")
+    k = _col([1, 1, 2, 2], dt.INT64)
+    v = _col([nan, 2.0, 3.0, 4.0], dt.FLOAT64)
+    keys, aggs = _run_dense(k, [AggSpec("sum", v), AggSpec("avg", v)], 4)
+    assert keys[0] == [1, 2]
+    assert math.isnan(aggs[0][0]) and math.isnan(aggs[1][0])
+    assert aggs[0][1] == 7.0 and aggs[1][1] == 3.5
