@@ -177,14 +177,18 @@ class Md5(Expression):
 
 
 class Rand(Expression):
-    """rand(seed): per-row uniform [0,1) via threefry, keyed by (seed, row index)
-    — deterministic given seed + partition like GpuRand."""
+    """rand(seed): per-row uniform [0,1) via threefry — deterministic given
+    (seed, partition, batch ordinal) like GpuRand's per-partition XORShift
+    stream. The batch ordinal is folded into the PRNG key so successive
+    batches in a partition draw fresh values instead of replaying the
+    sequence; the exec advances it via ``advance()`` after each batch."""
     side_effect_free = False
 
     def __init__(self, seed: int = 0):
         super().__init__()
         self.seed = seed
         self.partition_index = 0
+        self._batch_ordinal = 0
 
     @property
     def dtype(self):
@@ -194,9 +198,14 @@ class Rand(Expression):
     def nullable(self):
         return False
 
+    def advance(self, n_rows: int) -> None:
+        self._batch_ordinal += 1
+
     def eval(self, batch: ColumnarBatch):
         import jax
-        key = jax.random.key(self.seed + self.partition_index)
+        key = jax.random.fold_in(
+            jax.random.key(self.seed + self.partition_index),
+            self._batch_ordinal)
         data = jax.random.uniform(key, (batch.capacity,), dtype=jnp.float64)
         live = batch.row_mask()
         return result_column(dt.FLOAT64, jnp.where(live, data, 0.0), live,
@@ -219,6 +228,9 @@ class MonotonicallyIncreasingID(Expression):
     @property
     def nullable(self):
         return False
+
+    def advance(self, n_rows: int) -> None:
+        self.row_offset += n_rows
 
     def eval(self, batch: ColumnarBatch):
         base = (self.partition_index << 33) + self.row_offset

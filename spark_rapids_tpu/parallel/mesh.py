@@ -134,6 +134,27 @@ def _rebuild_columns(schema_dtypes: Sequence[dt.DType],
     return cols
 
 
+def _update_plan(agg_ops: Sequence[str], val_dtypes: Sequence[dt.DType]
+                 ) -> List[List[Tuple[str, dt.DType]]]:
+    """Per input agg, the update-phase partial columns carried through the
+    exchange: avg decomposes into sum+count (AggregateFunctions.scala avg;
+    dividing only after the merge keeps distributed avg exact)."""
+    plan = []
+    for op, t in zip(agg_ops, val_dtypes):
+        if op == "avg":
+            plan.append([("sum", dt.FLOAT64), ("count", dt.INT64)])
+        elif op in ("count", "count_star"):
+            plan.append([(op, dt.INT64)])
+        else:
+            plan.append([(op, agg_k.result_dtype(op, t))])
+    return plan
+
+
+def output_dtypes(agg_ops: Sequence[str], val_dtypes: Sequence[dt.DType]
+                  ) -> List[dt.DType]:
+    return [agg_k.result_dtype(op, t) for op, t in zip(agg_ops, val_dtypes)]
+
+
 def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
                            val_dtypes: Sequence[dt.DType],
                            agg_ops: Sequence[str], cap: int):
@@ -146,12 +167,26 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
     This is the GpuHashAggregate(partial) -> GpuShuffleExchange(hash) ->
     GpuHashAggregate(final) pipeline fused into ONE XLA computation
     (SURVEY.md §3.3 downstream), collectives riding ICI.
+
+    Every received-side buffer is sized ``n * cap``: each of the n peers can
+    legally send up to its full ``cap`` groups to ONE owner under key skew,
+    so a smaller receive window would silently drop rows.
     """
     n = mesh.devices.size
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:          # older jax
+        from jax.experimental.shard_map import shard_map
 
-    merge_ops = ["sum" if op in ("count", "count_star", "avg") else op
-                 for op in agg_ops]
+    plan = _update_plan(agg_ops, val_dtypes)
+    partial_dtypes = [t for cols in plan for (_op, t) in cols]
+    # merge phase: counts and avg partials merge by SUM; everything else
+    # merges with its own op (CudfAggregate update/merge pairs)
+    merge_ops = []
+    for cols in plan:
+        for (op, _t) in cols:
+            merge_ops.append("sum" if op in ("count", "count_star") else op)
+    out_cap = n * cap
 
     def per_worker(*arrays_and_count):
         *arrays, local_n = arrays_and_count
@@ -162,10 +197,15 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
         key_cols = _rebuild_columns(key_dtypes, arrays[:nk])
         val_cols = _rebuild_columns(val_dtypes, arrays[nk:])
 
-        # 1. local partial aggregate
+        # 1. local partial aggregate (update phase)
         specs = []
-        for op, c in zip(agg_ops, val_cols):
-            specs.append(agg_k.AggSpec(op if op != "avg" else "sum", c))
+        for cols_plan, c in zip(plan, val_cols):
+            for (uop, ut) in cols_plan:
+                cc = c
+                if ut == dt.FLOAT64 and c.dtype != dt.FLOAT64 and uop == "sum":
+                    cc = Column(dt.FLOAT64, c.data.astype(jnp.float64),
+                                c.validity)
+                specs.append(agg_k.AggSpec(uop, cc))
         out_keys, out_aggs, n_groups = agg_k.groupby_aggregate(
             key_cols, specs, local_n, cap)
 
@@ -175,17 +215,32 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
         payload = _column_arrays(out_keys) + _column_arrays(out_aggs)
         stacked, counts = bucket_rows_for_exchange(payload, pids, live, n, cap)
         moved, moved_counts = exchange(stacked, counts, "workers")
-        flat, recv_n = flatten_received(moved, moved_counts, cap * 1)
+        flat, recv_n = flatten_received(moved, moved_counts, out_cap)
 
         # 3. merge aggregate over received partials
         recv_keys = _rebuild_columns(key_dtypes, flat[:nk])
-        agg_dtypes = [a.dtype for a in out_aggs]
-        recv_aggs = _rebuild_columns(agg_dtypes, flat[nk:])
+        recv_aggs = _rebuild_columns(partial_dtypes, flat[nk:])
         mspecs = [agg_k.AggSpec(mop, c)
                   for mop, c in zip(merge_ops, recv_aggs)]
         f_keys, f_aggs, f_groups = agg_k.groupby_aggregate(
-            recv_keys, mspecs, recv_n, cap)
-        out = (_column_arrays(f_keys) + _column_arrays(f_aggs) +
+            recv_keys, mspecs, recv_n, out_cap)
+
+        # 4. finalize: divide avg partials post-merge
+        out_cols: List[Column] = []
+        ai = 0
+        for op, cols_plan in zip(agg_ops, plan):
+            if op == "avg":
+                s, c = f_aggs[ai], f_aggs[ai + 1]
+                valid = s.validity & (c.data > 0)
+                data = jnp.where(
+                    valid,
+                    s.data / jnp.maximum(c.data.astype(jnp.float64), 1.0),
+                    0.0)
+                out_cols.append(Column(dt.FLOAT64, data, valid))
+            else:
+                out_cols.append(f_aggs[ai])
+            ai += len(cols_plan)
+        out = (_column_arrays(f_keys) + _column_arrays(out_cols) +
                [f_groups])
         return tuple(a[None] for a in out)
 
@@ -194,10 +249,12 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
         sum(3 if t == dt.STRING else 2 for t in val_dtypes) + 1))
     out_count = (sum(3 if t == dt.STRING else 2 for t in key_dtypes))
 
-    smapped = shard_map(per_worker, mesh=mesh,
-                        in_specs=in_specs,
-                        out_specs=P("workers"),
-                        check_rep=False)
+    try:
+        smapped = shard_map(per_worker, mesh=mesh, in_specs=in_specs,
+                            out_specs=P("workers"), check_vma=False)
+    except TypeError:            # older jax spelling
+        smapped = shard_map(per_worker, mesh=mesh, in_specs=in_specs,
+                            out_specs=P("workers"), check_rep=False)
     return jax.jit(smapped)
 
 
@@ -234,9 +291,7 @@ def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
     outs = fn(*stacked, counts)
 
     # unpack per-worker results
-    agg_out_dtypes = [agg_k.result_dtype(
-        op if op not in ("avg",) else "sum",
-        val_dtypes[i]) for i, op in enumerate(agg_ops)]
+    agg_out_dtypes = output_dtypes(agg_ops, val_dtypes)
     results = []
     nk_arrays = sum(3 if t == dt.STRING else 2 for t in key_dtypes)
     for w in range(n):

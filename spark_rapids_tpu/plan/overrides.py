@@ -207,6 +207,27 @@ class PlanMeta(BaseMeta):
 
     def _tag_self(self) -> None:
         p = self.plan
+        if isinstance(p, lp.Aggregate):
+            d_leaves = [l for e in p.aggregate_exprs
+                        for l in e.collect(
+                            lambda x: isinstance(x, lp.AggregateExpression))
+                        if l.distinct]
+            if d_leaves:
+                # DISTINCT plans as a two-level aggregate (dedupe on
+                # (keys, value) then the outer agg — the reference routes
+                # this through Spark's partial/partial-merge distinct
+                # planning, aggregate.scala:77-170); one distinct column
+                # set at a time, like Spark's non-Expand planning path
+                if any(l.op not in ("count", "sum", "avg", "min", "max")
+                       for l in d_leaves):
+                    self.will_not_work(
+                        "DISTINCT is only supported for "
+                        "count/sum/avg/min/max")
+                if len({repr(l.children[0]) for l in d_leaves
+                        if l.children}) > 1:
+                    self.will_not_work(
+                        "multiple DISTINCT aggregate column sets "
+                        "are not supported")
         if isinstance(p, lp.Join):
             if p.how not in ("inner", "left", "right", "full", "left_semi",
                              "left_anti", "cross"):
@@ -311,6 +332,11 @@ class Overrides:
         if isinstance(p, lp.Filter):
             return ph.TpuFilterExec(kids[0], p.condition)
         if isinstance(p, lp.Aggregate):
+            leaves = [l for e in p.aggregate_exprs
+                      for l in e.collect(
+                          lambda x: isinstance(x, lp.AggregateExpression))]
+            if any(l.distinct for l in leaves):
+                return self._convert_distinct_agg(p, kids[0], leaves)
             return ph.TpuHashAggregateExec(kids[0], p.grouping,
                                            p.aggregate_exprs)
         if isinstance(p, lp.Distinct):
@@ -339,6 +365,89 @@ class Overrides:
             from ..io.write import TpuWriteFileExec
             return TpuWriteFileExec(kids[0], p)
         raise NotImplementedError(f"no TPU exec for {p.name}")
+
+    def _convert_distinct_agg(self, p: lp.Aggregate, child: ph.TpuExec,
+                              leaves: List[lp.AggregateExpression]
+                              ) -> ph.TpuExec:
+        """Two-level plan for DISTINCT aggregates (the reference's distinct
+        planning, aggregate.scala:77-170 replaceMode partial/partial-merge):
+
+          inner:  group by (keys..., v) — dedupes the distinct column while
+                  computing the non-distinct aggregates per (keys, v) subgroup
+          outer:  group by keys — distinct aggs evaluate over the now-unique
+                  v values; non-distinct aggs merge their inner partials
+                  (count->sum, sum->sum, avg->sum/count divide)
+        """
+        from ..ops.cast import Cast as _Cast
+        d_leaves = [l for l in leaves if l.distinct]
+        nd_leaves = [l for l in leaves if not l.distinct]
+        v_expr = d_leaves[0].children[0]
+
+        inner_grouping = list(p.grouping) + [v_expr]
+        inner_outputs: List[ex.Expression] = []
+        for i, g in enumerate(p.grouping):
+            inner_outputs.append(ex.Alias(g, f"_g{i}"))
+        inner_outputs.append(ex.Alias(v_expr, "_v"))
+        # non-distinct partial pieces, one or two inner agg columns per leaf
+        nd_parts: Dict[int, List[str]] = {}
+        for i, l in enumerate(nd_leaves):
+            if l.op == "avg":
+                c = l.children[0]
+                inner_outputs.append(ex.Alias(
+                    lp.AggregateExpression("sum", c), f"_nd{i}_s"))
+                inner_outputs.append(ex.Alias(
+                    lp.AggregateExpression("count", c), f"_nd{i}_c"))
+                nd_parts[i] = [f"_nd{i}_s", f"_nd{i}_c"]
+            else:
+                inner_outputs.append(ex.Alias(
+                    lp.AggregateExpression(
+                        l.op, l.children[0] if l.children else None,
+                        ignore_nulls=l.ignore_nulls), f"_nd{i}"))
+                nd_parts[i] = [f"_nd{i}"]
+        inner = ph.TpuHashAggregateExec(child, inner_grouping, inner_outputs)
+
+        def _ref(name: str) -> ex.ColumnRef:
+            return ex.ColumnRef(name).resolve(inner.schema)
+
+        def _sum_of(name: str) -> ex.Expression:
+            return lp.AggregateExpression("sum", _ref(name))
+
+        def _merge_leaf(i: int, l: lp.AggregateExpression) -> ex.Expression:
+            names = nd_parts[i]
+            if l.op == "avg":
+                s = _sum_of(names[0])
+                c = _sum_of(names[1])
+                num = s if s.dtype == dt.FLOAT64 else _Cast(s, dt.FLOAT64)
+                den = _Cast(c, dt.FLOAT64)
+                return ar.Divide(num, den)
+            if l.op in ("count", "count_star", "sum"):
+                return _sum_of(names[0])
+            return lp.AggregateExpression(l.op, _ref(names[0]),
+                                          ignore_nulls=l.ignore_nulls)
+
+        def rewrite(e: ex.Expression) -> ex.Expression:
+            def fn(node):
+                for l in d_leaves:
+                    if node is l:
+                        op = "count" if l.op == "count_star" else l.op
+                        return lp.AggregateExpression(op, _ref("_v"))
+                for i, l in enumerate(nd_leaves):
+                    if node is l:
+                        return _merge_leaf(i, l)
+                for gi, g in enumerate(p.grouping):
+                    if node is g or (
+                            isinstance(node, ex.ColumnRef) and
+                            isinstance(g, ex.ColumnRef) and
+                            node.col_name == g.col_name):
+                        return _ref(f"_g{gi}")
+                return None
+            return e.transform(fn)
+
+        outer_grouping = [_ref(f"_g{i}") for i in range(len(p.grouping))]
+        outer_outputs = [
+            ex.Alias(rewrite(e), ex.output_name(e, i))
+            for i, e in enumerate(p.aggregate_exprs)]
+        return ph.TpuHashAggregateExec(inner, outer_grouping, outer_outputs)
 
     def _convert_join(self, p: lp.Join, kids: List[ph.TpuExec]) -> ph.TpuExec:
         from ..cpu.engine import _extract_equi_keys

@@ -141,6 +141,27 @@ class TpuExec:
         return self._tree_string()
 
 
+def _prepare_stateful(exprs: List[ex.Expression], pid: int
+                      ) -> Tuple[List[ex.Expression], List[ex.Expression]]:
+    """Per-partition clone + bind of stateful expressions (Rand,
+    monotonically_increasing_id, spark_partition_id): bound exprs are shared
+    across partitions, so stateful nodes must be copied per partition and
+    given their partition index (GpuRand / GpuMonotonicallyIncreasingID get
+    this from TaskContext in the reference). Returns (exprs, stateful nodes);
+    the caller calls ``advance(n_rows)`` on each node after every batch so
+    per-row streams progress instead of replaying."""
+    import copy
+    if not any(e.collect(lambda x: not x.side_effect_free) for e in exprs):
+        return exprs, []
+    exprs = [copy.deepcopy(e) for e in exprs]
+    stateful = [n for e in exprs
+                for n in e.collect(lambda x: not x.side_effect_free)]
+    for n in stateful:
+        if hasattr(n, "partition_index"):
+            n.partition_index = pid
+    return exprs, [n for n in stateful if hasattr(n, "advance")]
+
+
 def _task_begin() -> None:
     """Device admission at task (partition evaluation) start: the semaphore
     bounds concurrently-executing device tasks. Ordering contract preserved
@@ -308,13 +329,17 @@ class TpuProjectExec(TpuExec):
         return self._schema
 
     def execute(self) -> List[Partition]:
-        return [self._map(p) for p in self.children[0].execute()]
+        return [self._map(p, i)
+                for i, p in enumerate(self.children[0].execute())]
 
-    def _map(self, part: Partition) -> Partition:
+    def _map(self, part: Partition, pid: int = 0) -> Partition:
+        exprs, stateful = _prepare_stateful(self.exprs, pid)
         for batch in part:
             with self.metrics.timer("opTime"):
-                cols = [ex.materialize(e.eval(batch), batch) for e in self.exprs]
+                cols = [ex.materialize(e.eval(batch), batch) for e in exprs]
                 out = ColumnarBatch(self._schema, cols, batch.num_rows)
+            for n in stateful:
+                n.advance(batch.num_rows)
             self.metrics.inc("numOutputRows", out.num_rows)
             self.metrics.inc("numOutputBatches")
             yield out
@@ -335,12 +360,16 @@ class TpuFilterExec(TpuExec):
         return self._schema
 
     def execute(self) -> List[Partition]:
-        return [self._map(p) for p in self.children[0].execute()]
+        return [self._map(p, i)
+                for i, p in enumerate(self.children[0].execute())]
 
-    def _map(self, part: Partition) -> Partition:
+    def _map(self, part: Partition, pid: int = 0) -> Partition:
+        (condition,), stateful = _prepare_stateful([self.condition], pid)
         for batch in part:
             with self.metrics.timer("opTime"):
-                pred = self.condition.eval(batch)
+                pred = condition.eval(batch)
+                for n in stateful:
+                    n.advance(batch.num_rows)
                 if isinstance(pred, Scalar):
                     if pred.value is True:
                         yield batch
