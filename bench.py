@@ -140,8 +140,16 @@ def main():
 
     bytes_per_row = 8 + 1 + 8 + 1 + 1            # key, kvalid, val, vvalid, flag
     gbytes_per_s = tpu_rows_per_s * bytes_per_row / 1e9
-    # one-hot matmul flops: rows x slots x 2 (mul+add) x 3 features + count
-    tflops = tpu_rows_per_s * K_SLOTS * 2 * 4 / 1e12
+    # one-hot matmul flops: rows x slots x 2 (mul+add) x planned feature
+    # planes (occupancy + contrib + hi/lo/nan for the fused sum/count/avg)
+    from spark_rapids_tpu.columnar import dtypes as _dt
+    from spark_rapids_tpu.columnar.column import Column as _Col
+    from spark_rapids_tpu.ops import aggregates as _agg
+    _c = _Col(_dt.FLOAT64, np.zeros(8), np.zeros(8, dtype=bool))
+    n_feats = _agg.dense_feature_count(
+        [_agg.AggSpec("sum", _c), _agg.AggSpec("count", _c),
+         _agg.AggSpec("avg", _c)])
+    tflops = tpu_rows_per_s * K_SLOTS * 2 * n_feats / 1e12
     print(json.dumps({
         "metric": "fused filter+project+groupby throughput",
         "value": round(tpu_rows_per_s / 1e6, 2),

@@ -224,6 +224,11 @@ CONCURRENT_TPU_TASKS = _conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
     "(ref: spark.rapids.sql.concurrentGpuTasks, RapidsConf.scala:351)"
 ).integer_conf.create_with_default(2)
 
+TASK_POOL_THREADS = _conf("spark.rapids.tpu.sql.taskPoolThreads").doc(
+    "Threads draining partitions concurrently (Spark's executor task slots; "
+    "the TpuSemaphore still bounds how many hold the device at once)"
+).integer_conf.create_with_default(4)
+
 ALLOC_FRACTION = _conf("spark.rapids.tpu.memory.allocFraction").doc(
     "Fraction of device HBM the pool may use (ref: spark.rapids.memory.gpu.allocFraction)"
 ).double_conf.check(lambda v: 0.0 < v <= 1.0).create_with_default(0.9)
@@ -368,6 +373,9 @@ class TpuConf:
     def batch_size_bytes(self) -> int: return self.get(BATCH_SIZE_BYTES)
     @property
     def concurrent_tpu_tasks(self) -> int: return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def task_pool_threads(self) -> int: return self.get(TASK_POOL_THREADS)
     @property
     def host_spill_storage_size(self) -> int: return self.get(HOST_SPILL_STORAGE_SIZE)
     @property

@@ -129,6 +129,17 @@ class SpillableBuffer:
                 i += 2
         return ColumnarBatch(self.meta.schema, cols, self.meta.num_rows)
 
+    def promote_to_device(self, arrays: List[Any]) -> None:
+        """Move the buffer back to the device tier (re-promotion on acquire,
+        RapidsBufferStore.scala:275-301); caller accounts the bytes."""
+        with self._lock:
+            self._device_arrays = arrays
+            self._host_arrays = None
+            if self._disk_path and os.path.exists(self._disk_path):
+                os.unlink(self._disk_path)
+            self._disk_path = None
+            self.tier = StorageTier.DEVICE
+
     def free(self) -> None:
         with self._lock:
             self._device_arrays = None
@@ -164,7 +175,15 @@ class BufferCatalog:
             if cls._instance is None:
                 from .. import config as cfg
                 conf = cfg.TpuConf()
+                try:
+                    # real device budget even when no session was built —
+                    # the 16 GiB constructor default is only a last resort
+                    from .device import DeviceManager
+                    device_budget = DeviceManager.get(conf).memory_budget_bytes
+                except Exception:
+                    device_budget = 1 << 34
                 cls._instance = BufferCatalog(
+                    device_budget=device_budget,
                     host_budget=conf.host_spill_storage_size,
                     spill_dir=conf.spill_dir)
             return cls._instance
@@ -196,13 +215,25 @@ class BufferCatalog:
         return buf.id
 
     def acquire_batch(self, buffer_id: int) -> ColumnarBatch:
+        """Materialize a registered batch on device. A spilled buffer is
+        re-promoted to the device tier WITH accounting — admission first
+        (possibly spilling lower-priority buffers), then the promotion is
+        charged against the device budget, so concurrent acquires cannot
+        silently exceed it (RapidsBufferStore.scala:275-301)."""
         with self._mu:
             buf = self.buffers[buffer_id]
             if buf.tier != StorageTier.DEVICE:
-                # promotion accounting: batch returns to device tier lazily;
-                # we leave the stored copy at its tier (re-read is cheap for
-                # host; disk reads free their file only on remove)
-                pass
+                target = self.device_budget - buf.size_bytes
+                if self.device_bytes > target:
+                    self._spill_device_to(max(target, 0))
+                prev_tier = buf.tier
+                arrays = buf._load_arrays()
+                buf.promote_to_device(arrays)
+                if prev_tier == StorageTier.HOST:
+                    self.host_bytes -= buf.size_bytes
+                self.device_bytes += buf.size_bytes
+        # device-tier rebuild happens OUTSIDE the catalog lock so concurrent
+        # task threads on the (common) unspilled path never serialize here
         return buf.get_batch()
 
     def remove(self, buffer_id: int) -> None:

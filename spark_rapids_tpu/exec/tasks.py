@@ -1,0 +1,53 @@
+"""Task execution: thread-pooled partition drains with semaphore discipline.
+
+Reference: Spark executors run N concurrent tasks; ``GpuSemaphore`` bounds how
+many of them may hold the device at once (GpuSemaphore.scala:27-161), and a
+task-completion listener releases the permit. Here a "task" is the drain of
+one partition's batch iterator on a pool thread; ``physical._task_begin``
+acquires the semaphore lazily at the first device op inside the drain, and the
+runner releases it in a ``finally`` when the partition is exhausted — the
+task-completion-listener contract (GpuSemaphore.scala:93) without Spark.
+
+The pool size (``spark.rapids.tpu.sql.taskPoolThreads``) may exceed the
+semaphore permits: extra threads block in ``acquire`` exactly like Spark tasks
+queueing on the GPU, keeping host-side input preparation overlapped with
+device work.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def _release_semaphore() -> None:
+    from .device import TpuSemaphore
+    TpuSemaphore.get().release_if_necessary()
+
+
+def run_partition_tasks(parts: Sequence[Any],
+                        fn: Callable[[int, Any], T],
+                        max_workers: int = 0) -> List[T]:
+    """Run ``fn(pid, partition)`` for each partition as a task, returning
+    results in partition order. Tasks run on a fresh pool (nested calls —
+    e.g. an exchange inside a collect — must not share a bounded pool, or
+    a parent task waiting on child tasks could starve the pool); each task
+    releases the TpuSemaphore on completion regardless of outcome."""
+    if max_workers <= 0:
+        from .. import config as cfg
+        max_workers = cfg.TpuConf().task_pool_threads
+
+    def task(pid_part):
+        pid, part = pid_part
+        try:
+            return fn(pid, part)
+        finally:
+            _release_semaphore()
+
+    if len(parts) <= 1 or max_workers <= 1:
+        return [task((i, p)) for i, p in enumerate(parts)]
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(parts))) as pool:
+        return list(pool.map(task, enumerate(parts)))

@@ -392,19 +392,32 @@ def dense_key_stats(key_col: Column, num_rows,
     return rmin, jnp.stack(parts)
 
 
-def _dense_chunks(cap: int) -> int:
-    return max(1, cap // _DENSE_CHUNK)
-
-
 def _onehot_feature_sums(seg: jnp.ndarray, feats: Sequence[jnp.ndarray],
                          K_slots: int) -> jnp.ndarray:
     """sum of each feature per slot via ONE chunked one-hot matmul; f64[K, F].
 
     ``feats`` is a list of f32[cap] arrays; they are stacked per chunk inside
     the scan body so the full [cap, F] matrix never materializes in HBM.
+
+    Non-bucketed capacities are zero-padded up to a multiple of _DENSE_CHUNK
+    so (a) the chunk reshape is always legal for any public caller and (b)
+    per-chunk rows never exceed _DENSE_CHUNK — the bound the f32-exactness
+    analysis (top of this section) assumes.
     """
     cap = seg.shape[0]
-    ch = _dense_chunks(cap)
+    if cap <= _DENSE_CHUNK:
+        ch = 1
+    else:
+        ch = -(-cap // _DENSE_CHUNK)
+        padded = ch * _DENSE_CHUNK
+        if padded != cap:
+            pad = padded - cap
+            # padded rows contribute 0 to every feature plane regardless of
+            # their (zero) segment id
+            seg = jnp.concatenate([seg, jnp.zeros(pad, seg.dtype)])
+            feats = [jnp.concatenate([f, jnp.zeros(pad, f.dtype)])
+                     for f in feats]
+            cap = padded
 
     def body(acc, xs):
         s, fs = xs
@@ -572,6 +585,30 @@ def groupby_dense(key_col: Column, specs: Sequence[AggSpec], num_rows,
     out_aggs = [K.gather_column(c, perm, out_valid=group_live)
                 for c in slot_aggs]
     return [out_key], out_aggs, n_groups
+
+
+def dense_feature_count(specs: Sequence[AggSpec]) -> int:
+    """Number of matmul feature planes groupby_dense builds for ``specs``
+    (mirrors the planning loop above; used to report accurate FLOPs)."""
+    n = 1                                   # occupancy
+    seen = set()
+    for spec in specs:
+        if spec.op in ("count_star", "min", "max", "first", "last"):
+            continue
+        cid = id(spec.column.data)
+        if ("contrib", cid) not in seen:
+            seen.add(("contrib", cid))
+            n += 1
+        if spec.op == "sum" and (spec.column.dtype.is_integral or
+                                 spec.column.dtype == dt.BOOL):
+            if ("nibbles", cid) not in seen:
+                seen.add(("nibbles", cid))
+                n += 16
+        elif spec.op in ("sum", "avg"):
+            if ("hilo", cid) not in seen:
+                seen.add(("hilo", cid))
+                n += 3
+    return n
 
 
 # ---------------------------------------------------------------------------

@@ -54,6 +54,20 @@ class Expression:
         replaced = fn(node)
         return node if replaced is None else replaced
 
+    def transform_down(self, fn) -> "Expression":
+        """Top-down transform (Catalyst transformDown analog): ``fn`` sees
+        each ORIGINAL node before its children are rewritten, and a replaced
+        node's subtree is not descended into. Required whenever ``fn`` matches
+        nodes by identity — a bottom-up pass copies any node whose children
+        changed, so identity checks would silently miss it."""
+        replaced = fn(self)
+        if replaced is not None:
+            return replaced
+        new_children = [c.transform_down(fn) for c in self.children]
+        if new_children != self.children:
+            return self.with_children(new_children)
+        return self
+
     def with_children(self, children: List["Expression"]) -> "Expression":
         import copy
         node = copy.copy(node_src := self)

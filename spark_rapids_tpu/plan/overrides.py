@@ -441,7 +441,10 @@ class Overrides:
                             node.col_name == g.col_name):
                         return _ref(f"_g{gi}")
                 return None
-            return e.transform(fn)
+            # top-down: leaves are matched by identity, which a bottom-up
+            # pass would break by copying nodes whose children were rewritten
+            # (e.g. sum(k) where k is also a grouping column)
+            return e.transform_down(fn)
 
         outer_grouping = [_ref(f"_g{i}") for i in range(len(p.grouping))]
         outer_outputs = [

@@ -70,8 +70,12 @@ def bind_refs(e: ex.Expression, schema: dt.Schema) -> ex.Expression:
 # ---------------------------------------------------------------------------
 
 class Metrics(dict):
+    _lock = __import__("threading").Lock()
+
     def inc(self, key: str, amount: float = 1) -> None:
-        self[key] = self.get(key, 0) + amount
+        # partitions drain on concurrent task threads; keep counters exact
+        with Metrics._lock:
+            self[key] = self.get(key, 0) + amount
 
     def timer(self, key: str):
         return _Timer(self, key)
@@ -122,11 +126,19 @@ class TpuExec:
         raise NotImplementedError
 
     def execute_collect(self) -> ColumnarBatch:
-        """Materialize all partitions into one batch (driver collect)."""
-        batches: List[ColumnarBatch] = []
-        for part in self.execute():
-            batches.extend(part)
-        return concat_batches(self.schema, batches)
+        """Materialize all partitions into one batch (driver collect).
+        Partitions drain concurrently as tasks (Spark's task parallelism);
+        accumulated results are spillable so N in-flight partitions cannot
+        pin the whole dataset in HBM."""
+        from ..exec.spill import SpillableColumnarBatch
+        from ..exec.tasks import run_partition_tasks
+
+        def drain(pid, part):
+            return [SpillableColumnarBatch(b) for b in part if b.num_rows > 0]
+
+        per_part = run_partition_tasks(self.execute(), drain)
+        return concat_spillable(
+            self.schema, [s for lst in per_part for s in lst])
 
     def _tree_string(self, depth: int = 0) -> str:
         out = "  " * depth + self._node_string()
@@ -182,14 +194,16 @@ def _reserve(nbytes: int) -> None:
 def accumulate_spillable(parts) -> List["SpillableColumnarBatch"]:
     """Drain partitions into spillable handles: accumulated build/sort inputs
     must not pin HBM while more batches stream in (SpillableColumnarBatch
-    treatment of build sides, GpuShuffledHashJoinExec / GpuSortExec)."""
+    treatment of build sides, GpuShuffledHashJoinExec / GpuSortExec).
+    Partitions drain concurrently as tasks."""
     from ..exec.spill import SpillableColumnarBatch
-    out: List[SpillableColumnarBatch] = []
-    for p in parts:
-        for b in p:
-            if b.num_rows > 0:
-                out.append(SpillableColumnarBatch(b))
-    return out
+    from ..exec.tasks import run_partition_tasks
+
+    def drain(pid, p):
+        return [SpillableColumnarBatch(b) for b in p if b.num_rows > 0]
+
+    parts = list(parts)
+    return [s for lst in run_partition_tasks(parts, drain) for s in lst]
 
 
 def concat_spillable(schema: dt.Schema,
@@ -405,18 +419,24 @@ class TpuCoalesceBatchesExec(TpuExec):
         return [self._map(p) for p in self.children[0].execute()]
 
     def _map(self, part: Partition) -> Partition:
-        pending: List[ColumnarBatch] = []
+        # accumulated batches are spillable while more stream in — raw device
+        # batches must not pin a whole partition in HBM below sort/window
+        # (the reference's GpuCoalesceBatches accumulates spillable batches)
+        from ..exec.spill import SpillableColumnarBatch
+        pending: List[SpillableColumnarBatch] = []
         pending_rows = 0
         for batch in part:
-            pending.append(batch)
+            if batch.num_rows == 0:
+                continue
+            pending.append(SpillableColumnarBatch(batch))
             pending_rows += batch.num_rows
             if self.goal != "single" and pending_rows >= self.target_rows:
                 with self.metrics.timer("concatTime"):
-                    yield concat_batches(self.schema, pending)
+                    yield concat_spillable(self.schema, pending)
                 pending, pending_rows = [], 0
         if pending:
             with self.metrics.timer("concatTime"):
-                yield concat_batches(self.schema, pending)
+                yield concat_spillable(self.schema, pending)
 
 
 # ---------------------------------------------------------------------------
@@ -681,7 +701,8 @@ class TpuHashAggregateExec(TpuExec):
                         node.col_name == g.col_name):
                     return ex.BoundReference(gi, g.dtype, True)
             return None
-        return e.transform(fn)
+        # top-down: leaf matching is by identity (see overrides rewrite note)
+        return e.transform_down(fn)
 
 
 # ---------------------------------------------------------------------------

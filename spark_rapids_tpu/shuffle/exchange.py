@@ -73,13 +73,18 @@ class TpuShuffleExchangeExec(TpuExec):
         return RoundRobinPartitioner(self.num_partitions)
 
     def execute(self) -> List[Partition]:
+        from ..exec.tasks import run_partition_tasks
         shuffle = LocalShuffle(self.num_partitions)
         partitioner = self._make_partitioner()
+
+        def map_task(pid, part):
+            for batch in part:
+                shuffle.write(partitioner, batch)
+                self.metrics.inc("dataSize", batch.device_size_bytes())
+
         with self.metrics.timer("shuffleWriteTime"):
-            for part in self.children[0].execute():
-                for batch in part:
-                    shuffle.write(partitioner, batch)
-                    self.metrics.inc("dataSize", batch.device_size_bytes())
+            # map side: one task per upstream partition, drained concurrently
+            run_partition_tasks(self.children[0].execute(), map_task)
         return [shuffle.read(p, self.schema)
                 for p in range(self.num_partitions)]
 

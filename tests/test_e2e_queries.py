@@ -271,6 +271,19 @@ def test_count_distinct_no_grouping():
              F.sum("i").alias("si")))
 
 
+def test_distinct_with_agg_on_grouping_column():
+    """A non-distinct aggregate over the GROUPING column alongside a DISTINCT:
+    the leaf's child ColumnRef matches the grouping rewrite, so identity-based
+    leaf matching must happen top-down (regression: bottom-up transform copied
+    the leaf and skipped its merge rewrite, crashing at execution)."""
+    import pyarrow as pa
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(pa.table({
+            "k": [1, 1, 1, 2], "v": ["a", "a", "b", "c"]}))
+        .groupBy("k").agg(F.countDistinct("v").alias("cd"),
+                          F.sum("k").alias("sk")))
+
+
 def test_multiple_distinct_columns_fall_back():
     """Two different DISTINCT column sets are not TPU-planned: the aggregate
     falls back to the CPU engine (and still answers correctly)."""

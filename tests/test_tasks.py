@@ -1,0 +1,129 @@
+"""Task parallelism: concurrent partition drains gated by the TpuSemaphore,
+with spill-catalog accounting that holds under re-promotion.
+
+Reference behavior being preserved: GpuSemaphore bounds concurrent device
+tasks and releases on task completion (GpuSemaphore.scala:27-161);
+RapidsBufferStore re-promotes spilled buffers on acquire with accounting
+(RapidsBufferStore.scala:275-301).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.exec.device import TpuSemaphore
+from spark_rapids_tpu.exec.spill import (BufferCatalog, SpillableColumnarBatch,
+                                         StorageTier)
+from spark_rapids_tpu.exec.tasks import run_partition_tasks
+
+
+def _batch(n=64, base=0):
+    vals = np.arange(base, base + n, dtype=np.int64)
+    schema = dt.Schema([dt.Field("x", dt.INT64)])
+    return ColumnarBatch(
+        schema, [Column.from_numpy(vals, dt.INT64)], n)
+
+
+def test_partitions_progress_concurrently():
+    """All N partition tasks must be in flight at once: each partition's
+    generator blocks on a barrier that only N concurrent drains can pass."""
+    n = 4
+    barrier = threading.Barrier(n, timeout=30)
+
+    def part(i):
+        barrier.wait()          # deadlocks (-> Broken) if drains are serial
+        yield _batch(base=i * 100)
+
+    def drain(pid, p):
+        return [b.num_rows for b in p]
+
+    out = run_partition_tasks([part(i) for i in range(n)], drain,
+                              max_workers=n)
+    assert out == [[64]] * n
+
+
+def test_semaphore_released_after_tasks():
+    TpuSemaphore.reset()
+    sem = TpuSemaphore.initialize(2)
+
+    def drain(pid, p):
+        sem.acquire_if_necessary()   # what _task_begin does mid-drain
+        return sum(b.num_rows for b in p)
+
+    parts = [iter([_batch()]) for _ in range(6)]
+    out = run_partition_tasks(parts, drain, max_workers=4)
+    assert out == [64] * 6
+    # every permit must be back (release-on-task-completion contract)
+    assert sem._sem._value == 2
+    TpuSemaphore.reset()
+
+
+def test_semaphore_bounds_concurrent_device_holders():
+    TpuSemaphore.reset()
+    sem = TpuSemaphore.initialize(2)
+    holders = []
+    peak = []
+    lock = threading.Lock()
+
+    def drain(pid, p):
+        sem.acquire_if_necessary()
+        with lock:
+            holders.append(pid)
+            peak.append(len(holders))
+        import time
+        time.sleep(0.05)
+        with lock:
+            holders.remove(pid)
+        return pid
+
+    run_partition_tasks([iter([_batch()]) for _ in range(6)], drain,
+                        max_workers=6)
+    assert max(peak) <= 2
+    TpuSemaphore.reset()
+
+
+def test_acquire_batch_repromotes_with_accounting(tmp_path):
+    b = _batch(1 << 10)
+    size = b.device_size_bytes()
+    cat = BufferCatalog(device_budget=int(size * 2.5), host_budget=size * 10,
+                        spill_dir=str(tmp_path))
+    s1 = SpillableColumnarBatch(b, catalog=cat)
+    s2 = SpillableColumnarBatch(_batch(1 << 10, base=5), catalog=cat)
+    s3 = SpillableColumnarBatch(_batch(1 << 10, base=9), catalog=cat)
+    # budget fits 2.5 batches -> the lowest-priority (first) spilled to host
+    assert cat.device_bytes <= cat.device_budget
+    assert cat.host_bytes > 0
+    spilled = [s for s in (s1, s2, s3)
+               if cat.buffers[s._id].tier == StorageTier.HOST]
+    assert spilled
+    # re-acquiring the spilled buffer promotes it back WITH accounting:
+    # something else spills to make room, and the budget still holds
+    got = spilled[0].get_batch()
+    assert got.num_rows == 1 << 10
+    assert cat.buffers[spilled[0]._id].tier == StorageTier.DEVICE
+    assert cat.device_bytes <= cat.device_budget
+    # total accounted device bytes equals the sum of device-tier buffers
+    expect = sum(buf.size_bytes for buf in cat.buffers.values()
+                 if buf.tier == StorageTier.DEVICE)
+    assert cat.device_bytes == expect
+    for s in (s1, s2, s3):
+        s.close()
+    assert cat.device_bytes == 0 and cat.host_bytes == 0
+
+
+def test_collect_parallel_partitions_match_serial():
+    """execute_collect over a multi-partition scan returns the same rows
+    regardless of drain interleaving."""
+    import pyarrow as pa
+    from spark_rapids_tpu.plan.physical import TpuLocalScanExec
+
+    table = pa.table({"x": list(range(1000))})
+    schema = dt.Schema([dt.Field("x", dt.INT64)])
+    exec_ = TpuLocalScanExec(table, schema, batch_rows=100, num_partitions=5)
+    out = exec_.execute_collect()
+    got = sorted(out.column(0).to_pylist(out.num_rows))
+    assert got == list(range(1000))
