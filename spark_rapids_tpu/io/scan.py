@@ -64,6 +64,12 @@ class TpuFileScanExec(TpuExec):
     def schema(self) -> dt.Schema:
         return self.plan.schema
 
+    @property
+    def output_partitions(self) -> int:
+        if self.reader_type == "PERFILE":
+            return max(1, len(self.files))
+        return 1
+
     def execute(self) -> List[Partition]:
         if not self.files:
             def empty():
@@ -74,7 +80,9 @@ class TpuFileScanExec(TpuExec):
             return [self._multithreaded()]
         if self.reader_type == "COALESCING" and self.plan.fmt != "csv":
             return [self._coalescing()]
-        return [self._perfile()]
+        # PERFILE: one partition per file (Spark's FilePartition granularity,
+        # the task-parallel unit) — multi-file scans drive distributed plans
+        return [self._perfile(f) for f in self.files]
 
     # -- strategies ----------------------------------------------------------
     def _read(self, path: str):
@@ -85,16 +93,15 @@ class TpuFileScanExec(TpuExec):
         self.metrics.inc("bufferTime")
         return t
 
-    def _perfile(self) -> Partition:
-        for f in self.files:
-            table = self._read(f)
-            if table.num_rows == 0:
-                continue
-            with self.metrics.timer("tpuDecodeTime"):
-                batch = ColumnarBatch.from_arrow(table)
-            self.metrics.inc("numOutputRows", batch.num_rows)
-            self.metrics.inc("numOutputBatches")
-            yield batch
+    def _perfile(self, f: str) -> Partition:
+        table = self._read(f)
+        if table.num_rows == 0:
+            return
+        with self.metrics.timer("tpuDecodeTime"):
+            batch = ColumnarBatch.from_arrow(table)
+        self.metrics.inc("numOutputRows", batch.num_rows)
+        self.metrics.inc("numOutputBatches")
+        yield batch
 
     def _coalescing(self) -> Partition:
         """Combine files up to the batch byte target before one upload

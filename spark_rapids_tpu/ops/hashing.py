@@ -264,10 +264,11 @@ class SparkPartitionID(Expression):
 
 class InputFileName(Expression):
     """input_file_name() — populated by the scan exec via thread-local context
-    (GpuInputFileBlock analog)."""
+    (GpuInputFileBlock analog). Thread-local: partitions drain on concurrent
+    task threads, each reading a different file."""
     side_effect_free = False
 
-    _current_file: str = ""
+    _tls = __import__("threading").local()
 
     @property
     def dtype(self):
@@ -279,7 +280,7 @@ class InputFileName(Expression):
 
     @classmethod
     def set_current(cls, path: str) -> None:
-        cls._current_file = path
+        cls._tls.current_file = path
 
     def eval(self, batch: ColumnarBatch):
-        return Scalar(self._current_file, dt.STRING)
+        return Scalar(getattr(self._tls, "current_file", ""), dt.STRING)

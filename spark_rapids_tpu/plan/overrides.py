@@ -337,12 +337,11 @@ class Overrides:
                           lambda x: isinstance(x, lp.AggregateExpression))]
             if any(l.distinct for l in leaves):
                 return self._convert_distinct_agg(p, kids[0], leaves)
-            return ph.TpuHashAggregateExec(kids[0], p.grouping,
-                                           p.aggregate_exprs)
+            return self._make_aggregate(kids[0], p.grouping, p.aggregate_exprs)
         if isinstance(p, lp.Distinct):
             grouping = [ex.ColumnRef(n).resolve(p.children[0].schema)
                         for n in p.children[0].schema.names()]
-            return ph.TpuHashAggregateExec(kids[0], grouping, list(grouping))
+            return self._make_aggregate(kids[0], grouping, list(grouping))
         if isinstance(p, lp.Join):
             return self._convert_join(p, kids)
         if isinstance(p, lp.Sort):
@@ -365,6 +364,32 @@ class Overrides:
             from ..io.write import TpuWriteFileExec
             return TpuWriteFileExec(kids[0], p)
         raise NotImplementedError(f"no TPU exec for {p.name}")
+
+    def _make_aggregate(self, child: ph.TpuExec,
+                        grouping: List[ex.Expression],
+                        outputs: List[ex.Expression]) -> ph.TpuExec:
+        """Aggregate planning (the reference's replaceMode two-phase planning,
+        aggregate.scala:77-170): a multi-partition child gets
+        partial(update) -> hash exchange on the grouping keys -> final(merge)
+        with the final merge running per exchange partition; a single
+        partition keeps the fused complete mode (the transition elision the
+        reference performs when the distribution is already satisfied)."""
+        if child.output_partitions > 1:
+            from ..shuffle.exchange import (TpuHashExchangeExec,
+                                            TpuShuffleExchangeExec)
+            partial = ph.TpuHashAggregateExec(child, grouping, outputs,
+                                              mode="partial")
+            if grouping:
+                keys = [ex.ColumnRef(f"_k{i}") for i in range(len(grouping))]
+                exch = TpuHashExchangeExec(
+                    partial, self.conf.shuffle_partitions, keys)
+            else:
+                # global aggregate: all partials meet on one partition
+                exch = TpuShuffleExchangeExec(partial, 1)
+            return ph.TpuHashAggregateExec(exch, grouping, outputs,
+                                           mode="final",
+                                           per_partition_final=True)
+        return ph.TpuHashAggregateExec(child, grouping, outputs)
 
     def _convert_distinct_agg(self, p: lp.Aggregate, child: ph.TpuExec,
                               leaves: List[lp.AggregateExpression]
@@ -404,7 +429,7 @@ class Overrides:
                         l.op, l.children[0] if l.children else None,
                         ignore_nulls=l.ignore_nulls), f"_nd{i}"))
                 nd_parts[i] = [f"_nd{i}"]
-        inner = ph.TpuHashAggregateExec(child, inner_grouping, inner_outputs)
+        inner = self._make_aggregate(child, inner_grouping, inner_outputs)
 
         def _ref(name: str) -> ex.ColumnRef:
             return ex.ColumnRef(name).resolve(inner.schema)
@@ -450,7 +475,7 @@ class Overrides:
         outer_outputs = [
             ex.Alias(rewrite(e), ex.output_name(e, i))
             for i, e in enumerate(p.aggregate_exprs)]
-        return ph.TpuHashAggregateExec(inner, outer_grouping, outer_outputs)
+        return self._make_aggregate(inner, outer_grouping, outer_outputs)
 
     def _convert_join(self, p: lp.Join, kids: List[ph.TpuExec]) -> ph.TpuExec:
         from ..cpu.engine import _extract_equi_keys
@@ -513,6 +538,10 @@ class CpuOpBridgeExec(ph.TpuExec):
     @property
     def schema(self):
         return self.plan.schema
+
+    @property
+    def output_partitions(self) -> int:
+        return 1
 
     def execute(self):
         from ..cpu.engine import execute as cpu_execute

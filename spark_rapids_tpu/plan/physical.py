@@ -114,6 +114,14 @@ class TpuExec:
     def name(self) -> str:
         return type(self).__name__
 
+    @property
+    def output_partitions(self) -> int:
+        """Estimated number of output partitions (Spark outputPartitioning
+        analog, reduced to a count): the planner uses this to decide when a
+        two-phase aggregate / co-partitioned join / range-partitioned sort
+        needs an exchange."""
+        return self.children[0].output_partitions if self.children else 1
+
     def children_coalesce_goal(self, i: int):
         """Per-child batch goal (CoalesceGoal lattice,
         GpuCoalesceBatches.scala:117-130): None (no requirement), "target"
@@ -255,6 +263,10 @@ class TpuLocalScanExec(TpuExec):
     def schema(self):
         return self._schema
 
+    @property
+    def output_partitions(self) -> int:
+        return self.num_partitions
+
     def execute(self) -> List[Partition]:
         n = self.table.num_rows
         per_part = max(1, -(-n // self.num_partitions))
@@ -300,6 +312,10 @@ class TpuRangeExec(TpuExec):
     @property
     def schema(self):
         return self._schema
+
+    @property
+    def output_partitions(self) -> int:
+        return self.num_partitions
 
     def execute(self) -> List[Partition]:
         import jax.numpy as jnp
@@ -454,9 +470,17 @@ class TpuHashAggregateExec(TpuExec):
     """
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
-                 aggregate_exprs: List[ex.Expression], mode: str = "complete"):
+                 aggregate_exprs: List[ex.Expression], mode: str = "complete",
+                 per_partition_final: bool = False):
         super().__init__(child)
         self.mode = mode
+        # per_partition_final: the planner guarantees the child is hash-
+        # partitioned on the grouping keys (an exchange directly below), so
+        # each partition's groups are disjoint and the final merge runs
+        # per-partition instead of draining every partition into one stream
+        # (the reference's HashClusteredDistribution requirement that the
+        # exchange satisfies, aggregate.scala two-phase planning)
+        self.per_partition_final = per_partition_final
         self.grouping_src = grouping
         self.aggregate_exprs = aggregate_exprs
         self._dense_state = {}   # dense-dispatch memo shared across batches
@@ -512,12 +536,23 @@ class TpuHashAggregateExec(TpuExec):
         # coalesce toward the target batch size (the reference's TargetSize)
         return "target"
 
+    @property
+    def output_partitions(self) -> int:
+        if self.mode == "partial" or self.per_partition_final:
+            return self.children[0].output_partitions
+        return 1
+
     def execute(self) -> List[Partition]:
         parts = self.children[0].execute()
         if self.mode == "partial":
             # update-only aggregation is per-partition (upstream of the
             # hash exchange, like the reference's partial mode)
             return [self._stream_merge(p, project=False) for p in parts]
+        if self.mode == "final" and self.per_partition_final:
+            # child is hash-partitioned on the grouping keys: groups are
+            # disjoint per partition, each merges independently (the
+            # distributed reduce side)
+            return [self._stream_merge(p, project=True) for p in parts]
         # complete/final must see every row of a group: all partitions feed
         # ONE streaming update+merge loop (aggregate.scala:427-485) whose
         # state is one spillable partial batch — never a concat of the input
@@ -765,6 +800,10 @@ class TpuLimitExec(TpuExec):
     def schema(self):
         return self.children[0].schema
 
+    @property
+    def output_partitions(self) -> int:
+        return 1 if self.is_global else self.children[0].output_partitions
+
     def execute(self) -> List[Partition]:
         parts = self.children[0].execute()
         if self.is_global and len(parts) > 1:
@@ -803,6 +842,10 @@ class TpuUnionExec(TpuExec):
     @property
     def schema(self):
         return self.children[0].schema
+
+    @property
+    def output_partitions(self) -> int:
+        return sum(c.output_partitions for c in self.children)
 
     def execute(self) -> List[Partition]:
         parts: List[Partition] = []
@@ -885,6 +928,10 @@ class TpuSortMergeJoinExec(TpuExec):
     @property
     def schema(self):
         return self._out_schema
+
+    @property
+    def output_partitions(self) -> int:
+        return 1 if self.how == "full" else self.children[0].output_partitions
 
     def children_coalesce_goal(self, i: int):
         # build side is materialized to a single batch; stream side benefits

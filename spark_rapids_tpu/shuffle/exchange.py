@@ -65,6 +65,10 @@ class TpuShuffleExchangeExec(TpuExec):
     def schema(self):
         return self.children[0].schema
 
+    @property
+    def output_partitions(self) -> int:
+        return self.num_partitions
+
     def _make_partitioner(self) -> TpuPartitioner:
         if self.num_partitions == 1:
             return SinglePartitioner()
