@@ -51,8 +51,16 @@ class RuntimeConf:
         return self._session.conf.get_key(key, default)
 
 
+class _BuilderAccessor:
+    """``TpuSession.builder`` returns a FRESH builder per access — a shared
+    mutable builder would leak .config() settings into later sessions."""
+
+    def __get__(self, obj, objtype=None):
+        return TpuSessionBuilder()
+
+
 class TpuSession:
-    builder = TpuSessionBuilder()
+    builder = _BuilderAccessor()
 
     _active: Optional["TpuSession"] = None
     _lock = threading.Lock()

@@ -36,7 +36,8 @@ def parse_bytes(value: Any) -> int:
     if isinstance(value, (int, float)):
         return int(value)
     s = str(value).strip().lower()
-    m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([a-z]*)", s)
+    # negative values pass through (sentinels like autoBroadcastJoinThreshold=-1)
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)\s*([a-z]*)", s)
     if not m:
         raise ValueError(f"cannot parse byte value: {value!r}")
     num, suffix = m.groups()
@@ -250,6 +251,14 @@ SHUFFLE_COMPRESSION_CODEC = _conf("spark.rapids.tpu.shuffle.compression.codec").
     "Codec for shuffle payloads: none, lz4 (ref: spark.rapids.shuffle.compression.codec, "
     "RapidsConf.scala:729)").string_conf.check(
         lambda v: v in ("none", "lz4")).create_with_default("none")
+
+AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
+    "Build sides at or under this many bytes broadcast (materialize once, "
+    "reused across stream partitions); larger builds co-partition both sides "
+    "through a hash exchange (ref: spark.sql.autoBroadcastJoinThreshold + "
+    "GpuBroadcastExchangeExec.scala:47). -1 disables broadcast."
+).bytes_conf.create_with_default(10 * 1024 * 1024)
 
 REPLACE_SORT_MERGE_JOIN = _conf("spark.rapids.tpu.sql.replaceHashJoin.enabled").doc(
     "Replace hash joins with TPU sort-merge joins (inverse of the reference's "

@@ -92,6 +92,14 @@ class LogicalPlan:
     def expressions(self) -> List[ex.Expression]:
         return []
 
+    def stats_bytes(self) -> int:
+        """Size-in-bytes estimate for join-strategy selection (Catalyst's
+        SizeInBytesOnlyStatsPlanVisitor role: leaf sizes propagate up, the
+        broadcast decision compares against autoBroadcastJoinThreshold)."""
+        if not self.children:
+            return 1 << 60          # unknown leaf: never broadcast
+        return sum(c.stats_bytes() for c in self.children)
+
     def __repr__(self):
         return self._tree_string(0)
 
@@ -118,6 +126,9 @@ class LocalScan(LogicalPlan):
             dt.Field(n, dt.from_arrow(t))
             for n, t in zip(self.data.schema.names, self.data.schema.types)])
 
+    def stats_bytes(self) -> int:
+        return self.data.nbytes
+
     def _node_string(self):
         return f"LocalScan [{', '.join(self.schema.names())}]"
 
@@ -141,6 +152,17 @@ class FileScan(LogicalPlan):
             from ..io import infer_schema
             self._file_schema = infer_schema(self.fmt, self.paths, self.options)
         return self._file_schema
+
+    def stats_bytes(self) -> int:
+        """Sum of on-disk file sizes (FileSourceScan sizeInBytes analog);
+        parquet compression makes this an underestimate of in-memory size,
+        matching Spark's behavior (it applies the same raw file size)."""
+        import os
+        from ..io import expand_paths
+        try:
+            return sum(os.path.getsize(f) for f in expand_paths(self.paths))
+        except OSError:
+            return 1 << 60
 
     def _node_string(self):
         return f"FileScan {self.fmt} {self.paths}"
@@ -283,6 +305,10 @@ class Range(LogicalPlan):
 
     def _compute_schema(self) -> dt.Schema:
         return dt.Schema([dt.Field("id", dt.INT64, nullable=False)])
+
+    def stats_bytes(self) -> int:
+        n = max(0, -(-(self.end - self.start) // self.step)) if self.step else 0
+        return n * 8
 
     def _node_string(self):
         return f"Range({self.start}, {self.end}, {self.step})"

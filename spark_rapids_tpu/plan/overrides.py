@@ -286,12 +286,18 @@ class Overrides:
 
     def _insert_coalesce(self, node: ph.TpuExec) -> ph.TpuExec:
         """Transition pass: insert TpuCoalesceBatchesExec per the op's
-        children coalesce goals (GpuTransitionOverrides.scala:118-244)."""
+        children coalesce goals (GpuTransitionOverrides.scala:118-244).
+        Exchanges are exempt: they already emit one concatenated batch per
+        partition (the reference's optimizeCoalesce elision around shuffles,
+        GpuTransitionOverrides.scala:51-94)."""
+        from ..shuffle.exchange import (TpuBroadcastExchangeExec,
+                                        TpuShuffleExchangeExec)
         for i, child in enumerate(node.children):
             child = self._insert_coalesce(child)
             goal = node.children_coalesce_goal(i)
             if goal is not None and not isinstance(
-                    child, ph.TpuCoalesceBatchesExec):
+                    child, (ph.TpuCoalesceBatchesExec,
+                            TpuShuffleExchangeExec, TpuBroadcastExchangeExec)):
                 # size from the CHILD's schema: those are the rows being
                 # concatenated toward batchSizeBytes
                 child = ph.TpuCoalesceBatchesExec(
@@ -491,11 +497,47 @@ class Overrides:
         if how == "right":
             # remap: right outer = left outer with sides swapped, then
             # reorder output columns (GpuHashJoin.scala:112-132 remap)
-            inner = ph.TpuSortMergeJoinExec(right, left, "left", rk, lk,
-                                            None)
+            inner = self._plan_equi_join(
+                right, left, "left", rk, lk, None,
+                build_stats=p.children[0].stats_bytes())
             return _ReorderExec(inner, p.schema,
                                 len(rnames), len(lnames))
-        return ph.TpuSortMergeJoinExec(left, right, how, lk, rk, residual)
+        return self._plan_equi_join(left, right, how, lk, rk, residual,
+                                    build_stats=p.children[1].stats_bytes())
+
+    def _plan_equi_join(self, stream: ph.TpuExec, build: ph.TpuExec, how: str,
+                        stream_keys, build_keys, residual,
+                        build_stats: int) -> ph.TpuExec:
+        """Join strategy selection (GpuBroadcastJoinMeta + Spark's
+        autoBroadcastJoinThreshold): a build side at or under the threshold
+        broadcasts — materialized once as a spillable, reused by every stream
+        partition; a larger build co-partitions BOTH sides through a hash
+        exchange and joins one build partition at a time."""
+        threshold = int(self.conf.get(cfg.AUTO_BROADCAST_JOIN_THRESHOLD))
+        if threshold >= 0 and build_stats <= threshold:
+            from ..shuffle.exchange import TpuBroadcastExchangeExec
+            return ph.TpuSortMergeJoinExec(
+                stream, TpuBroadcastExchangeExec(build), how,
+                stream_keys, build_keys, residual)
+        from ..shuffle.exchange import TpuHashExchangeExec
+        n = self.conf.shuffle_partitions
+        # co-partitioning correctness: murmur3 is type-sensitive, so both
+        # sides must hash the SAME type — promote mismatched key pairs
+        # (Catalyst would have inserted these casts during coercion)
+        pk_stream, pk_build = list(stream_keys), list(build_keys)
+        try:
+            for i, (a, b) in enumerate(zip(pk_stream, pk_build)):
+                if a.dtype != b.dtype:
+                    t = dt.promote(a.dtype, b.dtype)
+                    if t is not None:
+                        pk_stream[i] = a if a.dtype == t else Cast(a, t)
+                        pk_build[i] = b if b.dtype == t else Cast(b, t)
+        except Exception:
+            pass
+        return ph.TpuShuffledJoinExec(
+            TpuHashExchangeExec(stream, n, pk_stream),
+            TpuHashExchangeExec(build, n, pk_build),
+            how, stream_keys, build_keys, residual)
 
 
 def _subtree_ok(meta: PlanMeta) -> bool:

@@ -137,16 +137,30 @@ class TpuExec:
         """Materialize all partitions into one batch (driver collect).
         Partitions drain concurrently as tasks (Spark's task parallelism);
         accumulated results are spillable so N in-flight partitions cannot
-        pin the whole dataset in HBM."""
+        pin the whole dataset in HBM. Query-scoped state (broadcast builds,
+        unread shuffle slices) is released afterwards."""
         from ..exec.spill import SpillableColumnarBatch
         from ..exec.tasks import run_partition_tasks
 
         def drain(pid, part):
             return [SpillableColumnarBatch(b) for b in part if b.num_rows > 0]
 
-        per_part = run_partition_tasks(self.execute(), drain)
-        return concat_spillable(
-            self.schema, [s for lst in per_part for s in lst])
+        try:
+            per_part = run_partition_tasks(self.execute(), drain)
+            return concat_spillable(
+                self.schema, [s for lst in per_part for s in lst])
+        finally:
+            self.cleanup()
+
+    def cleanup(self) -> None:
+        """Release query-scoped resources tree-wide after the final drain
+        (the reference ties these to task/stage completion listeners)."""
+        self._cleanup()
+        for c in self.children:
+            c.cleanup()
+
+    def _cleanup(self) -> None:
+        pass
 
     def _tree_string(self, depth: int = 0) -> str:
         out = "  " * depth + self._node_string()
@@ -940,11 +954,20 @@ class TpuSortMergeJoinExec(TpuExec):
 
     def execute(self) -> List[Partition]:
         # build side = right (stream left), matching Spark BuildRight default.
-        # Accumulated build batches are spillable until the single-batch
-        # concat (the reference holds its build side spillable the same way).
-        build = concat_spillable(
-            self.children[1].schema,
-            accumulate_spillable(self.children[1].execute()))
+        # The build is materialized ONCE as a spillable handle shared by every
+        # stream partition (broadcast semantics: the reference's broadcast
+        # batch is likewise materialized lazily once per executor and held
+        # spillable, GpuBroadcastExchangeExec.scala:238-367); partitions
+        # re-acquire it, so it can spill between partition tasks.
+        from ..exec.spill import SpillableColumnarBatch
+        from ..shuffle.exchange import TpuBroadcastExchangeExec
+        bchild = self.children[1]
+        if isinstance(bchild, TpuBroadcastExchangeExec):
+            handle = bchild.materialize()
+        else:
+            build = concat_spillable(
+                bchild.schema, accumulate_spillable(bchild.execute()))
+            handle = self._build_handle = SpillableColumnarBatch(build)
         stream_parts = self.children[0].execute()
         if self.how == "full":
             # unmatched-build accounting happens inside one join pass, so full
@@ -953,12 +976,20 @@ class TpuSortMergeJoinExec(TpuExec):
             merged = concat_spillable(self.children[0].schema,
                                       accumulate_spillable(stream_parts))
             stream_parts = [iter([merged])]
-        return [self._join_part(p, build) for p in stream_parts]
+        return [self._join_part(p, handle) for p in stream_parts]
 
-    def _join_part(self, part: Partition, build: ColumnarBatch) -> Partition:
+    def _cleanup(self) -> None:
+        h = getattr(self, "_build_handle", None)
+        if h is not None:
+            h.close()
+            self._build_handle = None
+
+    def _join_part(self, part: Partition,
+                   build_handle: "SpillableColumnarBatch") -> Partition:
         # full outer: execute() has already merged the whole stream side into
         # this one partition as a single (possibly empty) batch
         _task_begin()
+        build = build_handle.get_batch()
         bkey_cols = [ex.materialize(e.eval(build), build)
                      for e in self.right_keys]
         for batch in part:
@@ -1007,6 +1038,47 @@ class TpuSortMergeJoinExec(TpuExec):
                                          left_nulls + un_cols, un)
                     self.metrics.inc("numOutputRows", un)
                     yield uout
+
+
+class TpuShuffledJoinExec(TpuSortMergeJoinExec):
+    """Co-partitioned equality join: both children are hash-exchanged on the
+    join keys with the same partition count, so partition i of the stream
+    side joins only partition i of the build side
+    (GpuShuffledHashJoinExec shape, shims/spark300/GpuShuffledHashJoinExec
+    .scala — with sort-merge kernels per DESIGN.md §3). Unlike the broadcast
+    form, the build side is never materialized whole: one build partition at
+    a time. Full outer is correct per partition pair because co-partitioning
+    makes key ownership disjoint."""
+
+    @property
+    def output_partitions(self) -> int:
+        return self.children[0].output_partitions
+
+    def execute(self) -> List[Partition]:
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+        assert len(lparts) == len(rparts), \
+            f"co-partition mismatch: {len(lparts)} vs {len(rparts)}"
+        return [self._join_copart(sp, bp)
+                for sp, bp in zip(lparts, rparts)]
+
+    def _join_copart(self, stream_part: Partition,
+                     build_part: Partition) -> Partition:
+        from ..exec.spill import SpillableColumnarBatch
+        build = concat_spillable(
+            self.children[1].schema,
+            [SpillableColumnarBatch(b) for b in build_part if b.num_rows > 0])
+        handle = SpillableColumnarBatch(build)
+        try:
+            if self.how == "full":
+                merged = concat_spillable(
+                    self.children[0].schema,
+                    [SpillableColumnarBatch(b) for b in stream_part
+                     if b.num_rows > 0])
+                stream_part = iter([merged])
+            yield from self._join_part(stream_part, handle)
+        finally:
+            handle.close()
 
 
 class TpuCrossJoinExec(TpuExec):

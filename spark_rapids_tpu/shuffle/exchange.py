@@ -78,7 +78,7 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def execute(self) -> List[Partition]:
         from ..exec.tasks import run_partition_tasks
-        shuffle = LocalShuffle(self.num_partitions)
+        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
         partitioner = self._make_partitioner()
 
         def map_task(pid, part):
@@ -92,6 +92,16 @@ class TpuShuffleExchangeExec(TpuExec):
         return [shuffle.read(p, self.schema)
                 for p in range(self.num_partitions)]
 
+    def _cleanup(self) -> None:
+        sh = getattr(self, "_shuffle", None)
+        if sh is not None:
+            # release slices never pulled (early-terminating consumers, limit)
+            for pending in sh.slices.values():
+                for s in pending:
+                    if not s._closed:
+                        s.close()
+            self._shuffle = None
+
 
 class TpuHashExchangeExec(TpuShuffleExchangeExec):
     """Hash exchange for aggregate/join key distribution (partial->final)."""
@@ -99,3 +109,49 @@ class TpuHashExchangeExec(TpuShuffleExchangeExec):
     def __init__(self, child: TpuExec, num_partitions: int,
                  keys: List[ex.Expression]):
         super().__init__(child, num_partitions, by=keys)
+
+
+class TpuBroadcastExchangeExec(TpuExec):
+    """Broadcast exchange: collect the child ONCE into a single spillable
+    batch shared by every consumer partition
+    (GpuBroadcastExchangeExec.scala:47,238-367 — async driver collect +
+    lazy device materialization on executors; standalone, the 'broadcast'
+    is one registered spillable buffer re-acquired per stream partition).
+    """
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._handle: Optional[SpillableColumnarBatch] = None
+        self._lock = __import__("threading").Lock()
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def output_partitions(self) -> int:
+        return 1
+
+    def materialize(self) -> SpillableColumnarBatch:
+        """Build (once) and return the shared broadcast handle."""
+        from ..plan.physical import accumulate_spillable, concat_spillable
+        with self._lock:
+            if self._handle is None:
+                with self.metrics.timer("broadcastTime"):
+                    batch = concat_spillable(
+                        self.schema,
+                        accumulate_spillable(self.children[0].execute()))
+                self.metrics.inc("dataSize", batch.device_size_bytes())
+                self._handle = SpillableColumnarBatch(batch)
+            return self._handle
+
+    def execute(self) -> List[Partition]:
+        def gen():
+            yield self.materialize().get_batch()
+        return [gen()]
+
+    def _cleanup(self) -> None:
+        with self._lock:
+            if self._handle is not None:
+                self._handle.close()
+                self._handle = None

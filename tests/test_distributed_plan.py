@@ -123,6 +123,81 @@ def test_two_phase_agg_first_last_falls_back_cleanly():
     assert_tpu_and_cpu_equal(q, approx=1e-9)
 
 
+_FORCE_SHUFFLE = {"spark.rapids.tpu.sql.autoBroadcastJoinThreshold": "-1"}
+
+
+def _join_frames():
+    rng = np.random.default_rng(11)
+    left = pd.DataFrame({
+        "a": rng.integers(0, 50, 400),
+        "x": rng.normal(0, 1, 400)})
+    right = pd.DataFrame({
+        "b": rng.integers(25, 75, 300),       # half-overlapping key range
+        "y": rng.integers(0, 100, 300)})
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_shuffled_join_copartitioned(how):
+    """autoBroadcastJoinThreshold=-1 forces the co-partitioned shuffled join
+    for every join type; results must match the CPU oracle."""
+    left, right = _join_frames()
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        l = s.createDataFrame(left)
+        r = s.createDataFrame(right)
+        return l.join(r, on=(col("a") == col("b")), how=how)
+
+    assert_tpu_and_cpu_equal(q, approx=1e-9, conf=_FORCE_SHUFFLE)
+    from spark_rapids_tpu.plan.physical import TpuShuffledJoinExec
+    assert _find(captured["s"].last_plan(), TpuShuffledJoinExec), \
+        captured["s"].last_plan()
+
+
+def test_broadcast_join_planned_for_small_build():
+    """Small build side -> broadcast exchange appears in the plan."""
+    left, right = _join_frames()
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        return (s.createDataFrame(left)
+                .join(s.createDataFrame(right), on=(col("a") == col("b")),
+                      how="inner"))
+
+    assert_tpu_and_cpu_equal(q, approx=1e-9)
+    from spark_rapids_tpu.shuffle.exchange import TpuBroadcastExchangeExec
+    plan = captured["s"].last_plan()
+    assert _find(plan, TpuBroadcastExchangeExec), plan
+
+
+def test_shuffled_join_null_keys():
+    """NULL keys co-locate through the hash exchange; outer joins emit them
+    with NULL match columns exactly once."""
+    left = pd.DataFrame({"a": [1.0, None, 2.0, None, 3.0],
+                         "x": [1, 2, 3, 4, 5]})
+    right = pd.DataFrame({"b": [2.0, None, 4.0], "y": [10, 20, 30]})
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(left)
+        .join(s.createDataFrame(right), on=(col("a") == col("b")),
+              how="full"),
+        conf=_FORCE_SHUFFLE)
+
+
+def test_shuffled_join_after_repartitioned_agg():
+    """Compose: distributed agg feeding a shuffled join."""
+    left, right = _join_frames()
+    def q(s):
+        l = (s.createDataFrame(left).repartition(4)
+             .groupBy("a").agg(F.sum("x").alias("sx")))
+        return l.join(s.createDataFrame(right),
+                      on=(col("a") == col("b")), how="inner")
+    assert_tpu_and_cpu_equal(q, approx=1e-9, conf=_FORCE_SHUFFLE)
+
+
 def test_perfile_scan_partitions_drive_two_phase(tmp_path):
     """A multi-file PERFILE parquet scan is multi-partition, so the planner
     emits the distributed aggregate without an explicit repartition."""
