@@ -351,6 +351,15 @@ class Overrides:
         if isinstance(p, lp.Join):
             return self._convert_join(p, kids)
         if isinstance(p, lp.Sort):
+            if p.is_global and kids[0].output_partitions > 1:
+                # distributed sort: range-partition on sampled bounds, then
+                # sort each partition independently — partition order + local
+                # order = total order (GpuRangePartitioning + GpuSortExec)
+                from ..shuffle.exchange import TpuRangeExchangeExec
+                n = min(self.conf.shuffle_partitions,
+                        max(2, kids[0].output_partitions))
+                exch = TpuRangeExchangeExec(kids[0], n, p.orders)
+                return ph.TpuSortExec(exch, p.orders, is_global=False)
             return ph.TpuSortExec(kids[0], p.orders, p.is_global)
         if isinstance(p, lp.Limit):
             return ph.TpuLimitExec(kids[0], p.n)

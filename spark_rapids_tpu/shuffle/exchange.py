@@ -51,6 +51,13 @@ class LocalShuffle:
         if batches:
             yield concat_batches(schema, batches)
 
+    def close_pending(self) -> None:
+        """Release slices never pulled (early-terminating consumers)."""
+        for pending in self.slices.values():
+            for s in pending:
+                if not s._closed:
+                    s.close()
+
 
 class TpuShuffleExchangeExec(TpuExec):
     """Repartition(n) / repartition(n, cols) exchange."""
@@ -95,11 +102,7 @@ class TpuShuffleExchangeExec(TpuExec):
     def _cleanup(self) -> None:
         sh = getattr(self, "_shuffle", None)
         if sh is not None:
-            # release slices never pulled (early-terminating consumers, limit)
-            for pending in sh.slices.values():
-                for s in pending:
-                    if not s._closed:
-                        s.close()
+            sh.close_pending()
             self._shuffle = None
 
 
@@ -109,6 +112,84 @@ class TpuHashExchangeExec(TpuShuffleExchangeExec):
     def __init__(self, child: TpuExec, num_partitions: int,
                  keys: List[ex.Expression]):
         super().__init__(child, num_partitions, by=keys)
+
+
+class TpuRangeExchangeExec(TpuExec):
+    """Range exchange for distributed sort (GpuRangePartitioning.scala +
+    GpuRangePartitioner.scala:237): sample the child, compute ordered bound
+    rows, route every row to the partition owning its key range. Partition i
+    of the output holds keys strictly below partition i+1's, so per-partition
+    sorts compose into a total order.
+
+    Two passes over spillable handles: accumulate (bounded residency), sample
+    bounds, then split — the reference samples with a driver-side reservoir;
+    here the sample is a per-batch random gather (~sample_target rows total).
+    """
+
+    SAMPLE_TARGET_PER_PARTITION = 100
+
+    def __init__(self, child: TpuExec, num_partitions: int, orders):
+        super().__init__(child)
+        from ..plan.physical import bind_refs
+        from ..plan import logical as lp
+        self.num_partitions = max(1, num_partitions)
+        self.orders = [lp.SortOrder(bind_refs(o.child, child.schema),
+                                    o.ascending, o.nulls_first)
+                       for o in orders]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def output_partitions(self) -> int:
+        return self.num_partitions
+
+    def _sample(self, batch: ColumnarBatch, k: int) -> ColumnarBatch:
+        import numpy as np
+        import jax.numpy as jnp
+        from ..columnar.column import bucket
+        from ..ops import kernels as K
+        n = batch.num_rows
+        take = min(n, k)
+        rng = np.random.default_rng(42 + n)
+        idx = jnp.asarray(np.sort(rng.choice(n, size=take, replace=False)),
+                          dtype=jnp.int32)
+        live = jnp.arange(len(idx)) < take
+        cols = [K.gather_column(c, idx, out_valid=live)
+                for c in batch.columns]
+        return ColumnarBatch(batch.schema, cols, take)
+
+    def execute(self) -> List[Partition]:
+        from ..plan.physical import accumulate_spillable
+        from .partitioning import RangePartitioner
+        spillables = accumulate_spillable(self.children[0].execute())
+        if not spillables:
+            def empty():
+                return
+                yield
+            return [empty() for _ in range(self.num_partitions)]
+        target = self.SAMPLE_TARGET_PER_PARTITION * self.num_partitions
+        per_batch = max(8, -(-target // len(spillables)))
+        samples = []
+        with self.metrics.timer("sampleTime"):
+            for s in spillables:
+                samples.append(self._sample(s.get_batch(), per_batch))
+        partitioner = RangePartitioner(self.num_partitions, self.orders,
+                                       samples)
+        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
+        with self.metrics.timer("shuffleWriteTime"):
+            for s in spillables:
+                shuffle.write(partitioner, s.get_batch())
+                s.close()
+        return [shuffle.read(p, self.schema)
+                for p in range(self.num_partitions)]
+
+    def _cleanup(self) -> None:
+        sh = getattr(self, "_shuffle", None)
+        if sh is not None:
+            sh.close_pending()
+            self._shuffle = None
 
 
 class TpuBroadcastExchangeExec(TpuExec):
