@@ -313,6 +313,22 @@ READER_TYPE = _conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
 ).string_conf.check(lambda v: v in ("PERFILE", "COALESCING", "MULTITHREADED")
                     ).create_with_default("COALESCING")
 
+MESH_ENABLED = _conf("spark.rapids.tpu.sql.mesh.enabled").doc(
+    "SPMD execution over a jax device mesh: 'auto' (multi-device accelerator "
+    "platforms), 'true' (force, incl. virtual CPU meshes for tests), 'false'. "
+    "Routes supported group-by/join/sort plans through fused all_to_all "
+    "pipelines (parallel/mesh.py) instead of the host exchange"
+).string_conf.check(
+    lambda v: str(v).lower() in ("auto", "true", "false", "1", "0")
+).create_with_default("auto")
+
+MESH_MAX_STAGE_BYTES = _conf("spark.rapids.tpu.sql.mesh.maxStageBytes").doc(
+    "Upper bound on the estimated input size of a mesh-routed stage: the "
+    "SPMD pipeline stages the whole input as one host batch and sizes "
+    "receive windows at workers*cap, so inputs above this keep the "
+    "spillable host exchange path with bounded residency"
+).bytes_conf.create_with_default(2 * 1024 * 1024 * 1024)
+
 MATMUL_AGG = _conf("spark.rapids.tpu.sql.agg.matmul.enabled").doc(
     "MXU one-hot-matmul segment reductions for group-by sum/count/avg: "
     "'auto' (accelerator only), 'true', or 'false'. Float sums differ from "

@@ -41,6 +41,68 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n]), ("workers",))
 
 
+def maybe_mesh(conf=None) -> Optional[Mesh]:
+    """The active device mesh per ``spark.rapids.tpu.sql.mesh.enabled``:
+    'true' forces SPMD execution over every visible device (tests force a
+    virtual CPU mesh this way) and propagates any mesh-construction failure;
+    'auto' enables it on multi-device accelerator platforms, degrading to
+    None on any failure; 'false' disables. Unknown values are rejected.
+    Planner entry point."""
+    from .. import config as cfg
+    conf = conf or cfg.TpuConf()
+    mode = str(conf.get(cfg.MESH_ENABLED)).lower()
+    if mode in ("false", "0"):
+        return None
+    if mode not in ("true", "1", "auto"):
+        raise ValueError(
+            f"invalid {cfg.MESH_ENABLED.key}: {mode!r} "
+            "(expected true/false/auto)")
+    if mode in ("true", "1"):
+        devs = jax.devices()
+        if len(devs) < 2:
+            raise RuntimeError(
+                f"{cfg.MESH_ENABLED.key}=true but only {len(devs)} device(s) "
+                "are visible — SPMD execution needs a multi-device mesh")
+        return make_mesh()
+    try:
+        devs = jax.devices()
+        if len(devs) < 2 or devs[0].platform == "cpu":
+            return None
+        return make_mesh()
+    except Exception:
+        return None
+
+
+# jitted SPMD stage cache: re-tracing per query would pay full XLA
+# compilation each time; keys repeat because caps are bucketed
+_FN_CACHE: Dict[tuple, Any] = {}
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    return (int(mesh.devices.size),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def _cached_fn(key: tuple, builder):
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fn = _FN_CACHE[key] = builder()
+    return fn
+
+
+def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+    except ImportError:          # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:            # older jax spelling
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 # ---------------------------------------------------------------------------
 # In-jit exchange: bucket-by-hash + all_to_all (the ICI shuffle data plane)
 # ---------------------------------------------------------------------------
@@ -173,11 +235,6 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
     so a smaller receive window would silently drop rows.
     """
     n = mesh.devices.size
-    try:
-        from jax import shard_map
-    except ImportError:          # older jax
-        from jax.experimental.shard_map import shard_map
-
     plan = _update_plan(agg_ops, val_dtypes)
     partial_dtypes = [t for cols in plan for (_op, t) in cols]
     # merge phase: counts and avg partials merge by SUM; everything else
@@ -247,15 +304,222 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
     in_specs = tuple([P("workers")] * (
         sum(3 if t == dt.STRING else 2 for t in key_dtypes) +
         sum(3 if t == dt.STRING else 2 for t in val_dtypes) + 1))
-    out_count = (sum(3 if t == dt.STRING else 2 for t in key_dtypes))
+    return jax.jit(_shard_map(per_worker, mesh, in_specs, P("workers")))
 
-    try:
-        smapped = shard_map(per_worker, mesh=mesh, in_specs=in_specs,
-                            out_specs=P("workers"), check_vma=False)
-    except TypeError:            # older jax spelling
-        smapped = shard_map(per_worker, mesh=mesh, in_specs=in_specs,
-                            out_specs=P("workers"), check_rep=False)
-    return jax.jit(smapped)
+
+# ---------------------------------------------------------------------------
+# Distributed co-partition exchange (the SPMD shuffled-join data plane)
+# ---------------------------------------------------------------------------
+
+def copartition_exchange_fn(mesh: Mesh, col_dtypes: Sequence[dt.DType],
+                            key_positions: Sequence[int], cap: int):
+    """Jitted row-level hash exchange over ICI: every worker buckets its rows
+    by ``pmod(murmur3(keys), n)`` and one ``all_to_all`` delivers them to the
+    owning worker. This is GpuShuffledHashJoinExec's exchange
+    (GpuShuffleExchangeExec + GpuHashPartitioning) collapsed into one XLA
+    computation per side; the per-worker join then runs on co-partitioned
+    shards. Receive windows are ``n * cap`` so key skew cannot drop rows.
+    """
+    n = mesh.devices.size
+    out_cap = n * cap
+    n_arrays = sum(3 if t == dt.STRING else 2 for t in col_dtypes)
+
+    def per_worker(*arrays_and_count):
+        *arrays, local_n = arrays_and_count
+        arrays = [a[0] for a in arrays]
+        local_n = local_n[0]
+        cols = _rebuild_columns(col_dtypes, arrays)
+        key_cols = [cols[i] for i in key_positions]
+        live = jnp.arange(cap) < local_n
+        pids = jnp.mod(jnp.mod(murmur3_batch(key_cols, cap), n) + n, n)
+        payload = _column_arrays(cols)
+        stacked, counts = bucket_rows_for_exchange(payload, pids, live, n, cap)
+        moved, moved_counts = exchange(stacked, counts, "workers")
+        flat, recv_n = flatten_received(moved, moved_counts, out_cap)
+        return tuple(a[None] for a in flat) + (recv_n[None],)
+
+    in_specs = tuple([P("workers")] * (n_arrays + 1))
+    return jax.jit(_shard_map(per_worker, mesh, in_specs, P("workers")))
+
+
+def _stack_shards(batches: List[ColumnarBatch], cap: int) -> List[jnp.ndarray]:
+    """Stack per-worker batches (rebucketed to a common cap) on a leading
+    workers axis, one stacked array per underlying column array."""
+    per_worker = []
+    for b in batches:
+        arrays = []
+        for c in b.columns:
+            if c.capacity != cap:
+                c = K.rebucket_column(c, b.num_rows, cap)
+            arrays.extend(c.arrays())
+        per_worker.append(arrays)
+    return [jnp.stack([pw[i] for pw in per_worker])
+            for i in range(len(per_worker[0]))]
+
+
+def run_copartition_exchange(mesh: Mesh, batches: List[ColumnarBatch],
+                             key_positions: Sequence[int]
+                             ) -> List[ColumnarBatch]:
+    """Host driver for one side of an SPMD shuffled join: returns per-worker
+    co-partitioned batches (same key -> same worker index)."""
+    n = mesh.devices.size
+    assert len(batches) == n, "one shard per worker"
+    cap = max(b.capacity for b in batches)
+    col_dtypes = [c.dtype for c in batches[0].columns]
+    stacked = _stack_shards(batches, cap)
+    counts = jnp.asarray([b.num_rows for b in batches], dtype=jnp.int32)
+    fn = _cached_fn(
+        ("copart", _mesh_key(mesh), tuple(col_dtypes),
+         tuple(key_positions), cap),
+        lambda: copartition_exchange_fn(mesh, col_dtypes, key_positions, cap))
+    outs = fn(*stacked, counts)
+    schema = batches[0].schema
+    results = []
+    for w in range(n):
+        arrays = [o[w] for o in outs[:-1]]
+        recv_n = int(outs[-1][w])
+        cols = _rebuild_columns(col_dtypes, arrays)
+        results.append(ColumnarBatch(schema, cols, recv_n))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Distributed sort: sample -> all_gather bounds -> all_to_all -> local sort,
+# ALL inside one XLA computation
+# ---------------------------------------------------------------------------
+
+_SAMPLE_PER_WORKER = 64
+
+
+def _lex_lt(a_words: List[jnp.ndarray], b_words: List[jnp.ndarray]
+            ) -> jnp.ndarray:
+    """Lexicographic a < b over parallel word lists (mixed uint/float words
+    from kernels._key_arrays are order-correct under elementwise compare)."""
+    lt = jnp.zeros(a_words[0].shape, dtype=jnp.bool_)
+    eq = jnp.ones(a_words[0].shape, dtype=jnp.bool_)
+    for aw, bw in zip(a_words, b_words):
+        lt = lt | (eq & (aw < bw))
+        eq = eq & (aw == bw)
+    return lt
+
+
+def distributed_sort_fn(mesh: Mesh, col_dtypes: Sequence[dt.DType],
+                        key_positions: Sequence[int],
+                        ascending: Sequence[bool],
+                        nulls_first: Sequence[bool], cap: int):
+    """Build the jitted SPMD global sort over ``mesh``.
+
+    Per worker, in ONE XLA computation (the reference needs a driver-side
+    reservoir sample plus a full exchange round-trip —
+    GpuRangePartitioner.scala:237):
+
+      1. encode sort keys into order-preserving words (kernels._key_arrays)
+      2. sample evenly-spaced live rows; ``all_gather`` samples over ICI
+      3. every worker sorts the identical global sample and picks the same
+         n-1 bound rows -> partition id per row by lexicographic rank
+      4. ``all_to_all`` routes rows to their range owner (n*cap receive
+         window: worst-case skew lands everything on one worker)
+      5. local lexsort of the received shard
+
+    Worker w's output is the w-th global key range, locally sorted, so
+    host-side concatenation in worker order is the total order.
+    """
+    n = mesh.devices.size
+    out_cap = n * cap
+    n_arrays = sum(3 if t == dt.STRING else 2 for t in col_dtypes)
+    s = _SAMPLE_PER_WORKER
+
+    def encode(cols: List[Column]) -> List[jnp.ndarray]:
+        words: List[jnp.ndarray] = []
+        for pos, asc, nf in zip(key_positions, ascending, nulls_first):
+            words.extend(K._key_arrays(K.SortKey(cols[pos], asc, nf)))
+        return words
+
+    def per_worker(*arrays_and_count):
+        *arrays, local_n = arrays_and_count
+        arrays = [a[0] for a in arrays]
+        local_n = local_n[0]
+        cols = _rebuild_columns(col_dtypes, arrays)
+        words = encode(cols)
+
+        # 2. sample s evenly-spaced live rows (invalid when local_n == 0)
+        pick = (jnp.arange(s) * jnp.maximum(local_n, 1)) // s
+        pick = jnp.clip(pick, 0, cap - 1).astype(jnp.int32)
+        s_valid = (jnp.arange(s) < local_n) & (local_n > 0)
+        s_words = [w[pick] for w in words]
+        g_words = [jax.lax.all_gather(w, "workers", tiled=True)
+                   for w in s_words]
+        g_valid = jax.lax.all_gather(s_valid, "workers", tiled=True)
+
+        # 3. identical global-sample sort on every worker -> bound rows
+        order = jnp.lexsort(tuple(reversed(
+            [(~g_valid).astype(jnp.uint8)] + g_words)))
+        total = jnp.sum(g_valid)
+        b_words = []
+        bidx = []
+        for w_i in range(n - 1):
+            gi = jnp.clip(((w_i + 1) * total) // n, 0, n * s - 1)
+            bidx.append(order[gi])
+        for w in g_words:
+            b_words.append(jnp.stack([w[i] for i in bidx]) if bidx
+                           else jnp.zeros((0,), w.dtype))
+
+        # partition id = count of bounds strictly below the row's key
+        pid = jnp.zeros(cap, dtype=jnp.int32)
+        for w_i in range(n - 1):
+            bw = [jnp.broadcast_to(bwords[w_i], (cap,))
+                  for bwords in b_words]
+            pid = pid + _lex_lt(bw, words).astype(jnp.int32)
+        pid = jnp.clip(pid, 0, n - 1)
+
+        # 4. route rows to their range owner
+        live = jnp.arange(cap) < local_n
+        payload = _column_arrays(cols)
+        stacked, counts = bucket_rows_for_exchange(payload, pid, live, n, cap)
+        moved, moved_counts = exchange(stacked, counts, "workers")
+        flat, recv_n = flatten_received(moved, moved_counts, out_cap)
+
+        # 5. local sort of the received shard
+        recv_cols = _rebuild_columns(col_dtypes, flat)
+        keys = [K.SortKey(recv_cols[pos], asc, nf)
+                for pos, asc, nf in zip(key_positions, ascending,
+                                        nulls_first)]
+        idx = K.sort_indices(keys, recv_n, out_cap)
+        sorted_cols = [K.gather_column(c, idx) for c in recv_cols]
+        out = _column_arrays(sorted_cols) + [recv_n]
+        return tuple(a[None] for a in out)
+
+    in_specs = tuple([P("workers")] * (n_arrays + 1))
+    return jax.jit(_shard_map(per_worker, mesh, in_specs, P("workers")))
+
+
+def run_distributed_sort(mesh: Mesh, batches: List[ColumnarBatch],
+                         key_positions: Sequence[int],
+                         ascending: Sequence[bool],
+                         nulls_first: Sequence[bool]) -> List[ColumnarBatch]:
+    """Host driver: shard batches across workers, run the fused SPMD sort,
+    return per-worker sorted range shards (concatenation = total order)."""
+    n = mesh.devices.size
+    assert len(batches) == n, "one shard per worker"
+    cap = max(b.capacity for b in batches)
+    col_dtypes = [c.dtype for c in batches[0].columns]
+    stacked = _stack_shards(batches, cap)
+    counts = jnp.asarray([b.num_rows for b in batches], dtype=jnp.int32)
+    fn = _cached_fn(
+        ("sort", _mesh_key(mesh), tuple(col_dtypes), tuple(key_positions),
+         tuple(ascending), tuple(nulls_first), cap),
+        lambda: distributed_sort_fn(mesh, col_dtypes, key_positions,
+                                    tuple(ascending), tuple(nulls_first),
+                                    cap))
+    outs = fn(*stacked, counts)
+    schema = batches[0].schema
+    results = []
+    for w in range(n):
+        arrays = [o[w] for o in outs[:-1]]
+        recv_n = int(outs[-1][w])
+        cols = _rebuild_columns(col_dtypes, arrays)
+        results.append(ColumnarBatch(schema, cols, recv_n))
+    return results
 
 
 def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
@@ -287,7 +551,11 @@ def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
     stacked = stack(arrays_of)
     counts = jnp.asarray([b.num_rows for b in batches], dtype=jnp.int32)
 
-    fn = distributed_groupby_fn(mesh, key_dtypes, val_dtypes, agg_ops, cap)
+    fn = _cached_fn(
+        ("groupby", _mesh_key(mesh), tuple(key_dtypes), tuple(val_dtypes),
+         tuple(agg_ops), cap),
+        lambda: distributed_groupby_fn(mesh, key_dtypes, val_dtypes,
+                                       agg_ops, cap))
     outs = fn(*stacked, counts)
 
     # unpack per-worker results

@@ -343,14 +343,23 @@ class Overrides:
                           lambda x: isinstance(x, lp.AggregateExpression))]
             if any(l.distinct for l in leaves):
                 return self._convert_distinct_agg(p, kids[0], leaves)
-            return self._make_aggregate(kids[0], p.grouping, p.aggregate_exprs)
+            return self._make_aggregate(kids[0], p.grouping, p.aggregate_exprs,
+                                         p.children[0].stats_bytes())
         if isinstance(p, lp.Distinct):
             grouping = [ex.ColumnRef(n).resolve(p.children[0].schema)
                         for n in p.children[0].schema.names()]
-            return self._make_aggregate(kids[0], grouping, list(grouping))
+            return self._make_aggregate(kids[0], grouping, list(grouping),
+                                         p.children[0].stats_bytes())
         if isinstance(p, lp.Join):
             return self._convert_join(p, kids)
         if isinstance(p, lp.Sort):
+            mesh = self._mesh_for_stage(p.children[0].stats_bytes()) \
+                if p.is_global else None
+            if mesh is not None:
+                # fused SPMD sort: sample -> bounds -> all_to_all -> local
+                # sort in one XLA computation (parallel/mesh.py)
+                from ..parallel.mesh_exec import TpuMeshSortExec
+                return TpuMeshSortExec(kids[0], p.orders, mesh)
             if p.is_global and kids[0].output_partitions > 1:
                 # distributed sort: range-partition on sampled bounds, then
                 # sort each partition independently — partition order + local
@@ -380,15 +389,72 @@ class Overrides:
             return TpuWriteFileExec(kids[0], p)
         raise NotImplementedError(f"no TPU exec for {p.name}")
 
+    def _mesh(self):
+        """Active SPMD mesh, if mesh execution is enabled (cached).
+        maybe_mesh degrades silently only in 'auto' mode; a forced 'true'
+        propagates construction failures instead of quietly planning the
+        host path."""
+        if not hasattr(self, "_mesh_cache"):
+            from ..parallel.mesh import maybe_mesh
+            self._mesh_cache = maybe_mesh(self.conf)
+        return self._mesh_cache
+
+    def _mesh_for_stage(self, *stats: int):
+        """Mesh for a stage whose inputs are estimated at ``stats`` bytes —
+        None above mesh.maxStageBytes (the SPMD stage materializes its whole
+        input host-side and sizes receive windows at workers*cap, so huge
+        stages keep the bounded-residency host exchange)."""
+        mesh = self._mesh()
+        if mesh is None:
+            return None
+        limit = int(self.conf.get(cfg.MESH_MAX_STAGE_BYTES))
+        if sum(stats) > limit:
+            return None
+        return mesh
+
+    def _try_mesh_aggregate(self, child: ph.TpuExec,
+                            grouping: List[ex.Expression],
+                            outputs: List[ex.Expression],
+                            stats_bytes: int) -> Optional[ph.TpuExec]:
+        """Route a supported group-by to the fused SPMD pipeline: keyed,
+        non-distinct, each output either a grouping column or a bare
+        sum/count/avg/min/max leaf (first/last stay host-side — their
+        distributed result would depend on shard order)."""
+        mesh = self._mesh_for_stage(stats_bytes)
+        if mesh is None or not grouping:
+            return None
+        from ..parallel import mesh_exec as me
+        for e in outputs:
+            inner = e.children[0] if isinstance(e, ex.Alias) else e
+            if isinstance(inner, lp.AggregateExpression):
+                if inner.distinct or inner.op not in me.MESH_AGG_OPS:
+                    return None
+                if inner.children and inner.children[0].dtype == dt.STRING \
+                        and inner.op not in ("count",):
+                    return None
+            else:
+                try:
+                    me._grouping_index(inner, grouping)
+                except ValueError:
+                    return None
+        return me.TpuMeshGroupByExec(child, grouping, outputs, mesh)
+
     def _make_aggregate(self, child: ph.TpuExec,
                         grouping: List[ex.Expression],
-                        outputs: List[ex.Expression]) -> ph.TpuExec:
+                        outputs: List[ex.Expression],
+                        stats_bytes: int) -> ph.TpuExec:
         """Aggregate planning (the reference's replaceMode two-phase planning,
         aggregate.scala:77-170): a multi-partition child gets
         partial(update) -> hash exchange on the grouping keys -> final(merge)
         with the final merge running per exchange partition; a single
         partition keeps the fused complete mode (the transition elision the
-        reference performs when the distribution is already satisfied)."""
+        reference performs when the distribution is already satisfied).
+        With an active mesh, supported shapes fuse the whole
+        partial -> exchange -> final pipeline into one SPMD computation."""
+        mesh_exec = self._try_mesh_aggregate(child, grouping, outputs,
+                                             stats_bytes)
+        if mesh_exec is not None:
+            return mesh_exec
         if child.output_partitions > 1:
             from ..shuffle.exchange import (TpuHashExchangeExec,
                                             TpuShuffleExchangeExec)
@@ -444,7 +510,8 @@ class Overrides:
                         l.op, l.children[0] if l.children else None,
                         ignore_nulls=l.ignore_nulls), f"_nd{i}"))
                 nd_parts[i] = [f"_nd{i}"]
-        inner = self._make_aggregate(child, inner_grouping, inner_outputs)
+        inner = self._make_aggregate(child, inner_grouping, inner_outputs,
+                                     p.children[0].stats_bytes())
 
         def _ref(name: str) -> ex.ColumnRef:
             return ex.ColumnRef(name).resolve(inner.schema)
@@ -490,7 +557,8 @@ class Overrides:
         outer_outputs = [
             ex.Alias(rewrite(e), ex.output_name(e, i))
             for i, e in enumerate(p.aggregate_exprs)]
-        return self._make_aggregate(inner, outer_grouping, outer_outputs)
+        return self._make_aggregate(inner, outer_grouping, outer_outputs,
+                                    p.children[0].stats_bytes())
 
     def _convert_join(self, p: lp.Join, kids: List[ph.TpuExec]) -> ph.TpuExec:
         from ..cpu.engine import _extract_equi_keys
@@ -508,15 +576,17 @@ class Overrides:
             # reorder output columns (GpuHashJoin.scala:112-132 remap)
             inner = self._plan_equi_join(
                 right, left, "left", rk, lk, None,
-                build_stats=p.children[0].stats_bytes())
+                build_stats=p.children[0].stats_bytes(),
+                stream_stats=p.children[1].stats_bytes())
             return _ReorderExec(inner, p.schema,
                                 len(rnames), len(lnames))
         return self._plan_equi_join(left, right, how, lk, rk, residual,
-                                    build_stats=p.children[1].stats_bytes())
+                                    build_stats=p.children[1].stats_bytes(),
+                                    stream_stats=p.children[0].stats_bytes())
 
     def _plan_equi_join(self, stream: ph.TpuExec, build: ph.TpuExec, how: str,
                         stream_keys, build_keys, residual,
-                        build_stats: int) -> ph.TpuExec:
+                        build_stats: int, stream_stats: int) -> ph.TpuExec:
         """Join strategy selection (GpuBroadcastJoinMeta + Spark's
         autoBroadcastJoinThreshold): a build side at or under the threshold
         broadcasts — materialized once as a spillable, reused by every stream
@@ -543,6 +613,13 @@ class Overrides:
                         pk_build[i] = b if b.dtype == t else Cast(b, t)
         except Exception:
             pass
+        mesh = self._mesh_for_stage(build_stats, stream_stats)
+        if mesh is not None:
+            # SPMD co-partition: one fused all_to_all per side over ICI
+            from ..parallel.mesh_exec import TpuMeshJoinExec
+            return TpuMeshJoinExec(stream, build, how, stream_keys,
+                                   build_keys, residual, mesh,
+                                   pk_stream, pk_build)
         return ph.TpuShuffledJoinExec(
             TpuHashExchangeExec(stream, n, pk_stream),
             TpuHashExchangeExec(build, n, pk_build),
