@@ -1,0 +1,370 @@
+"""Multi-host shuffle transport: TCP transfer server + fetching client.
+
+Reference mapping (SURVEY.md §2.8):
+- ``RapidsShuffleServer.scala:67-671`` -> :class:`ShuffleServer` — serves
+  metadata and streams table bytes through fixed-size send windows
+  (``BufferSendState`` windowing -> CRC-tagged chunk frames).
+- ``RapidsShuffleClient.scala:480-612`` -> :class:`ShuffleClient` — fetch
+  protocol: MetadataRequest -> MetadataResponse -> TransferRequest(s) with
+  inflight-byte throttling (``RapidsShuffleTransport.scala:413-435``),
+  chunk reassembly, batch reconstruction.
+- ``RapidsShuffleIterator.scala:49-365`` -> :meth:`ShuffleClient.fetch`'s
+  retry loop — transport errors surface as :class:`ShuffleFetchError` after
+  bounded retries (the reference throws RapidsShuffleFetchFailedException to
+  trigger Spark's stage retry; standalone, the caller decides).
+
+The UCX/RDMA plane of the reference maps to ICI collectives (parallel/mesh);
+this TCP plane is the DCN fallback for inter-host fetches, stragglers, and
+elastic retry, exactly the split SURVEY.md §5 calls for.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column
+from . import wire
+from .wire import (ERROR, META_REQ, META_RESP, XFER_CHUNK, XFER_DONE,
+                   XFER_REQ, ArrayDesc, BufferDesc, FrameReader, encode_frame)
+
+
+class ShuffleFetchError(RuntimeError):
+    """Fetch failed after retries (RapidsShuffleFetchFailedException analog:
+    the caller maps this to a stage retry / recompute)."""
+
+
+# ---------------------------------------------------------------------------
+# Server-side store
+# ---------------------------------------------------------------------------
+
+class ShuffleStore:
+    """(shuffle_id, reduce_id) -> registered host buffers with metadata
+    (ShuffleBufferCatalog analog, host-tier: the transfer server serves
+    bytes from host staging, never touching the device)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._next_id = 1
+        self._buffers: Dict[int, Tuple[BufferDesc, List[np.ndarray]]] = {}
+        self._by_partition: Dict[Tuple[int, int], List[int]] = {}
+
+    def register_batch(self, shuffle_id: int, reduce_id: int,
+                       batch: ColumnarBatch) -> int:
+        arrays = [np.asarray(a) for c in batch.columns for a in c.arrays()]
+        descs = [ArrayDesc(str(a.dtype), a.shape, a.nbytes) for a in arrays]
+        with self._mu:
+            bid = self._next_id
+            self._next_id += 1
+            desc = BufferDesc(
+                bid, shuffle_id, reduce_id, batch.num_rows,
+                [f.name for f in batch.schema],
+                [f.dtype.name for f in batch.schema], descs)
+            self._buffers[bid] = (desc, arrays)
+            self._by_partition.setdefault((shuffle_id, reduce_id),
+                                          []).append(bid)
+        return bid
+
+    def metas(self, shuffle_id: int, reduce_ids: List[int]
+              ) -> List[BufferDesc]:
+        with self._mu:
+            out = []
+            for rid in reduce_ids:
+                for bid in self._by_partition.get((shuffle_id, rid), []):
+                    out.append(self._buffers[bid][0])
+            return out
+
+    def payload(self, buffer_id: int) -> Tuple[BufferDesc, bytes]:
+        with self._mu:
+            desc, arrays = self._buffers[buffer_id]
+        return desc, b"".join(a.tobytes() for a in arrays)
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._mu:
+            gone = [k for k in self._by_partition if k[0] == shuffle_id]
+            for k in gone:
+                for bid in self._by_partition.pop(k):
+                    self._buffers.pop(bid, None)
+
+
+# ---------------------------------------------------------------------------
+# Connections (socket + in-process mock share this surface)
+# ---------------------------------------------------------------------------
+
+class Connection:
+    """Byte-stream connection surface (ClientConnection/ServerConnection
+    analog, RapidsShuffleTransport.scala:165-370)."""
+
+    def send(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_exact(self, n: int) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class SocketConnection(Connection):
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def send(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    def read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            out += chunk
+        return out
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class ShuffleServer:
+    """Serves shuffle metadata + windowed buffer streams over TCP."""
+
+    def __init__(self, store: ShuffleStore, host: str = "127.0.0.1",
+                 port: int = 0, chunk_bytes: int = wire.DEFAULT_CHUNK_BYTES):
+        self.store = store
+        self.chunk_bytes = chunk_bytes
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ShuffleServer":
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                sock, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self.handle_connection,
+                                 args=(SocketConnection(sock),), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def handle_connection(self, conn: Connection) -> None:
+        """One request/response session (the server handler loop,
+        RapidsShuffleServer.scala:97-167). Public so the mock rig can drive
+        it directly over an in-process connection."""
+        reader = FrameReader(conn.read_exact)
+        try:
+            while True:
+                msg_type, header, _payload = reader.next_frame()
+                if msg_type == META_REQ:
+                    metas = self.store.metas(header["shuffle_id"],
+                                             header["reduce_ids"])
+                    conn.send(encode_frame(META_RESP, {
+                        "buffers": [m.to_json() for m in metas]}))
+                elif msg_type == XFER_REQ:
+                    self._send_buffers(conn, header["buffer_ids"])
+                else:
+                    conn.send(encode_frame(
+                        ERROR, {"message": f"bad msg {msg_type}"}))
+                    return
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def _send_buffers(self, conn: Connection, buffer_ids: List[int]) -> None:
+        """Stream each buffer through fixed-size chunk windows
+        (BufferSendState.next windowing)."""
+        for bid in buffer_ids:
+            try:
+                desc, payload = self.store.payload(bid)
+            except KeyError:
+                conn.send(encode_frame(ERROR,
+                                       {"message": f"unknown buffer {bid}"}))
+                return
+            ranges = wire.chunk_ranges(len(payload), self.chunk_bytes)
+            for seq, (off, ln) in enumerate(ranges):
+                body = payload[off:off + ln]
+                conn.send(encode_frame(XFER_CHUNK, {
+                    "buffer_id": bid, "seq": seq, "n_chunks": len(ranges),
+                    "offset": off, "crc32": wire.chunk_crc(body)}, body))
+        conn.send(encode_frame(XFER_DONE, {"buffer_ids": buffer_ids}))
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+class ShuffleClient:
+    """Fetches shuffle partitions from a peer transfer server.
+
+    Inflight throttling: transfer requests are issued so at most
+    ``max_inflight_bytes`` of advertised buffer bytes are outstanding at a
+    time (RapidsShuffleTransport throttle, :413-435) — a pull window that
+    bounds receive-side memory no matter how large the partition is.
+    Retries: each fetch attempt uses a fresh connection; CRC mismatches and
+    connection failures retry up to ``max_retries`` with backoff.
+    """
+
+    def __init__(self, connect: Callable[[], Connection],
+                 max_inflight_bytes: int = 8 << 20,
+                 max_retries: int = 3, retry_backoff_s: float = 0.05):
+        self._connect = connect
+        self.max_inflight_bytes = max_inflight_bytes
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.metrics: Dict[str, int] = {"retries": 0, "bytes_fetched": 0,
+                                        "chunks": 0}
+
+    @staticmethod
+    def for_address(host: str, port: int, **kw) -> "ShuffleClient":
+        def connect():
+            sock = socket.create_connection((host, port), timeout=10)
+            return SocketConnection(sock)
+        return ShuffleClient(connect, **kw)
+
+    # -- public API ----------------------------------------------------------
+    def fetch(self, shuffle_id: int, reduce_ids: List[int]
+              ) -> List[ColumnarBatch]:
+        """Fetch all batches of the given reduce partitions (doFetch,
+        RapidsShuffleClient.scala:480)."""
+        last_err: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.metrics["retries"] += 1
+                time.sleep(self.retry_backoff_s * attempt)
+            try:
+                return self._fetch_once(shuffle_id, reduce_ids)
+            except (ConnectionError, OSError, ValueError) as e:
+                last_err = e
+        raise ShuffleFetchError(
+            f"shuffle {shuffle_id} partitions {reduce_ids} failed after "
+            f"{self.max_retries + 1} attempts: {last_err}") from last_err
+
+    # -- one attempt ---------------------------------------------------------
+    def _fetch_once(self, shuffle_id: int, reduce_ids: List[int]
+                    ) -> List[ColumnarBatch]:
+        conn = self._connect()
+        try:
+            conn.send(encode_frame(META_REQ, {"shuffle_id": shuffle_id,
+                                              "reduce_ids": reduce_ids}))
+            reader = FrameReader(conn.read_exact)
+            msg_type, header, _ = reader.next_frame()
+            if msg_type == ERROR:
+                raise ConnectionError(header.get("message", "server error"))
+            assert msg_type == META_RESP, msg_type
+            metas = [BufferDesc.from_json(d) for d in header["buffers"]]
+
+            # pending transfer queue with inflight-byte throttling
+            pending = list(metas)
+            inflight: Dict[int, BufferDesc] = {}
+            inflight_bytes = 0
+            received: Dict[int, bytearray] = {}
+            seen_chunks: Dict[int, int] = {}
+            done: List[ColumnarBatch] = []
+
+            def issue():
+                nonlocal inflight_bytes
+                batch_ids = []
+                while pending and (
+                        not inflight or
+                        inflight_bytes + pending[0].total_bytes
+                        <= self.max_inflight_bytes):
+                    m = pending.pop(0)
+                    inflight[m.buffer_id] = m
+                    inflight_bytes += m.total_bytes
+                    batch_ids.append(m.buffer_id)
+                if batch_ids:
+                    conn.send(encode_frame(XFER_REQ,
+                                           {"buffer_ids": batch_ids}))
+
+            issue()
+            while inflight or pending:
+                msg_type, header, payload = reader.next_frame()
+                if msg_type == ERROR:
+                    raise ConnectionError(header.get("message"))
+                if msg_type == XFER_DONE:
+                    continue
+                assert msg_type == XFER_CHUNK, msg_type
+                bid = header["buffer_id"]
+                if wire.chunk_crc(payload) != header["crc32"]:
+                    raise ValueError(f"chunk crc mismatch for buffer {bid}")
+                buf = received.setdefault(
+                    bid, bytearray(inflight[bid].total_bytes))
+                buf[header["offset"]:header["offset"] + len(payload)] = \
+                    payload
+                self.metrics["chunks"] += 1
+                seen_chunks[bid] = seen_chunks.get(bid, 0) + 1
+                if seen_chunks[bid] == header["n_chunks"]:
+                    m = inflight.pop(bid)
+                    inflight_bytes -= m.total_bytes
+                    self.metrics["bytes_fetched"] += m.total_bytes
+                    done.append(_rebuild_batch(m, bytes(received.pop(bid))))
+                    issue()
+            return done
+        finally:
+            conn.close()
+
+
+def _rebuild_batch(meta: BufferDesc, payload: bytes) -> ColumnarBatch:
+    """Reconstruct a ColumnarBatch from wire bytes (getBatchFromMeta,
+    MetaUtils.scala:33-241)."""
+    arrays: List[np.ndarray] = []
+    off = 0
+    for d in meta.arrays:
+        a = np.frombuffer(payload, dtype=np.dtype(d.dtype),
+                          count=d.nbytes // np.dtype(d.dtype).itemsize,
+                          offset=off).reshape(d.shape)
+        arrays.append(a)
+        off += d.nbytes
+    fields = [dt.Field(n, dt.of(t))
+              for n, t in zip(meta.field_names, meta.field_dtypes)]
+    schema = dt.Schema(fields)
+    import jax.numpy as jnp
+    cols: List[Column] = []
+    i = 0
+    for f in fields:
+        if f.dtype == dt.STRING:
+            cols.append(Column(f.dtype, jnp.asarray(arrays[i]),
+                               jnp.asarray(arrays[i + 1]),
+                               jnp.asarray(arrays[i + 2])))
+            i += 3
+        else:
+            cols.append(Column(f.dtype, jnp.asarray(arrays[i]),
+                               jnp.asarray(arrays[i + 1])))
+            i += 2
+    return ColumnarBatch(schema, cols, meta.num_rows)

@@ -1,0 +1,125 @@
+"""Shuffle wire format: the control/data plane framing for the DCN (TCP)
+transfer server.
+
+Reference: the flatbuffer messages of ``sql-plugin/src/main/format/*.fbs``
+(TableMeta / MetadataRequest / MetadataResponse / TransferRequest) driven by
+``RapidsShuffleClient.scala:376-737`` and ``RapidsShuffleServer.scala:67-671``.
+TPU-standalone design: the control plane is length-prefixed JSON (the role
+flatbuffers plays — small, structural, versioned), the data plane is raw
+array bytes in fixed-size CRC-tagged chunks (the bounce-buffer windows of
+``WindowedBlockIterator``/``BufferSendState``, moved from RDMA registration
+windows to TCP frames).
+
+Frame layout (all little-endian):
+    u32 total_len | u8 msg_type | u32 header_len | header(JSON) | payload
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# message types
+META_REQ = 1       # {shuffle_id, reduce_ids[]}
+META_RESP = 2      # {buffers: [BufferDesc...]}
+XFER_REQ = 3       # {buffer_ids[]}
+XFER_CHUNK = 4     # {buffer_id, seq, n_chunks, offset, crc32} + payload
+XFER_DONE = 5      # {buffer_ids[]}
+ERROR = 6          # {message}
+
+_HDR = struct.Struct("<IBI")
+
+# data-plane chunk size: the bounce-buffer window (BounceBufferManager's
+# fixed-size buffers; 1 MiB keeps per-frame latency low on DCN)
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+
+@dataclass
+class ArrayDesc:
+    """One device array of a columnar batch (TableMeta ColumnMeta analog)."""
+    dtype: str
+    shape: Tuple[int, ...]
+    nbytes: int
+
+    def to_json(self):
+        return {"dtype": self.dtype, "shape": list(self.shape),
+                "nbytes": self.nbytes}
+
+    @staticmethod
+    def from_json(d):
+        return ArrayDesc(d["dtype"], tuple(d["shape"]), d["nbytes"])
+
+
+@dataclass
+class BufferDesc:
+    """Shuffle buffer metadata (TableMeta analog): enough to reconstruct a
+    ColumnarBatch from raw bytes on the receiving side."""
+    buffer_id: int
+    shuffle_id: int
+    reduce_id: int
+    num_rows: int
+    field_names: List[str]
+    field_dtypes: List[str]        # columnar dtype names
+    arrays: List[ArrayDesc] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays)
+
+    def to_json(self):
+        return {"buffer_id": self.buffer_id, "shuffle_id": self.shuffle_id,
+                "reduce_id": self.reduce_id, "num_rows": self.num_rows,
+                "field_names": self.field_names,
+                "field_dtypes": self.field_dtypes,
+                "arrays": [a.to_json() for a in self.arrays]}
+
+    @staticmethod
+    def from_json(d):
+        return BufferDesc(
+            d["buffer_id"], d["shuffle_id"], d["reduce_id"], d["num_rows"],
+            list(d["field_names"]), list(d["field_dtypes"]),
+            [ArrayDesc.from_json(a) for a in d["arrays"]])
+
+
+def encode_frame(msg_type: int, header: Dict[str, Any],
+                 payload: bytes = b"") -> bytes:
+    h = json.dumps(header).encode()
+    total = _HDR.size + len(h) + len(payload)
+    return _HDR.pack(total, msg_type, len(h)) + h + payload
+
+
+class FrameReader:
+    """Incremental frame decoder over a read(n)->bytes callable."""
+
+    def __init__(self, read_exact):
+        self._read = read_exact
+
+    def next_frame(self) -> Tuple[int, Dict[str, Any], bytes]:
+        head = self._read(_HDR.size)
+        total, msg_type, hlen = _HDR.unpack(head)
+        rest = self._read(total - _HDR.size)
+        header = json.loads(rest[:hlen].decode())
+        return msg_type, header, rest[hlen:]
+
+
+def chunk_ranges(total_bytes: int, chunk_bytes: int
+                 ) -> List[Tuple[int, int]]:
+    """(offset, length) windows covering [0, total_bytes) — the
+    WindowedBlockIterator math (WindowedBlockIterator.scala), collapsed to
+    one flat buffer per shuffle table."""
+    if total_bytes == 0:
+        return [(0, 0)]
+    out = []
+    off = 0
+    while off < total_bytes:
+        ln = min(chunk_bytes, total_bytes - off)
+        out.append((off, ln))
+        off += ln
+    return out
+
+
+def chunk_crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
