@@ -27,7 +27,13 @@ class ColumnarBatch:
         assert len(caps) <= 1, f"mixed capacities in batch: {caps}"
         self.schema = schema
         self.columns = columns
-        self.num_rows = int(num_rows)
+        try:
+            self.num_rows = int(num_rows)
+        except Exception:
+            # traced device scalar: batches built inside fused (jitted)
+            # stages carry their row count as a tracer until the stage's
+            # host boundary syncs it
+            self.num_rows = num_rows
 
     # -- shape ---------------------------------------------------------------
     @property
@@ -98,6 +104,32 @@ class ColumnarBatch:
     def empty(schema: dt.Schema, capacity: int = 128) -> "ColumnarBatch":
         cols = [Column.full_null(f.dtype, capacity) for f in schema]
         return ColumnarBatch(schema, cols, 0)
+
+    # -- flat array form (fused stages / spill / wire share this layout) -----
+    def flat_arrays(self) -> List[jnp.ndarray]:
+        """All underlying arrays in schema order: [data, validity(, lengths)]
+        per column — the jit-boundary form of a batch."""
+        out: List[jnp.ndarray] = []
+        for c in self.columns:
+            out.extend(c.arrays())
+        return out
+
+    @staticmethod
+    def from_flat_arrays(schema: dt.Schema, arrays: Sequence[jnp.ndarray],
+                         num_rows) -> "ColumnarBatch":
+        """Inverse of flat_arrays; num_rows may be a traced scalar inside
+        fused stages."""
+        cols: List[Column] = []
+        i = 0
+        for f in schema:
+            if f.dtype == dt.STRING:
+                cols.append(Column(f.dtype, arrays[i], arrays[i + 1],
+                                   arrays[i + 2]))
+                i += 3
+            else:
+                cols.append(Column(f.dtype, arrays[i], arrays[i + 1]))
+                i += 2
+        return ColumnarBatch(schema, cols, num_rows)
 
     # -- host extraction -----------------------------------------------------
     def to_pydict(self) -> Dict[str, List[Any]]:

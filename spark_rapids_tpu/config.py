@@ -217,8 +217,10 @@ BATCH_SIZE_BYTES = _conf("spark.rapids.tpu.sql.batchSizeBytes").doc(
 ).bytes_conf.create_with_default(512 * 1024 * 1024)
 
 MAX_READER_BATCH_SIZE_ROWS = _conf("spark.rapids.tpu.sql.reader.batchSizeRows").doc(
-    "Soft cap on rows per scan batch (ref: spark.rapids.sql.reader.batchSizeRows)"
-).integer_conf.create_with_default(1 << 21)
+    "Cap on rows per scan/coalesced batch (ref: spark.rapids.sql.reader."
+    "batchSizeRows). Whole-stage programs compile per batch capacity and "
+    "XLA compile cost grows steeply with shape; 128k rows streams well "
+    "through one compiled stage").integer_conf.create_with_default(1 << 17)
 
 CONCURRENT_TPU_TASKS = _conf("spark.rapids.tpu.sql.concurrentTpuTasks").doc(
     "Number of tasks that may hold the device concurrently "

@@ -36,6 +36,92 @@ def expand_paths(paths: List[str]) -> List[str]:
     return out
 
 
+_HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def partition_values_for(path: str, roots: List[str]) -> List[tuple]:
+    """``k=v`` directory segments between the scan root and the file,
+    URL-decoded, in path order (the hive partition layout the reference
+    appends post-decode, ColumnarPartitionReaderWithPartitionValues.scala +
+    GpuParquetScan.scala:749-759). Returns [(name, value_str|None)]."""
+    from urllib.parse import unquote
+    rel = None
+    for r in roots:
+        root = os.path.abspath(r)
+        p = os.path.abspath(path)
+        if p.startswith(root + os.sep):
+            rel = os.path.relpath(os.path.dirname(p), root)
+            break
+    if rel in (None, "."):
+        return []
+    out = []
+    for seg in rel.split(os.sep):
+        if "=" not in seg:
+            continue
+        k, v = seg.split("=", 1)
+        v = unquote(v)
+        out.append((k, None if v == _HIVE_NULL else v))
+    return out
+
+
+def infer_partition_dtype(values: List[Optional[str]]) -> dt.DType:
+    """Spark's partition-column type inference, reduced: every non-null
+    value parses as int -> bigint; as float -> double; else string."""
+    non_null = [v for v in values if v is not None]
+    if not non_null:
+        return dt.STRING
+    try:
+        for v in non_null:
+            int(v)
+        return dt.INT64
+    except ValueError:
+        pass
+    try:
+        for v in non_null:
+            float(v)
+        return dt.FLOAT64
+    except ValueError:
+        pass
+    return dt.STRING
+
+
+def partition_schema(files: List[str], roots: List[str]) -> dt.Schema:
+    """Partition columns discovered from the directory layout of ``files``."""
+    by_name: Dict[str, List[Optional[str]]] = {}
+    order: List[str] = []
+    for f in files:
+        for k, v in partition_values_for(f, roots):
+            if k not in by_name:
+                by_name[k] = []
+                order.append(k)
+            by_name[k].append(v)
+    return dt.Schema([
+        dt.Field(k, infer_partition_dtype(by_name[k]), True)
+        for k in order])
+
+
+def append_partition_columns(table, path: str, roots: List[str],
+                             pschema: dt.Schema):
+    """Arrow table + constant partition-value columns for this file."""
+    import pyarrow as pa
+    values = dict(partition_values_for(path, roots))
+    for f in pschema:
+        if f.name in table.column_names:
+            continue
+        raw = values.get(f.name)
+        if raw is None:
+            val = None
+        elif f.dtype == dt.INT64:
+            val = int(raw)
+        elif f.dtype == dt.FLOAT64:
+            val = float(raw)
+        else:
+            val = raw
+        arr = pa.array([val] * table.num_rows, type=dt.to_arrow(f.dtype))
+        table = table.append_column(f.name, arr)
+    return table
+
+
 def infer_schema(fmt: str, paths: List[str],
                  options: Dict[str, Any]) -> dt.Schema:
     files = expand_paths(paths)
@@ -55,6 +141,10 @@ def infer_schema(fmt: str, paths: List[str],
     fields = []
     for name, typ in zip(arrow_schema.names, arrow_schema.types):
         fields.append(dt.Field(name, dt.from_arrow(typ)))
+    # hive-layout partition columns append after the file columns
+    for f in partition_schema(files, paths):
+        if f.name not in {x.name for x in fields}:
+            fields.append(f)
     return dt.Schema(fields)
 
 
@@ -97,25 +187,32 @@ def _read_csv(path: str, options: Dict[str, Any]):
 
 
 def read_file_to_arrow(fmt: str, path: str, options: Dict[str, Any],
-                       columns: Optional[List[str]] = None, filters=None):
+                       columns: Optional[List[str]] = None, filters=None,
+                       roots: Optional[List[str]] = None,
+                       pschema: Optional[dt.Schema] = None):
     if fmt == "parquet":
         import pyarrow.parquet as pq
-        return pq.read_table(path, columns=columns, filters=filters)
-    if fmt == "orc":
+        t = pq.read_table(path, columns=columns, filters=filters)
+    elif fmt == "orc":
         import pyarrow.orc as orc
-        return orc.ORCFile(path).read(columns=columns)
-    if fmt == "csv":
+        t = orc.ORCFile(path).read(columns=columns)
+    elif fmt == "csv":
         t = _read_csv(path, options)
         if columns:
             t = t.select(columns)
-        return t
-    raise ValueError(f"unsupported format {fmt}")
+    else:
+        raise ValueError(f"unsupported format {fmt}")
+    if roots and pschema is not None and len(pschema):
+        t = append_partition_columns(t, path, roots, pschema)
+    return t
 
 
 def read_to_arrow(fmt: str, paths: List[str], options: Dict[str, Any]):
     import pyarrow as pa
     files = expand_paths(paths)
-    tables = [read_file_to_arrow(fmt, f, options) for f in files]
+    pschema = partition_schema(files, paths)
+    tables = [read_file_to_arrow(fmt, f, options, roots=paths,
+                                 pschema=pschema) for f in files]
     if len(tables) == 1:
         return tables[0]
     return pa.concat_tables(tables, promote_options="permissive")

@@ -52,6 +52,8 @@ class TpuFileScanExec(TpuExec):
         self.plan = plan
         self.conf = conf or cfg.TpuConf()
         self.files = expand_paths(plan.paths)
+        from . import partition_schema
+        self.pschema = partition_schema(self.files, plan.paths)
         self.reader_type = str(
             self.conf.get_key("spark.rapids.tpu.sql.format.parquet.reader.type",
                               "COALESCING")).upper()
@@ -89,7 +91,8 @@ class TpuFileScanExec(TpuExec):
         from ..ops.hashing import InputFileName
         InputFileName.set_current(path)
         t = read_file_to_arrow(self.plan.fmt, path, self.plan.options,
-                               filters=self.filters)
+                               filters=self.filters, roots=self.plan.paths,
+                               pschema=self.pschema)
         self.metrics.inc("bufferTime")
         return t
 

@@ -79,7 +79,11 @@ class Cast(Expression):
         return f"cast({self.children[0]!r} AS {self.to})"
 
 
-def device_cast(data: jnp.ndarray, src: dt.DType, dst: dt.DType) -> jnp.ndarray:
+def device_cast(data: jnp.ndarray, src: dt.DType, dst: dt.DType,
+                xp=jnp) -> jnp.ndarray:
+    """Cast kernel over jnp arrays; ``xp=np`` evaluates the identical
+    semantics in pure numpy (scalar folding must not bind jax primitives —
+    under an active trace even constant-input ops return tracers)."""
     if src == dst:
         return data
     npdst = dst.numpy_dtype
@@ -88,26 +92,26 @@ def device_cast(data: jnp.ndarray, src: dt.DType, dst: dt.DType) -> jnp.ndarray:
     if src == dt.BOOL:
         return data.astype(npdst)
     if src == dt.DATE and dst == dt.TIMESTAMP:
-        return data.astype(jnp.int64) * MICROS_PER_DAY
+        return data.astype(xp.int64) * MICROS_PER_DAY
     if src == dt.TIMESTAMP and dst == dt.DATE:
-        return jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32)
+        return xp.floor_divide(data, MICROS_PER_DAY).astype(xp.int32)
     if src == dt.TIMESTAMP and dst.is_integral:
-        secs = jnp.floor_divide(data, MICROS_PER_SECOND)
+        secs = xp.floor_divide(data, MICROS_PER_SECOND)
         return secs.astype(npdst)
     if src.is_integral and dst == dt.TIMESTAMP:
-        return data.astype(jnp.int64) * MICROS_PER_SECOND
+        return data.astype(xp.int64) * MICROS_PER_SECOND
     if src == dt.TIMESTAMP and dst.is_floating:
-        return data.astype(jnp.float64) / MICROS_PER_SECOND
+        return data.astype(xp.float64) / MICROS_PER_SECOND
     if src.is_floating and dst == dt.TIMESTAMP:
-        return (data * MICROS_PER_SECOND).astype(jnp.int64)
+        return (data * MICROS_PER_SECOND).astype(xp.int64)
     if src.is_floating and dst.is_integral:
         lo, hi = _INT_RANGE[dst]
-        trunc = jnp.trunc(jnp.where(jnp.isnan(data), 0.0, data))
-        clipped = jnp.clip(trunc, float(lo), float(hi))
+        trunc = xp.trunc(xp.where(xp.isnan(data), 0.0, data))
+        clipped = xp.clip(trunc, float(lo), float(hi))
         # first go through int64 (saturating), then wrap-narrow like Java
-        as64 = jnp.where(trunc <= float(lo), jnp.int64(lo),
-                         jnp.where(trunc >= float(hi), jnp.int64(hi),
-                                   clipped.astype(jnp.int64)))
+        as64 = xp.where(trunc <= float(lo), xp.int64(lo),
+                        xp.where(trunc >= float(hi), xp.int64(hi),
+                                 clipped.astype(xp.int64)))
         return as64.astype(npdst)
     # integral->integral (wrap), integral->float, float<->float, date<->int
     return data.astype(npdst)
@@ -122,7 +126,10 @@ def _cast_scalar(v: Scalar, src: dt.DType, dst: dt.DType) -> Scalar:
         return Scalar(_format_value(v.value, src), dst)
     if src == dt.STRING:
         return Scalar(_parse_value(v.value, dst), dst)
-    out = np.asarray(device_cast(jnp.asarray(v.value, src.numpy_dtype), src, dst))
+    # pure numpy: scalar folding runs inside fused traces, where any jax
+    # primitive bind would return a tracer and break host conversion
+    out = np.asarray(device_cast(np.asarray(v.value, src.numpy_dtype),
+                                 src, dst, xp=np))
     return Scalar(out.item(), dst)
 
 

@@ -81,24 +81,95 @@ class SortKey(NamedTuple):
     nulls_first: bool = True   # Spark default: NULLS FIRST for asc, NULLS LAST for desc
 
 
-def _key_arrays(key: SortKey) -> List[jnp.ndarray]:
-    """Most-significant-first list of unsigned arrays encoding one sort key."""
+def _key_arrays_bits(key: SortKey) -> List[Tuple[jnp.ndarray, Optional[int]]]:
+    """Most-significant-first (array, value_bit_width) pairs encoding one
+    sort key. bit_width None marks float value keys (unpackable — they stay
+    raw operands); small widths (1-bit null ranks, short string payloads)
+    let pack_key_bits collapse whole key sets into one 32-bit sort lane,
+    which is the difference between a seconds and a minutes sort compile."""
     col, asc = key.column, key.ascending
+    encoded: List[Tuple[jnp.ndarray, Optional[int]]] = []
     if col.dtype == dt.STRING:
-        words = pack_string_words(col.data, col.lengths)
-        # length as final tie-break: zero padding is indistinguishable from an
-        # embedded NUL in the word keys, and segment_starts compares lengths too
-        encoded = [words[:, i] for i in range(words.shape[1])]
-        encoded.append(col.lengths.astype(jnp.uint32))
+        W = int(col.data.shape[1])
+        len_bits = max(1, (W + 1).bit_length())
+        if W <= 3 and 8 * W + len_bits <= 32:
+            # short strings: chars || length in ONE sub-32-bit value
+            # (length low bits give the prefix tie-break directly)
+            word = jnp.zeros(col.data.shape[0], jnp.uint32)
+            for j in range(W):
+                word = (word << jnp.uint32(8)) | col.data[:, j].astype(
+                    jnp.uint32)
+            word = (word << jnp.uint32(len_bits)) | col.lengths.astype(
+                jnp.uint32)
+            encoded.append((word, 8 * W + len_bits))
+        else:
+            words = pack_string_words(col.data, col.lengths)
+            encoded += [(words[:, i], 32) for i in range(words.shape[1])]
+            # length as final tie-break: zero padding is indistinguishable
+            # from an embedded NUL in the word keys
+            encoded.append((col.lengths.astype(jnp.uint32), len_bits))
         if not asc:
-            encoded = [~e for e in encoded]
+            encoded = [((a ^ jnp.uint32((1 << b) - 1)), b)
+                       for a, b in encoded]
     else:
-        encoded = encode_orderable_words(col.data, col.dtype, descending=not asc)
-    # null rank precedes value: 0 sorts before 1
+        for a in encode_orderable_words(col.data, col.dtype,
+                                        descending=not asc):
+            bw = _bit_width(a)
+            encoded.append((a, bw))     # None for float value keys
+    # null rank precedes value: 0 sorts before 1 (1-bit value)
     null_first = key.nulls_first
     null_rank = jnp.where(col.validity, jnp.uint8(1 if null_first else 0),
                           jnp.uint8(0 if null_first else 1))
-    return [null_rank] + encoded
+    return [(null_rank, 1)] + encoded
+
+
+def _key_arrays(key: SortKey) -> List[jnp.ndarray]:
+    """Most-significant-first list of unsigned arrays encoding one sort key
+    (unpacked form; mesh bound-comparison uses these directly)."""
+    return [a for a, _b in _key_arrays_bits(key)]
+
+
+def _bit_width(a: jnp.ndarray) -> Optional[int]:
+    return {jnp.uint8: 8, jnp.uint16: 16, jnp.uint32: 32,
+            jnp.uint64: 64}.get(a.dtype.type)
+
+
+def pack_key_bits(items: List[Tuple[jnp.ndarray, Optional[int]]]
+                  ) -> List[jnp.ndarray]:
+    """Pack consecutive (array, bit_width) most-significant-first keys into
+    uint32 lanes (earlier keys in higher bits), preserving lexicographic
+    order while collapsing the sort operand count.
+
+    Why: XLA's variadic-sort comparator compile time grows steeply with
+    operand count (~15-30s PER 32-bit operand on both the CPU and TPU
+    backends measured here), so a 7-operand lexsort costs minutes to
+    compile. A groupby on two short string keys plus null/pad ranks fits in
+    ONE packed lane. 32-bit lanes (not 64) because 64-bit integers are
+    emulated on TPU under the x64 rewrite — a u64 comparator costs two u32
+    comparators anyway. Values wider than 32 bits (and float value keys,
+    width None) pass through as raw operands."""
+    out: List[jnp.ndarray] = []
+    cur: Optional[jnp.ndarray] = None
+    used = 0
+    for a, bits in items:
+        if bits is None or bits > 32:
+            if cur is not None:
+                out.append(cur)
+                cur, used = None, 0
+            out.append(a)
+            continue
+        aa = a.astype(jnp.uint32)
+        if cur is None:
+            cur, used = aa, bits
+        elif used + bits <= 32:
+            cur = (cur << jnp.uint32(bits)) | aa
+            used += bits
+        else:
+            out.append(cur)
+            cur, used = aa, bits
+    if cur is not None:
+        out.append(cur)
+    return out
 
 
 def sort_indices(keys: Sequence[SortKey], num_rows, capacity: int) -> jnp.ndarray:
@@ -108,11 +179,12 @@ def sort_indices(keys: Sequence[SortKey], num_rows, capacity: int) -> jnp.ndarra
     ``num_rows`` may be a python int or a traced device scalar.
     """
     pad_rank = (jnp.arange(capacity) >= num_rows).astype(jnp.uint8)
-    msf: List[jnp.ndarray] = [pad_rank]
+    msf: List[Tuple[jnp.ndarray, Optional[int]]] = [(pad_rank, 1)]
     for key in keys:
-        msf.extend(_key_arrays(key))
+        msf.extend(_key_arrays_bits(key))
+    packed = pack_key_bits(msf)
     # jnp.lexsort wants least-significant first
-    return jnp.lexsort(tuple(reversed(msf)))
+    return jnp.lexsort(tuple(reversed(packed)))
 
 
 # ---------------------------------------------------------------------------
@@ -143,9 +215,22 @@ def gather_column(col: Column, indices: jnp.ndarray,
 
 
 def compaction_indices(keep: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(perm, count): stable order with kept rows first. keep must be False on padding."""
-    perm = jnp.argsort(~keep, stable=True)
-    return perm, jnp.sum(keep).astype(jnp.int32)
+    """(perm, count): stable order with kept rows first. keep must be False
+    on padding.
+
+    Sort-free: cumsum ranks each row within its class (kept/dropped), one
+    scatter inverts the position map. An XLA sort here would cost both a
+    pathological comparator compile (tens of seconds per sort instance on
+    some backends) and O(n log n) runtime for what is an O(n) operation.
+    """
+    n = keep.shape[0]
+    n_keep = jnp.sum(keep).astype(jnp.int32)
+    pos_keep = jnp.cumsum(keep).astype(jnp.int32) - 1
+    pos_drop = n_keep + jnp.cumsum(~keep).astype(jnp.int32) - 1
+    pos = jnp.where(keep, pos_keep, pos_drop)
+    perm = jnp.zeros(n, jnp.int32).at[pos].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return perm, n_keep
 
 
 def compact_columns(cols: Sequence[Column], keep: jnp.ndarray

@@ -307,11 +307,16 @@ class Overrides:
         return node
 
     def _target_batch_rows(self, schema) -> int:
-        """Rows per batch approximating the configured batchSizeBytes."""
+        """Rows per batch approximating the configured batchSizeBytes,
+        capped at reader.batchSizeRows: fused whole-stage programs compile
+        per capacity, and compile cost grows steeply with shape on the
+        backends measured here — streaming more, smaller batches through one
+        compiled program beats one huge batch."""
         row_bytes = 0
         for f in schema:
             row_bytes += (f.dtype.byte_width or 32) + 1
-        return max(1 << 14, self.conf.batch_size_bytes // max(row_bytes, 1))
+        rows = max(1 << 14, self.conf.batch_size_bytes // max(row_bytes, 1))
+        return min(rows, int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)))
 
     def _convert(self, meta: PlanMeta) -> ph.TpuExec:
         p = meta.plan
@@ -329,7 +334,9 @@ class Overrides:
         p = meta.plan
         kids = [self._convert(c) for c in meta.children]
         if isinstance(p, lp.LocalScan):
-            return ph.TpuLocalScanExec(p.data, p.schema)
+            return ph.TpuLocalScanExec(
+                p.data, p.schema,
+                batch_rows=int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)))
         if isinstance(p, lp.FileScan):
             from ..io.scan import TpuFileScanExec
             return TpuFileScanExec(p, self.conf)

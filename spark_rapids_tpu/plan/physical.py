@@ -259,6 +259,181 @@ def concat_batches(schema: dt.Schema, batches: List[ColumnarBatch],
 
 
 # ---------------------------------------------------------------------------
+# Whole-stage fusion (DESIGN.md §2; the TPU analog of codegen stages)
+# ---------------------------------------------------------------------------
+#
+# Eager evaluation dispatches every jnp op as its own compiled program —
+# hundreds of device round-trips per batch, the dominant engine cost (each
+# expression node is a separate kernel launch, exactly the fusion gap
+# SURVEY.md §3.3 calls out in the reference's per-expression JNI launches).
+# A fused stage traces the WHOLE per-batch computation once per shape:
+# one (or two, for dispatched group-bys) device calls per batch.
+
+def _fusion_enabled(node) -> bool:
+    flag = getattr(node, "_fusion", None)
+    if flag is not None:
+        return flag
+    from .. import config as cfg
+    return bool(cfg.TpuConf().get(cfg.WHOLESTAGE_FUSION))
+
+
+# Fused programs cache GLOBALLY on (expression structure, schema dtypes,
+# shapes): repeated queries reuse compiled stages across exec instances —
+# per-exec closures would force a recompile every query.
+_FUSED_CACHE: Dict[tuple, Any] = {}
+
+
+def _fused_fn(key: tuple, builder):
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        if len(_FUSED_CACHE) > 256:
+            _FUSED_CACHE.clear()
+        fn = _FUSED_CACHE[key] = builder()
+    return fn
+
+
+def _schema_sig(schema: dt.Schema) -> tuple:
+    return tuple(f.dtype.name for f in schema)
+
+
+def _expr_cache_key(e: ex.Expression):
+    """Structural cache key covering every instance attribute (reprs alone
+    are not faithful — e.g. Like's pattern is not in its repr). Returns None
+    when an attribute is opaque (unkeyable): the stage then jits per-exec
+    instead of sharing the global cache."""
+    parts: list = [type(e).__name__]
+    for k, v in sorted(vars(e).items()):
+        if k == "children":
+            continue
+        if isinstance(v, ex.Expression):
+            sub = _expr_cache_key(v)
+            if sub is None:
+                return None
+            parts.append((k, sub))
+            continue
+        r = repr(v)
+        if " at 0x" in r:
+            return None
+        parts.append((k, r))
+    for c in e.children:
+        sub = _expr_cache_key(c)
+        if sub is None:
+            return None
+        parts.append(sub)
+    return tuple(parts)
+
+
+class FusedStage:
+    """One jitted program evaluating bound expression trees over a batch.
+
+    mode 'project': outputs = evaluated expression columns.
+    mode 'filter':  single boolean expression; outputs = compacted input
+    columns + device row count (the host syncs the count, as the eager
+    path already does).
+
+    Any trace failure (an expression doing host-side work despite its
+    fusable flag) permanently falls back to eager for this stage.
+    """
+
+    def __init__(self, exprs: List[ex.Expression], in_schema: dt.Schema,
+                 out_schema: dt.Schema, mode: str = "project"):
+        self.exprs = exprs
+        self.in_schema = in_schema
+        self.out_schema = out_schema
+        self.mode = mode
+        self.broken = False
+        self._fn = None
+
+    @staticmethod
+    def maybe(node, exprs, in_schema, out_schema, stateful,
+              mode: str = "project"):
+        """A FusedStage when fusion applies: enabled, every tree fusable,
+        and no stateful expressions (their host-side per-batch state would
+        bake into the trace)."""
+        if not _fusion_enabled(node):
+            return None
+        if stateful or not all(e.tree_fusable() for e in exprs):
+            return None
+        return FusedStage(exprs, in_schema, out_schema, mode)
+
+    def _build(self):
+        import jax
+
+        def run_project(num_rows, *arrays):
+            b = ColumnarBatch.from_flat_arrays(self.in_schema, arrays,
+                                               num_rows)
+            cols = [ex.materialize(e.eval(b), b) for e in self.exprs]
+            return tuple(a for c in cols for a in c.arrays())
+
+        def run_filter(num_rows, *arrays):
+            b = ColumnarBatch.from_flat_arrays(self.in_schema, arrays,
+                                               num_rows)
+            pred = self.exprs[0].eval(b)
+            if isinstance(pred, Scalar):       # constant predicate: eager
+                raise _ScalarPredicate()
+            keep = pred.data & pred.validity & b.row_mask()
+            cols, count = K.compact_columns(b.columns, keep)
+            return tuple(a for c in cols for a in c.arrays()) + (count,)
+
+        return jax.jit(run_project if self.mode == "project"
+                       else run_filter)
+
+    def __call__(self, batch: ColumnarBatch):
+        """project -> ColumnarBatch | filter -> (ColumnarBatch, count) |
+        None on permanent fallback."""
+        if self.broken:
+            return None
+        import jax.numpy as jnp
+        try:
+            if self._fn is None:
+                ekeys = [_expr_cache_key(e) for e in self.exprs]
+                if any(k is None for k in ekeys):
+                    self._fn = self._build()      # unkeyable: per-exec jit
+                else:
+                    key = (self.mode, _schema_sig(self.in_schema),
+                           tuple(ekeys))
+                    self._fn = _fused_fn(key, self._build)
+            outs = self._fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
+        except _ScalarPredicate:
+            self.broken = True
+            return None
+        except Exception as e:
+            # host-side expression slipped through the fusable gate
+            import logging
+            logging.getLogger("spark_rapids_tpu.fusion").warning(
+                "whole-stage fusion fell back to eager for %s stage: %s",
+                self.mode, e)
+            self.broken = True
+            return None
+        if self.mode == "project":
+            return ColumnarBatch.from_flat_arrays(self.out_schema,
+                                                  list(outs),
+                                                  batch.num_rows)
+        # filter: compacted columns + device count (caller syncs)
+        tmp = ColumnarBatch.from_flat_arrays(self.out_schema,
+                                             list(outs[:-1]), 0)
+        return tmp.columns, outs[-1]
+
+
+class _ScalarPredicate(Exception):
+    pass
+
+
+def _dense_sig_supported(op: str, t) -> bool:
+    """Dtype-level mirror of aggregates._dense_spec_supported (the fused
+    path decides candidacy statically, before any column exists)."""
+    if op in ("count", "count_star"):
+        return True
+    if t is None:
+        return False
+    if op in ("sum", "avg"):
+        return t.is_integral or t == dt.BOOL or t.is_floating
+    if op in ("min", "max"):
+        return t != dt.STRING
+    return op in ("first", "last")
+
+
+# ---------------------------------------------------------------------------
 # Leaves
 # ---------------------------------------------------------------------------
 
@@ -378,10 +553,15 @@ class TpuProjectExec(TpuExec):
 
     def _map(self, part: Partition, pid: int = 0) -> Partition:
         exprs, stateful = _prepare_stateful(self.exprs, pid)
+        fused = FusedStage.maybe(self, exprs, self.children[0].schema,
+                                 self._schema, stateful)
         for batch in part:
             with self.metrics.timer("opTime"):
-                cols = [ex.materialize(e.eval(batch), batch) for e in exprs]
-                out = ColumnarBatch(self._schema, cols, batch.num_rows)
+                out = fused(batch) if fused is not None else None
+                if out is None:
+                    cols = [ex.materialize(e.eval(batch), batch)
+                            for e in exprs]
+                    out = ColumnarBatch(self._schema, cols, batch.num_rows)
             for n in stateful:
                 n.advance(batch.num_rows)
             self.metrics.inc("numOutputRows", out.num_rows)
@@ -409,11 +589,25 @@ class TpuFilterExec(TpuExec):
 
     def _map(self, part: Partition, pid: int = 0) -> Partition:
         (condition,), stateful = _prepare_stateful([self.condition], pid)
+        fused = FusedStage.maybe(self, [condition], self.children[0].schema,
+                                 self._schema, stateful, mode="filter")
         for batch in part:
             with self.metrics.timer("opTime"):
+                if fused is not None:
+                    res = fused(batch)
+                    if res is not None:
+                        cols, count = res
+                        n = int(count)   # host sync, as the eager path
+                        if n == 0:
+                            continue
+                        out = ColumnarBatch(self._schema, cols, n)
+                        self.metrics.inc("numOutputRows", n)
+                        self.metrics.inc("numOutputBatches")
+                        yield out
+                        continue
                 pred = condition.eval(batch)
-                for n in stateful:
-                    n.advance(batch.num_rows)
+                for s in stateful:
+                    s.advance(batch.num_rows)
                 if isinstance(pred, Scalar):
                     if pred.value is True:
                         yield batch
@@ -634,6 +828,9 @@ class TpuHashAggregateExec(TpuExec):
 
     def _update_partial_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Update-phase aggregation of one input batch into partial form."""
+        fused = self._maybe_fused_phase(batch, "update")
+        if fused is not None:
+            return self._shrink_partial(fused)
         keys, specs = self._build_update_specs(batch)
         cap = batch.capacity
         if not self.grouping:
@@ -642,7 +839,167 @@ class TpuHashAggregateExec(TpuExec):
         out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
             keys, specs, batch.num_rows, cap,
             allow_matmul=_matmul_agg_enabled(), dense_state=self._dense_state)
-        return ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups)
+        return self._shrink_partial(
+            ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups))
+
+    def _shrink_partial(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Compact a partial batch to bucket(n_groups) capacity: group-by
+        outputs inherit the INPUT capacity, and carrying a million-slot
+        batch holding six groups into the merge/final phases wastes memory
+        and forces the downstream fused programs to compile at the huge
+        capacity (compile cost grows steeply with shape on some backends)."""
+        ncap = bucket(max(batch.num_rows, 1))
+        if ncap >= batch.capacity:
+            return batch
+        cols = [K.rebucket_column(c, batch.num_rows, ncap)
+                for c in batch.columns]
+        return ColumnarBatch(batch.schema, cols, batch.num_rows)
+
+    # -- whole-stage fused group-by (expression eval + kernel in <=2
+    # device programs per batch; see the fusion section above) --------------
+    def _spec_signature(self, phase: str):
+        """Static (op, input dtype) signature of the phase's AggSpec list."""
+        sig = []
+        if phase == "update":
+            for leaf, bound in zip(self.leaves, self.bound_leaf_inputs):
+                t = bound.dtype if bound is not None else None
+                if leaf.op == "avg":
+                    sig += [("sum", dt.FLOAT64), ("count", t)]
+                else:
+                    sig.append((leaf.op, t))
+        else:
+            for leaf in self.leaves:
+                update_types = [ut for (_op, ut) in self._update_cols(leaf)]
+                for op, ut in zip(self._merge_ops(leaf), update_types):
+                    sig.append((op, ut))
+        return tuple(sig)
+
+    def _fusion_sig(self, phase: str, in_schema: dt.Schema):
+        gk = [_expr_cache_key(g) for g in self.grouping]
+        bk = [None if b is None else _expr_cache_key(b)
+              for b in self.bound_leaf_inputs]
+        if any(k is None for k in gk) or any(
+                b is not None and k is None for b, k in
+                zip(self.bound_leaf_inputs, bk)):
+            return None
+        return ("agg", phase, self.mode, tuple(gk), tuple(bk),
+                tuple((l.op, l.ignore_nulls) for l in self.leaves),
+                _schema_sig(in_schema))
+
+    def _maybe_fused_phase(self, batch: ColumnarBatch,
+                           phase: str) -> Optional[ColumnarBatch]:
+        """Fused group-by phase: an optional dense-stats probe plus ONE
+        fused kernel program per batch (vs one dispatch per op in the eager
+        path — the dominant engine cost). Dispatch mirrors
+        groupby_aggregate_fast: single small-span integral key -> dense MXU
+        one-hot path; otherwise the traced sort+scatter path. Falls back to
+        eager permanently on any trace failure."""
+        if getattr(self, "_fusion_broken", False) or not _fusion_enabled(self):
+            return None
+        if not all(e.tree_fusable() for e in self.grouping) or any(
+                b is not None and not b.tree_fusable()
+                for b in self.bound_leaf_inputs):
+            return None
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ..columnar.column import bucket as _bucket
+
+        in_schema = batch.schema
+        cap = batch.capacity
+        sig = self._fusion_sig(phase, in_schema)
+        if sig is None:
+            return None
+        build_eval = (self._build_update_specs if phase == "update"
+                      else self._merge_specs)
+        pschema = self._partial_schema()
+
+        try:
+            if not self.grouping:
+                def build_reduce():
+                    def fn(num_rows, *arrays):
+                        b = ColumnarBatch.from_flat_arrays(
+                            in_schema, arrays, num_rows)
+                        _keys, specs = build_eval(b)
+                        aggs = agg_k.reduce_aggregate(specs, num_rows,
+                                                      b.capacity)
+                        return tuple(a for c in aggs for a in c.arrays())
+                    return jax.jit(fn)
+                fn = _fused_fn(sig + ("reduce", cap), build_reduce)
+                outs = fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
+                return ColumnarBatch.from_flat_arrays(pschema, list(outs), 1)
+
+            spec_sig = self._spec_signature(phase)
+            key_dtype = (self.grouping[0].dtype
+                         if len(self.grouping) == 1 else None)
+            dense_cand = (
+                _matmul_agg_enabled() and
+                self._dense_state.get("enabled", True) and
+                key_dtype in (dt.INT8, dt.INT16, dt.INT32, dt.INT64,
+                              dt.BOOL, dt.DATE, dt.TIMESTAMP) and
+                all(_dense_sig_supported(op, t) for op, t in spec_sig))
+
+            if dense_cand:
+                def build_probe():
+                    def fn(num_rows, *arrays):
+                        b = ColumnarBatch.from_flat_arrays(
+                            in_schema, arrays, num_rows)
+                        keys, specs = build_eval(b)
+                        float_cols = [
+                            s.column for s in specs
+                            if s.op in ("sum", "avg") and s.column is not None
+                            and s.column.dtype.is_floating]
+                        return agg_k.dense_key_stats(keys[0], num_rows,
+                                                     float_cols=float_cols)
+                    return jax.jit(fn)
+                probe = _fused_fn(sig + ("probe", cap), build_probe)
+                rmin, dec = probe(jnp.int32(batch.num_rows),
+                                  *batch.flat_arrays())
+                stats = np.asarray(dec)          # the ONE dispatch sync
+                span, absmaxes = stats[0], stats[2:]
+                f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX
+                                    for a in absmaxes))
+                if span + 2 <= agg_k.DENSE_MAX_SLOTS and f32_safe:
+                    Kb = _bucket(int(span) + 2, 128)
+
+                    def build_dense():
+                        def fn(num_rows, rmin_d, *arrays):
+                            b = ColumnarBatch.from_flat_arrays(
+                                in_schema, arrays, num_rows)
+                            keys, specs = build_eval(b)
+                            ok, oa, ng = agg_k.groupby_dense(
+                                keys[0], specs, num_rows, Kb, rmin_d)
+                            flat = [a for c in ok + oa for a in c.arrays()]
+                            return tuple(flat) + (ng,)
+                        return jax.jit(fn)
+                    fn = _fused_fn(sig + ("dense", cap, Kb), build_dense)
+                    outs = fn(jnp.int32(batch.num_rows), rmin,
+                              *batch.flat_arrays())
+                    return ColumnarBatch.from_flat_arrays(
+                        pschema, list(outs[:-1]), int(outs[-1]))
+                if span + 2 > agg_k.DENSE_MAX_SLOTS:
+                    self._dense_state["enabled"] = False
+
+            def build_sort():
+                def fn(num_rows, *arrays):
+                    b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
+                                                       num_rows)
+                    keys, specs = build_eval(b)
+                    ok, oa, ng = agg_k.groupby_aggregate(
+                        keys, specs, num_rows, b.capacity)
+                    flat = [a for c in ok + oa for a in c.arrays()]
+                    return tuple(flat) + (ng,)
+                return jax.jit(fn)
+            fn = _fused_fn(sig + ("sort", cap), build_sort)
+            outs = fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
+            return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
+                                                  int(outs[-1]))
+        except Exception as e:
+            import logging
+            logging.getLogger("spark_rapids_tpu.fusion").warning(
+                "fused %s group-by fell back to eager: %s", phase, e)
+            self._fusion_broken = True
+            return None
 
     # -- final (merge partials) ---------------------------------------------
     def _merge_ops(self, leaf: lp.AggregateExpression):
@@ -667,6 +1024,9 @@ class TpuHashAggregateExec(TpuExec):
     def _merge_to_partial(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Merge-phase aggregation of concatenated partials back to one row
         per group (the merge half of the CudfAggregate update/merge pairs)."""
+        fused = self._maybe_fused_phase(batch, "merge")
+        if fused is not None:
+            return self._shrink_partial(fused)
         keys, specs = self._merge_specs(batch)
         if not keys:
             aggs = agg_k.reduce_aggregate(specs, batch.num_rows,
@@ -675,10 +1035,16 @@ class TpuHashAggregateExec(TpuExec):
         out_keys, aggs, n_groups = agg_k.groupby_aggregate_fast(
             keys, specs, batch.num_rows, batch.capacity,
             allow_matmul=_matmul_agg_enabled(), dense_state=self._dense_state)
-        return ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups)
+        return self._shrink_partial(
+            ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups))
 
     def _final(self, batch: ColumnarBatch) -> Partition:
         with self.metrics.timer("computeAggTime"):
+            fused = self._maybe_fused_final(batch)
+            if fused is not None:
+                self.metrics.inc("numOutputRows", fused.num_rows)
+                yield fused
+                return
             keys, specs = self._merge_specs(batch)
             if not keys:
                 aggs = agg_k.reduce_aggregate(specs, batch.num_rows,
@@ -690,7 +1056,57 @@ class TpuHashAggregateExec(TpuExec):
                     keys, specs, batch.num_rows, batch.capacity,
                     allow_matmul=_matmul_agg_enabled(),
                     dense_state=self._dense_state)
-        yield self._project_results(out_keys, aggs, n_groups)
+        out = self._project_results(out_keys, aggs, n_groups)
+        self.metrics.inc("numOutputRows", out.num_rows)
+        yield out
+
+    def _maybe_fused_final(self, batch: ColumnarBatch
+                           ) -> Optional[ColumnarBatch]:
+        """Fused merge + result projection: one device program for the whole
+        final phase (merge groupby -> leaf assembly -> result expressions)."""
+        if getattr(self, "_fusion_broken", False) or not _fusion_enabled(self):
+            return None
+        if not all(e.tree_fusable() for e in self.aggregate_exprs):
+            return None
+        import jax
+        import jax.numpy as jnp
+        sig = self._fusion_sig("final", batch.schema)
+        if sig is None:
+            return None
+        rkeys = [_expr_cache_key(e) for e in self.aggregate_exprs]
+        if any(k is None for k in rkeys):
+            return None
+        in_schema = batch.schema
+        cap = batch.capacity
+
+        def build():
+            def fn(num_rows, *arrays):
+                b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
+                                                   num_rows)
+                keys, specs = self._merge_specs(b)
+                if not keys:
+                    aggs = agg_k.reduce_aggregate(specs, num_rows,
+                                                  b.capacity)
+                    out = self._project_results([], aggs, 1)
+                    ng = jnp.int32(1)
+                else:
+                    ok, aggs, ng = agg_k.groupby_aggregate(
+                        keys, specs, num_rows, b.capacity)
+                    out = self._project_results(ok, aggs, ng)
+                return tuple(out.flat_arrays()) + (ng,)
+            return jax.jit(fn)
+
+        try:
+            fn = _fused_fn(sig + ("final", tuple(rkeys), cap), build)
+            outs = fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
+            return ColumnarBatch.from_flat_arrays(
+                self._out_schema, list(outs[:-1]), int(outs[-1]))
+        except Exception as e:
+            import logging
+            logging.getLogger("spark_rapids_tpu.fusion").warning(
+                "fused final group-by fell back to eager: %s", e)
+            self._fusion_broken = True
+            return None
 
     # -- result projection ---------------------------------------------------
     def _project_results(self, out_keys: List[Column], aggs: List[Column],
@@ -713,8 +1129,9 @@ class TpuHashAggregateExec(TpuExec):
                 leaf_cols.append(Column(dt.FLOAT64, data, valid))
             elif leaf.op in ("count", "count_star"):
                 # counts are never NULL: empty/all-null groups read 0
+                # (jnp.maximum: n_groups may be traced in the fused final)
                 c = aggs[ai]
-                live = jnp.arange(c.capacity) < max(n_groups, 1)
+                live = jnp.arange(c.capacity) < jnp.maximum(n_groups, 1)
                 data = jnp.where(live, jnp.where(c.validity, c.data, 0), 0)
                 leaf_cols.append(Column(dt.INT64, data, live))
             else:
@@ -731,11 +1148,12 @@ class TpuHashAggregateExec(TpuExec):
                                  out_keys + leaf_cols, n_groups)
 
         # rewrite output exprs: leaves -> bound refs into internal batch
+        # (no metrics here: n_groups may be a tracer in the fused final;
+        # callers account rows at the host boundary)
         out_cols = []
         for e in self.aggregate_exprs:
             rewritten = self._rewrite_result(e, len(out_keys))
             out_cols.append(ex.materialize(rewritten.eval(internal), internal))
-        self.metrics.inc("numOutputRows", n_groups)
         return ColumnarBatch(self._out_schema, out_cols, n_groups)
 
     def _rewrite_result(self, e: ex.Expression, nk: int) -> ex.Expression:
