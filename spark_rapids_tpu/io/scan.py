@@ -53,7 +53,16 @@ class TpuFileScanExec(TpuExec):
         self.conf = conf or cfg.TpuConf()
         self.files = expand_paths(plan.paths)
         from . import partition_schema
-        self.pschema = partition_schema(self.files, plan.paths)
+        want = set(plan.schema.names())
+        self.pschema = dt.Schema([
+            f for f in partition_schema(self.files, plan.paths)
+            if f.name in want])
+        # column pruning (planner's _prune_scan_columns): only decode/upload
+        # referenced file columns; partition values are appended post-read
+        proj = getattr(plan, "projection", None)
+        pnames = {f.name for f in self.pschema}
+        self.columns = ([c for c in proj if c not in pnames]
+                        if proj else None)
         self.reader_type = str(
             self.conf.get_key("spark.rapids.tpu.sql.format.parquet.reader.type",
                               "COALESCING")).upper()
@@ -91,8 +100,8 @@ class TpuFileScanExec(TpuExec):
         from ..ops.hashing import InputFileName
         InputFileName.set_current(path)
         t = read_file_to_arrow(self.plan.fmt, path, self.plan.options,
-                               filters=self.filters, roots=self.plan.paths,
-                               pschema=self.pschema)
+                               columns=self.columns, filters=self.filters,
+                               roots=self.plan.paths, pschema=self.pschema)
         self.metrics.inc("bufferTime")
         return t
 

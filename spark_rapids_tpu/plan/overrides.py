@@ -275,6 +275,7 @@ class Overrides:
         self.last_meta: Optional[PlanMeta] = None
 
     def apply(self, plan: lp.LogicalPlan) -> ph.TpuExec:
+        plan = _prune_scan_columns(plan)
         meta = PlanMeta(plan, self.conf)
         meta.tag()
         self.last_meta = meta
@@ -631,6 +632,67 @@ class Overrides:
             TpuHashExchangeExec(stream, n, pk_stream),
             TpuHashExchangeExec(build, n, pk_build),
             how, stream_keys, build_keys, residual)
+
+
+def _prune_scan_columns(root: lp.LogicalPlan) -> lp.LogicalPlan:
+    """Column pruning at the scans (Catalyst ColumnPruning role): columns a
+    query never references are not decoded or uploaded — on a tunneled
+    device every extra column is a host->device transfer per batch.
+
+    Conservative by-name analysis: keep every column referenced by any
+    expression in the tree plus the root's output; skip entirely when a
+    Union is present (its schema aligns children by POSITION)."""
+    import copy
+    referenced: set = set()
+    has_union = False
+
+    def walk(p: lp.LogicalPlan) -> None:
+        nonlocal has_union
+        if isinstance(p, lp.Union):
+            has_union = True
+        if isinstance(p, lp.Distinct):
+            referenced.update(p.schema.names())
+        if isinstance(p, lp.WriteFile):
+            # a write materializes every child column
+            referenced.update(p.children[0].schema.names())
+        for e in p.expressions():
+            for n in e.collect(lambda x: isinstance(x, ex.ColumnRef)):
+                referenced.add(n.col_name)
+        for c in p.children:
+            walk(c)
+
+    walk(root)
+    if has_union:
+        return root
+    referenced.update(root.schema.names())
+
+    def rewrite(p: lp.LogicalPlan) -> lp.LogicalPlan:
+        if isinstance(p, lp.LocalScan):
+            names = p.schema.names()
+            keep = [n for n in names if n in referenced] or names[:1]
+            if len(keep) < len(names):
+                return lp.LocalScan(p.data.select(keep), p.scan_name)
+            return p
+        if isinstance(p, lp.FileScan):
+            names = p.schema.names()
+            keep = [n for n in names if n in referenced] or names[:1]
+            if len(keep) < len(names):
+                pruned = copy.copy(p)
+                pruned._schema = None
+                pruned._file_schema = dt.Schema(
+                    [f for f in p.schema.fields if f.name in keep])
+                pruned.projection = keep
+                return pruned
+            return p
+        kids = [rewrite(c) for c in p.children]
+        if all(k is c for k, c in zip(kids, p.children)):
+            return p
+        out = copy.copy(p)
+        out.children = kids
+        out._schema = None
+        return out
+
+    return rewrite(root)
 
 
 def _subtree_ok(meta: PlanMeta) -> bool:
