@@ -104,4 +104,32 @@ def _compute_segment(fn, spec, seg: List[int], df, ev, okey_of, results):
                 window_rows = [vals[j] for j in seg[:r + 1]]
                 results[i] = _agg_py(fn.op, window_rows, fn.ignore_nulls)
             return
+        if frame.is_range:
+            # RANGE frame: window = same-segment rows whose (single, asc)
+            # order key lies in [k+lower, k+upper]; NULL-key rows form
+            # their own frame group (Spark semantics)
+            okey = ev.eval(spec.order_by[0].child)
+            for r, i in enumerate(seg):
+                k = okey[i]
+                if k is None:
+                    window = [j for j in seg if okey[j] is None]
+                else:
+                    lo = None if frame.lower is None else k + frame.lower
+                    hi = None if frame.upper is None else k + frame.upper
+                    window = [j for j in seg
+                              if okey[j] is not None
+                              and (lo is None or okey[j] >= lo)
+                              and (hi is None or okey[j] <= hi)]
+                results[i] = _agg_py(fn.op, [vals[j] for j in window],
+                                     fn.ignore_nulls)
+            return
+        # bounded ROW frame
+        for r, i in enumerate(seg):
+            lo = 0 if frame.lower is None else max(0, r + frame.lower)
+            hi = len(seg) - 1 if frame.upper is None else \
+                min(len(seg) - 1, r + frame.upper)
+            window_rows = [vals[j] for j in seg[lo:hi + 1]] \
+                if lo <= hi else []
+            results[i] = _agg_py(fn.op, window_rows, fn.ignore_nulls)
+        return
     raise NotImplementedError(f"cpu window fn {type(fn).__name__}")

@@ -32,15 +32,20 @@ UNBOUNDED = None
 
 @dataclass
 class WindowFrame:
-    """Row-based frame [lower, upper] relative to current row; None = unbounded.
-    (Range frames supported for the whole-partition case, like the reference's
-    limited range support.)"""
+    """Frame [lower, upper] relative to the current row; None = unbounded.
+
+    ``is_range=False``: ROW frame — offsets are row positions
+    (GpuWindowExpression row-based frames, GpuWindowExpression.scala:734).
+    ``is_range=True``: RANGE frame — offsets are in ORDER-KEY value units
+    over a single ascending 32-bit-or-narrower numeric/date key (the same
+    scope the reference supports: range frames on timestamp-days)."""
     lower: Optional[int] = UNBOUNDED    # e.g. None = UNBOUNDED PRECEDING
     upper: Optional[int] = 0            # 0 = CURRENT ROW
+    is_range: bool = False
 
     @property
     def is_unbounded_to_current(self) -> bool:
-        return self.lower is None and self.upper == 0
+        return self.lower is None and self.upper == 0 and not self.is_range
 
     @property
     def is_whole_partition(self) -> bool:
@@ -291,3 +296,142 @@ def whole_partition_agg(op: str, col: Optional[Column], seg_ids: jnp.ndarray,
     red = segment_aggregate(spec, seg_ids, live, capacity)
     out = K.gather_column(red, seg_ids, out_valid=live)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Bounded frames: N PRECEDING .. M FOLLOWING (row and range)
+# Reference: GpuWindowExpression.scala:734-800 lowers these to cudf
+# rolling-window aggregations; TPU-first they become prefix-sum gathers
+# (sum/count/avg) and doubling-table range-minimum queries (min/max) over
+# per-row [lo, hi] index bounds — no rolling kernel needed, and the same
+# aggregation code serves ROW and RANGE frames once bounds are computed.
+# ---------------------------------------------------------------------------
+
+def segment_positions(seg_ids: jnp.ndarray, starts: jnp.ndarray,
+                      live: jnp.ndarray, capacity: int):
+    """(seg_start_pos, seg_end_pos) row indices per row."""
+    pos = jnp.arange(capacity, dtype=jnp.int64)
+    seg_start = _seg_base(pos, starts, seg_ids, capacity).astype(jnp.int64)
+    seg_len = jax.ops.segment_sum(live.astype(jnp.int64), seg_ids,
+                                  num_segments=capacity)[seg_ids]
+    return seg_start, seg_start + jnp.maximum(seg_len - 1, 0)
+
+
+def frame_bounds_rows(seg_ids, starts, live, capacity: int,
+                      lower: Optional[int], upper: Optional[int]):
+    """Per-row [lo, hi] row-index bounds of a ROW frame, clamped to the
+    row's segment. hi < lo marks an empty window."""
+    pos = jnp.arange(capacity, dtype=jnp.int64)
+    seg_start, seg_end = segment_positions(seg_ids, starts, live, capacity)
+    lo = seg_start if lower is None else jnp.maximum(pos + lower, seg_start)
+    hi = seg_end if upper is None else jnp.minimum(pos + upper, seg_end)
+    return lo, hi
+
+
+def frame_bounds_range(order_col: Column, seg_ids, starts, live,
+                       capacity: int, lower: Optional[int],
+                       upper: Optional[int]):
+    """Per-row [lo, hi] bounds of a RANGE frame over one ASCENDING order key
+    of <=32-bit storage: rows whose key lies in [key-lower_off, key+upper_off].
+
+    Key + segment pack into one uint64 composite
+    ``(seg_id << 33) | (valid_bit << 32) | encoded_key32`` which is globally
+    sorted (data is segment-then-key sorted), so a single searchsorted per
+    bound finds the window. NULL order keys form their own frame group
+    (Spark semantics): their window is exactly the segment's null run.
+    """
+    from . import kernels as K
+
+    k = order_col.data.astype(jnp.int64)
+    # order-preserving 32-bit encoding (sign-flip), computed in int64 so the
+    # value offsets cannot wrap
+    def enc(v):
+        v = jnp.clip(v, -(1 << 31), (1 << 31) - 1)
+        return (v + (1 << 31)).astype(jnp.uint64)
+
+    valid_bit = jnp.where(order_col.validity, jnp.uint64(1), jnp.uint64(0))
+    seg64 = seg_ids.astype(jnp.uint64)
+    comp = (seg64 << jnp.uint64(33)) | (valid_bit << jnp.uint64(32)) | enc(k)
+    # padding rows must sort last
+    comp = jnp.where(live, comp, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+
+    # signed offsets, same convention as ROW frames: window keys lie in
+    # [k + lower, k + upper] (lower is typically negative: "X PRECEDING")
+    lo_key = (seg64 << jnp.uint64(33)) | (valid_bit << jnp.uint64(32)) | (
+        jnp.uint64(0) if lower is None else enc(k + int(lower)))
+    hi_key = (seg64 << jnp.uint64(33)) | (valid_bit << jnp.uint64(32)) | (
+        jnp.uint64(0xFFFFFFFF) if upper is None else enc(k + int(upper)))
+    lo = jnp.searchsorted(comp, lo_key, side="left").astype(jnp.int64)
+    hi = jnp.searchsorted(comp, hi_key, side="right").astype(jnp.int64) - 1
+    return lo, hi
+
+
+def _prefix_pad(vals: jnp.ndarray) -> jnp.ndarray:
+    """[0, cumsum(vals)] so windowed sums are P[hi+1] - P[lo]."""
+    return jnp.concatenate([jnp.zeros(1, vals.dtype), jnp.cumsum(vals)])
+
+
+def bounded_frame_agg(op: str, col: Optional[Column], lo: jnp.ndarray,
+                      hi: jnp.ndarray, live: jnp.ndarray,
+                      capacity: int) -> Column:
+    """Aggregate over per-row [lo, hi] row windows. Empty windows (hi < lo)
+    produce NULL (count: 0, Spark semantics)."""
+    empty = hi < lo
+    loc = jnp.clip(lo, 0, capacity - 1)
+    hic = jnp.clip(hi, 0, capacity - 1)
+
+    if op in ("count", "count_star"):
+        contrib = live if op == "count_star" else (live & col.validity)
+        P = _prefix_pad(contrib.astype(jnp.int64))
+        cnt = jnp.where(empty, 0, P[hic + 1] - P[loc])
+        return Column(dt.INT64, cnt, live)
+
+    contrib = live & col.validity
+    if op in ("sum", "avg"):
+        from .aggregates import _sum_dtype
+        out_t = _sum_dtype(col.dtype)
+        d = jnp.where(contrib, col.data.astype(out_t.numpy_dtype),
+                      jnp.zeros((), out_t.numpy_dtype))
+        P = _prefix_pad(d)
+        s = P[hic + 1] - P[loc]
+        C = _prefix_pad(contrib.astype(jnp.int64))
+        cnt = C[hic + 1] - C[loc]
+        has = (cnt > 0) & ~empty & live
+        if op == "sum":
+            return Column(out_t, jnp.where(has, s, 0), has)
+        data = jnp.where(has, s.astype(jnp.float64) /
+                         jnp.maximum(cnt.astype(jnp.float64), 1.0), 0.0)
+        return Column(dt.FLOAT64, data, has)
+
+    if op in ("min", "max"):
+        if col.dtype.is_floating:
+            fill = jnp.inf if op == "min" else -jnp.inf
+        else:
+            info = jnp.iinfo(col.data.dtype)
+            fill = info.max if op == "min" else info.min
+        d = jnp.where(contrib, col.data, jnp.asarray(fill, col.data.dtype))
+        fn = jnp.minimum if op == "min" else jnp.maximum
+        # doubling (sparse) table: T[k][i] = agg over rows [i, i + 2^k)
+        levels = [d]
+        span = 1
+        while span < capacity:
+            prev = levels[-1]
+            shifted = jnp.concatenate(
+                [prev[span:], jnp.full(span, fill, prev.dtype)])
+            levels.append(fn(prev, shifted))
+            span *= 2
+        T = jnp.stack(levels)                       # [K, cap]
+        length = jnp.maximum(hi - lo + 1, 1)
+        kidx = jnp.floor(jnp.log2(length.astype(jnp.float64))
+                         ).astype(jnp.int64)
+        left = T[kidx, loc]
+        right_pos = jnp.clip(hic - (1 << kidx.astype(jnp.int64)) + 1,
+                             0, capacity - 1)
+        right = T[kidx, right_pos]
+        out = fn(left, right)
+        C = _prefix_pad(contrib.astype(jnp.int64))
+        has = ((C[hic + 1] - C[loc]) > 0) & ~empty & live
+        return Column(col.dtype,
+                      jnp.where(has, out, jnp.zeros((), out.dtype)), has)
+
+    raise ValueError(f"bounded frame agg {op} unsupported")

@@ -80,6 +80,11 @@ for k in (ex.Literal, ex.ColumnRef, ex.BoundReference, ex.Alias,
 for sub in mo.UnaryMath.__subclasses__():
     _expr(sub)
 
+from ..ops import window as _W  # noqa: E402
+for k in (_W.WindowExpression, _W.RowNumber, _W.Rank, _W.DenseRank,
+          _W.Lead, _W.Lag):
+    _expr(k)
+
 # incompat expressions: results can differ from Spark in corner cases
 # (GpuOverrides incompat doc chaining, GpuOverrides.scala:84-97)
 _EXPR_RULES[st.Upper] = ExprRule(st.Upper, incompat="ASCII-only case mapping")
@@ -244,6 +249,27 @@ class PlanMeta(BaseMeta):
                         "non-equi join condition only supported for inner join")
         if isinstance(p, lp.FileScan) and p.fmt not in ("parquet", "csv", "orc"):
             self.will_not_work(f"file format {p.fmt} not supported")
+        if isinstance(p, lp.Window):
+            from ..ops import window as W
+            RANGE_KEY_TYPES = (dt.INT8, dt.INT16, dt.INT32, dt.DATE)
+            for _name, w in p.window_exprs:
+                frame = w.spec.frame
+                if frame is None or not frame.is_range:
+                    continue
+                # range frames: single ascending order key of <=32-bit
+                # storage (the reference's scope: timestamp-days,
+                # GpuWindowExpression.scala:734-800)
+                if len(w.spec.order_by) != 1:
+                    self.will_not_work(
+                        "RANGE frame needs exactly one order key")
+                elif not w.spec.order_by[0].ascending:
+                    self.will_not_work(
+                        "RANGE frame only supported for ascending order")
+                elif w.spec.order_by[0].child.dtype not in RANGE_KEY_TYPES:
+                    self.will_not_work(
+                        f"RANGE frame order key type "
+                        f"{w.spec.order_by[0].child.dtype} not supported "
+                        "(needs <=32-bit integral/date)")
 
     # -- explain (RapidsMeta.scala:261-295) ---------------------------------
     def explain(self, all_ops: bool = False, depth: int = 0) -> str:

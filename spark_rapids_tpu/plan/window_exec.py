@@ -120,9 +120,17 @@ class TpuWindowExec(TpuExec):
                                          Column(dt.BOOL, live, live),
                                          seg_ids, starts, live, cap)
                 return W.running_agg(fn.op, col, seg_ids, starts, live, cap)
-            raise NotImplementedError(
-                f"window frame {frame} not supported (row frames beyond "
-                "UNBOUNDED..CURRENT pending)")
+            # bounded frames: per-row [lo, hi] index bounds, then one
+            # windowed aggregation (GpuWindowExpression.scala:734-800)
+            if frame.is_range:
+                okey_sorted = K.gather_column(okeys[0][0], order)
+                lo, hi = W.frame_bounds_range(
+                    okey_sorted, seg_ids, starts, live, cap,
+                    frame.lower, frame.upper)
+            else:
+                lo, hi = W.frame_bounds_rows(seg_ids, starts, live, cap,
+                                             frame.lower, frame.upper)
+            return W.bounded_frame_agg(fn.op, col, lo, hi, live, cap)
         raise NotImplementedError(f"window function {type(fn).__name__}")
 
     def _order_changed(self, okeys, order, cap) -> jnp.ndarray:

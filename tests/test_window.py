@@ -133,3 +133,100 @@ def test_window_vs_cpu_random():
                 assert abs(cv - tv) < 1e-9
             else:
                 assert cv == tv, (cr, tr)
+
+
+# -- bounded frames: N PRECEDING .. M FOLLOWING (rows) + range frames --------
+# (VERDICT r3 item 9; ref: GpuWindowExpression.scala:734-800)
+
+def _golden_window(s, window_exprs, n=400, seed=5, unique_o=False):
+    import pyarrow as pa
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.cpu.engine import execute as cpu_execute
+    rng = np.random.default_rng(seed)
+    o = (rng.permutation(n) if unique_o
+         else rng.integers(0, 200, n))
+    # int32 order key: the RANGE-frame scope is <=32-bit keys (the
+    # reference's timestamp-days analog); row frames don't care
+    df = s.createDataFrame(pa.table({
+        "p": pa.array([int(x) for x in rng.integers(0, 9, n)]),
+        "o": pa.array([int(x) for x in o], type=pa.int32()),
+        "v": pa.array([None if rng.random() < 0.12 else float(x)
+                       for x in rng.normal(0, 10, n)]),
+    }))
+    plan = lp.Window(df._plan, window_exprs)
+    wdf = DataFrame(plan, s)
+    cpu = cpu_execute(wdf._analyzed())
+    tpu = wdf.collect()
+    s.assert_on_tpu()
+    cpu_rows = sorted(
+        [tuple(r) for r in cpu.itertuples(index=False, name=None)],
+        key=repr)
+    tpu_rows = sorted(tpu, key=repr)
+    assert len(cpu_rows) == len(tpu_rows)
+    for cr, tr in zip(cpu_rows, tpu_rows):
+        for cv, tv in zip(cr, tr):
+            if isinstance(cv, float) and isinstance(tv, float):
+                assert abs(cv - tv) < 1e-9, (cr, tr)
+            else:
+                assert cv == tv, (cr, tr)
+
+
+@pytest.mark.parametrize("lower,upper", [
+    (-2, 2), (-3, 0), (0, 3), (-1, 1), (None, 2), (-2, None), (1, 3),
+    (-5, -2),
+])
+@pytest.mark.parametrize("op", ["sum", "count", "min", "max", "avg"])
+def test_row_frames_golden(op, lower, upper):
+    s = _session()
+    from spark_rapids_tpu.ops.expressions import ColumnRef
+    # unique order keys keep the row-frame comparison deterministic under
+    # sort ties
+    _golden_window(s, [
+        (f"w", W.WindowExpression(
+            lp.AggregateExpression(op, ColumnRef("v")),
+            _spec(frame=W.WindowFrame(lower, upper)))),
+    ], n=350, unique_o=True)
+
+
+def test_row_frame_count_star_and_multibatch_partitions():
+    s = _session()
+    _golden_window(s, [
+        ("c", W.WindowExpression(
+            lp.AggregateExpression("count_star", None),
+            _spec(frame=W.WindowFrame(-4, 4)))),
+    ], n=3000, unique_o=True)
+
+
+@pytest.mark.parametrize("lower,upper", [
+    (-10, 10), (-20, 0), (0, 15), (None, 5), (-7, None),
+])
+def test_range_frames_golden(lower, upper):
+    s = _session()
+    from spark_rapids_tpu.ops.expressions import ColumnRef
+    _golden_window(s, [
+        ("rs", W.WindowExpression(
+            lp.AggregateExpression("sum", ColumnRef("v")),
+            _spec(frame=W.WindowFrame(lower, upper, is_range=True)))),
+        ("rc", W.WindowExpression(
+            lp.AggregateExpression("count", ColumnRef("v")),
+            _spec(frame=W.WindowFrame(lower, upper, is_range=True)))),
+    ], n=500)
+
+
+def test_range_frame_desc_falls_back():
+    """Descending range frames tag off to the CPU engine."""
+    s = _session()
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.ops.expressions import ColumnRef
+    df = s.createDataFrame({"p": [1, 1, 2], "o": [3, 1, 2],
+                            "v": [1.0, 2.0, 3.0]})
+    spec = W.WindowSpec([ColumnRef("p")],
+                        [lp.SortOrder(ColumnRef("o"), ascending=False)],
+                        W.WindowFrame(-2, 2, is_range=True))
+    plan = lp.Window(df._plan, [
+        ("w", W.WindowExpression(
+            lp.AggregateExpression("sum", ColumnRef("v")), spec))])
+    out = DataFrame(plan, s)
+    rows = out.collect()
+    s.assert_on_tpu(allowed_fallbacks=["Window"])
+    assert len(rows) == 3
