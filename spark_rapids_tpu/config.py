@@ -250,9 +250,15 @@ SHUFFLE_PARTITIONS = _conf("spark.rapids.tpu.sql.shuffle.partitions").doc(
 ).integer_conf.create_with_default(8)
 
 SHUFFLE_COMPRESSION_CODEC = _conf("spark.rapids.tpu.shuffle.compression.codec").doc(
-    "Codec for shuffle payloads: none, lz4 (ref: spark.rapids.shuffle.compression.codec, "
-    "RapidsConf.scala:729)").string_conf.check(
-        lambda v: v in ("none", "lz4")).create_with_default("none")
+    "Codec for shuffle transfer payloads: none, zlib (ref: spark.rapids."
+    "shuffle.compression.codec / NvcompLZ4CompressionCodec, "
+    "RapidsConf.scala:729; host-side here — no TPU decompression engine)"
+).string_conf.check(
+        lambda v: v in ("none", "zlib")).create_with_default("none")
+
+SPILL_COMPRESSION_CODEC = _conf("spark.rapids.tpu.memory.spill.compression.codec").doc(
+    "Codec for the disk spill tier: none, zlib").string_conf.check(
+        lambda v: v in ("none", "zlib")).create_with_default("none")
 
 AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(

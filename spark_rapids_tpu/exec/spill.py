@@ -98,7 +98,12 @@ class SpillableBuffer:
                 return 0
             os.makedirs(spill_dir, exist_ok=True)
             path = os.path.join(spill_dir, f"spill-{self.id}.npz")
-            np.savez(path, *self._host_arrays)
+            # codec per spill.compression.codec (TableCompressionCodec
+            # analog for the disk tier; zlib = np's deflate container)
+            from .. import config as cfg
+            codec = str(cfg.TpuConf().get(cfg.SPILL_COMPRESSION_CODEC))
+            save = np.savez_compressed if codec == "zlib" else np.savez
+            save(path, *self._host_arrays)
             self._disk_path = path
             self._host_arrays = None
             self.tier = StorageTier.DISK

@@ -1,0 +1,58 @@
+"""Tracing spans: named profiler ranges around hot regions.
+
+Reference: ``NvtxWithMetrics.scala:27`` — NVTX ranges (optionally fused with
+SQLMetrics timers) wrap every hot region so Nsight shows named spans:
+semaphore acquire (GpuSemaphore.scala:107), agg batches (aggregate.scala:435),
+shuffle write (RapidsShuffleInternalManager.scala:91).
+
+TPU analog: ``jax.profiler.TraceAnnotation`` spans show up in xprof/
+TensorBoard traces; ``start_profiler_server`` exposes the live profiler.
+Disabled (no-op, zero overhead beyond one attr check) unless
+``spark.rapids.tpu.sql.tracing.enabled`` is on.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+_enabled: Optional[bool] = None
+
+
+def _tracing_on() -> bool:
+    global _enabled
+    if _enabled is None:
+        from .. import config as cfg
+        _enabled = bool(cfg.TpuConf().get(cfg.TRACING_ENABLED))
+    return _enabled
+
+
+def reset_cache() -> None:
+    global _enabled
+    _enabled = None
+
+
+@contextmanager
+def trace_span(name: str, metrics=None, metric_key: Optional[str] = None):
+    """Named profiler span (NvtxWithMetrics: optionally also feeds a
+    metrics timer)."""
+    if not _tracing_on():
+        if metrics is not None and metric_key:
+            with metrics.timer(metric_key):
+                yield
+        else:
+            yield
+        return
+    import jax
+    import time
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    if metrics is not None and metric_key:
+        metrics.inc(metric_key, time.perf_counter() - t0)
+
+
+def start_profiler_server(port: int = 9012) -> None:
+    """Expose the live jax profiler (xprof capture target)."""
+    import jax
+    jax.profiler.start_server(port)

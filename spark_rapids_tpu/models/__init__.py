@@ -1,0 +1,9 @@
+"""ML integration: columnar export to jax / numpy / torch.
+
+Reference: docs/ml-integration.md + ColumnarRdd (SURVEY.md §2.4 #34)."""
+
+from .export import (collect_device, to_device_arrays, to_feature_matrix,
+                     to_numpy, to_torch)
+
+__all__ = ["collect_device", "to_device_arrays", "to_feature_matrix",
+           "to_numpy", "to_torch"]

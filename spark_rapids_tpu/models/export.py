@@ -1,0 +1,88 @@
+"""Columnar ML export: DataFrame -> device arrays / framework tensors.
+
+Reference: ``InternalColumnarRddConverter.scala:42-475`` + ``ColumnarRdd
+.scala:41-46`` — the zero-copy DataFrame -> RDD[cudf.Table] handoff that
+feeds XGBoost's DMatrix builder, detected via the transition-tagged
+``GpuColumnarToRowExec`` (GpuTransitionOverrides.scala:369-374).
+
+TPU-standalone: the engine's batches already hold jax device arrays, so the
+export IS zero-copy — ``collect_device`` returns the columns' arrays still
+resident on device; ``to_feature_matrix`` stacks numeric columns into the
+``[n_rows, n_features]`` f32 design matrix an XGBoost/linear trainer wants
+(one XLA transpose-free stack, no host round-trip); ``to_torch`` /
+``to_numpy`` cross to host frameworks explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+
+
+def collect_device(df) -> ColumnarBatch:
+    """Materialize a DataFrame fully on device (the ColumnarRdd.convert
+    analog: batches stay as device arrays, no row conversion)."""
+    return df.collect_batch()
+
+
+def to_device_arrays(df) -> Dict[str, Tuple]:
+    """{column name: (data, validity)} jax arrays, sliced to num_rows."""
+    batch = collect_device(df)
+    out = {}
+    for f, c in zip(batch.schema, batch.columns):
+        out[f.name] = (c.data[:batch.num_rows], c.validity[:batch.num_rows])
+    return out
+
+
+def to_feature_matrix(df, feature_cols: Optional[List[str]] = None,
+                      label_col: Optional[str] = None,
+                      nan_for_null: bool = True):
+    """(features f32[n, k], labels f32[n] | None): the DMatrix handoff.
+
+    NULLs become NaN (XGBoost's missing-value convention) when
+    ``nan_for_null``; non-numeric columns are rejected."""
+    import jax.numpy as jnp
+    batch = collect_device(df)
+    names = feature_cols or [
+        f.name for f in batch.schema
+        if f.name != label_col and (f.dtype.is_numeric or f.dtype == dt.BOOL)]
+    cols = []
+    for n in names:
+        c = batch.column(n)
+        f = batch.schema[batch.schema.index_of(n)]
+        if not (f.dtype.is_numeric or f.dtype == dt.BOOL):
+            raise TypeError(f"feature column {n!r} is {f.dtype}, not numeric")
+        d = c.data[:batch.num_rows].astype(jnp.float32)
+        if nan_for_null:
+            d = jnp.where(c.validity[:batch.num_rows], d, jnp.nan)
+        cols.append(d)
+    feats = jnp.stack(cols, axis=1) if cols else jnp.zeros((0, 0), jnp.float32)
+    labels = None
+    if label_col is not None:
+        lc = batch.column(label_col)
+        labels = lc.data[:batch.num_rows].astype(jnp.float32)
+    return feats, labels
+
+
+def to_numpy(df) -> Dict[str, "np.ndarray"]:
+    """Host numpy arrays (masked: NULL -> NaN for floats, None-able object
+    arrays are avoided — validity returned alongside)."""
+    import numpy as np
+    out = {}
+    for name, (data, valid) in to_device_arrays(df).items():
+        out[name] = (np.asarray(data), np.asarray(valid))
+    return out
+
+
+def to_torch(df, feature_cols: Optional[List[str]] = None,
+             label_col: Optional[str] = None):
+    """(features, labels) torch CPU tensors for torch-side training."""
+    import numpy as np
+    import torch
+    feats, labels = to_feature_matrix(df, feature_cols, label_col)
+    t_feats = torch.from_numpy(np.array(feats))
+    t_labels = torch.from_numpy(np.array(labels)) \
+        if labels is not None else None
+    return t_feats, t_labels

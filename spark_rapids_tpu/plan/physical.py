@@ -200,9 +200,12 @@ def _task_begin() -> None:
     """Device admission at task (partition evaluation) start: the semaphore
     bounds concurrently-executing device tasks. Ordering contract preserved
     from the reference (GpuSemaphore.scala:74-78): acquire after host-side
-    input is ready, before device work."""
+    input is ready, before device work. Traced like the reference's NVTX
+    span around the acquire (GpuSemaphore.scala:107)."""
     from ..exec.device import TpuSemaphore
-    TpuSemaphore.get().acquire_if_necessary()
+    from ..exec.tracing import trace_span
+    with trace_span("semaphore_acquire"):
+        TpuSemaphore.get().acquire_if_necessary()
 
 
 def _reserve(nbytes: int) -> None:
@@ -384,6 +387,7 @@ class FusedStage:
         if self.broken:
             return None
         import jax.numpy as jnp
+        from ..exec.tracing import trace_span
         try:
             if self._fn is None:
                 ekeys = [_expr_cache_key(e) for e in self.exprs]
@@ -393,7 +397,9 @@ class FusedStage:
                     key = (self.mode, _schema_sig(self.in_schema),
                            tuple(ekeys))
                     self._fn = _fused_fn(key, self._build)
-            outs = self._fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
+            with trace_span(f"fused_{self.mode}"):
+                outs = self._fn(jnp.int32(batch.num_rows),
+                                *batch.flat_arrays())
         except _ScalarPredicate:
             self.broken = True
             return None

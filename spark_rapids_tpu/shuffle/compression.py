@@ -1,0 +1,62 @@
+"""Shuffle/spill compression codecs.
+
+Reference: ``TableCompressionCodec.scala:41-107`` + ``NvcompLZ4Compression
+Codec.scala:25`` + ``CopyCompressionCodec.scala`` — batched device
+compression for shuffle payloads, codec chosen by
+``spark.rapids.shuffle.compression.codec`` (RapidsConf.scala:729).
+
+TPU-standalone: there is no device decompression engine, so codecs run
+host-side on the staged bytes — exactly where the transfer server and the
+disk spill tier already hold them. ``zlib`` ships with CPython; the codec
+interface leaves room for zstd/lz4 wheels when present.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, Optional
+
+
+class Codec:
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        return data
+
+
+class CopyCodec(Codec):
+    """Identity (CopyCompressionCodec.scala analog)."""
+
+
+class ZlibCodec(Codec):
+    name = "zlib"
+
+    def __init__(self, level: int = 1):
+        # level 1: shuffle payloads favor speed over ratio (the reference's
+        # nvcomp LZ4 is likewise a speed-first codec)
+        self.level = level
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        out = zlib.decompress(data)
+        if uncompressed_size and len(out) != uncompressed_size:
+            raise ValueError(
+                f"decompressed {len(out)} bytes, expected "
+                f"{uncompressed_size}")
+        return out
+
+
+_CODECS: Dict[str, Codec] = {"none": CopyCodec(), "zlib": ZlibCodec()}
+
+
+def get_codec(name: Optional[str]) -> Codec:
+    codec = _CODECS.get((name or "none").lower())
+    if codec is None:
+        raise ValueError(f"unknown compression codec {name!r} "
+                         f"(available: {sorted(_CODECS)})")
+    return codec

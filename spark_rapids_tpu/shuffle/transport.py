@@ -143,9 +143,12 @@ class ShuffleServer:
     """Serves shuffle metadata + windowed buffer streams over TCP."""
 
     def __init__(self, store: ShuffleStore, host: str = "127.0.0.1",
-                 port: int = 0, chunk_bytes: int = wire.DEFAULT_CHUNK_BYTES):
+                 port: int = 0, chunk_bytes: int = wire.DEFAULT_CHUNK_BYTES,
+                 codec: str = "none"):
+        from .compression import get_codec
         self.store = store
         self.chunk_bytes = chunk_bytes
+        self.codec = get_codec(codec)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -211,10 +214,13 @@ class ShuffleServer:
                 return
             ranges = wire.chunk_ranges(len(payload), self.chunk_bytes)
             for seq, (off, ln) in enumerate(ranges):
-                body = payload[off:off + ln]
+                raw = payload[off:off + ln]
+                body = self.codec.compress(raw)
                 conn.send(encode_frame(XFER_CHUNK, {
                     "buffer_id": bid, "seq": seq, "n_chunks": len(ranges),
-                    "offset": off, "crc32": wire.chunk_crc(body)}, body))
+                    "offset": off, "raw_len": ln,
+                    "codec": self.codec.name,
+                    "crc32": wire.chunk_crc(body)}, body))
         conn.send(encode_frame(XFER_DONE, {"buffer_ids": buffer_ids}))
 
     def stop(self) -> None:
@@ -323,6 +329,11 @@ class ShuffleClient:
                 bid = header["buffer_id"]
                 if wire.chunk_crc(payload) != header["crc32"]:
                     raise ValueError(f"chunk crc mismatch for buffer {bid}")
+                codec_name = header.get("codec", "none")
+                if codec_name != "none":
+                    from .compression import get_codec
+                    payload = get_codec(codec_name).decompress(
+                        payload, header.get("raw_len", 0))
                 buf = received.setdefault(
                     bid, bytearray(inflight[bid].total_bytes))
                 buf[header["offset"]:header["offset"] + len(payload)] = \
