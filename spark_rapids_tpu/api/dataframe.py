@@ -55,7 +55,39 @@ class DataFrame:
         if not cols:
             cols = tuple(self.columns)
         exprs = [_to_expr(c) for c in cols]
+        gen = self._lift_generator(exprs)
+        if gen is not None:
+            return gen
         return self._df(lp.Project(self._plan, exprs))
+
+    def _lift_generator(self, exprs) -> Optional["DataFrame"]:
+        """explode/posexplode in a select lifts into a Generate node under
+        the projection (Catalyst's ExtractGenerator rule)."""
+        from ..ops import arrays as ar_ops
+
+        def inner(e):
+            return e.children[0] if isinstance(e, ex.Alias) else e
+
+        gen_idx = [i for i, e in enumerate(exprs)
+                   if isinstance(inner(e), ar_ops.Explode)]
+        if not gen_idx:
+            return None
+        if len(gen_idx) > 1:
+            raise ValueError("only one generator per select (Spark rule)")
+        i = gen_idx[0]
+        e = exprs[i]
+        g_expr = inner(e)
+        col_name = e.alias if isinstance(e, ex.Alias) else "col"
+        g = lp.Generate(self._plan, g_expr, col_name=col_name)
+        out = []
+        for j, e2 in enumerate(exprs):
+            if j == i:
+                if g_expr.pos:
+                    out.append(ex.ColumnRef("pos"))
+                out.append(ex.ColumnRef(col_name))
+            else:
+                out.append(e2)
+        return self._df(lp.Project(g, out))
 
     def selectExpr(self, *exprs: str) -> "DataFrame":
         raise NotImplementedError("SQL string expressions need the parser")

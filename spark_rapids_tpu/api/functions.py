@@ -360,3 +360,31 @@ def lead(c, offset: int = 1, default=None) -> Col:
 def lag(c, offset: int = 1, default=None) -> Col:
     from ..ops.window import Lag
     return Col(Lag(_unwrap(col(c) if isinstance(c, str) else c), offset, default))
+
+
+# -- arrays / generators (complexTypeExtractors + GpuGenerateExec analogs) ---
+
+def explode(c) -> Col:
+    from ..ops import arrays as ar_ops
+    return Col(ar_ops.Explode(_unwrap(c)))
+
+
+def posexplode(c) -> Col:
+    from ..ops import arrays as ar_ops
+    return Col(ar_ops.Explode(_unwrap(c), pos=True))
+
+
+def split(c, delimiter: str) -> Col:
+    from ..ops import arrays as ar_ops
+    return Col(ar_ops.StringSplit(_unwrap(c), delimiter))
+
+
+def size(c) -> Col:
+    from ..ops import arrays as ar_ops
+    return Col(ar_ops.Size(_unwrap(c)))
+
+
+def get_item(c, index) -> Col:
+    from ..ops import arrays as ar_ops
+    idx = _unwrap(index) if isinstance(index, Col) else ex.Literal(int(index), dt.INT32)
+    return Col(ar_ops.GetArrayItem(_unwrap(c), idx))

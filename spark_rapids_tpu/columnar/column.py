@@ -63,10 +63,11 @@ class Column:
         self.data = data
         self.validity = validity
         self.lengths = lengths
-        if dtype == dt.STRING:
-            assert lengths is not None and data.ndim == 2, "string column needs lengths + 2D data"
+        if dtype.var_width:
+            assert lengths is not None and data.ndim == 2, \
+                "var-width (string/array) column needs lengths + 2D data"
         else:
-            assert data.ndim == 1, f"non-string column must be 1D, got {data.ndim}D"
+            assert data.ndim == 1, f"fixed-width column must be 1D, got {data.ndim}D"
 
     # -- capacity / shape ----------------------------------------------------
     @property
@@ -75,8 +76,8 @@ class Column:
 
     @property
     def byte_width(self) -> int:
-        """Padded byte width for strings; storage width for fixed types."""
-        if self.dtype == dt.STRING:
+        """Padded width for var-width columns; storage width for fixed types."""
+        if self.dtype.var_width:
             return int(self.data.shape[1])
         return self.dtype.byte_width
 
@@ -96,7 +97,7 @@ class Column:
     def with_arrays(self, data, validity, lengths=None) -> "Column":
         return Column(self.dtype, data, validity,
                       lengths if lengths is not None else
-                      (None if self.dtype != dt.STRING else self.lengths))
+                      (self.lengths if self.dtype.var_width else None))
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -127,6 +128,26 @@ class Column:
                     width: Optional[int] = None) -> "Column":
         n = len(values)
         valid_np = np.array([v is not None for v in values], dtype=np.bool_)
+        if dt.is_array(dtype):
+            # ARRAY<primitive>: padded element matrix + per-row lengths
+            # (NULL elements inside arrays are out of scope; see ops/arrays)
+            max_len = max((len(v) for v in values if v is not None),
+                          default=0)
+            w = width or bucket(max_len, 4)
+            cap = capacity or bucket(n)
+            mat = np.zeros((cap, w), dtype=dtype.numpy_dtype)
+            lens = np.zeros(cap, dtype=np.int32)
+            for i, v in enumerate(values):
+                if v is None:
+                    continue
+                if any(e is None for e in v):
+                    raise ValueError("NULL array elements not supported")
+                mat[i, :len(v)] = np.asarray(v, dtype=dtype.numpy_dtype)
+                lens[i] = len(v)
+            valid_full = np.zeros(cap, np.bool_)
+            valid_full[:n] = valid_np
+            return Column(dtype, jnp.asarray(mat), jnp.asarray(valid_full),
+                          jnp.asarray(lens))
         if dtype == dt.STRING:
             encoded = [v.encode("utf-8") if isinstance(v, str)
                        else (v if isinstance(v, bytes) else b"") for v in values]
@@ -156,8 +177,8 @@ class Column:
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
         dtype = dt.from_arrow(arr.type)
-        if dtype == dt.STRING:
-            return Column.from_pylist(arr.to_pylist(), dt.STRING, capacity, width)
+        if dtype == dt.STRING or dt.is_array(dtype):
+            return Column.from_pylist(arr.to_pylist(), dtype, capacity, width)
         np_valid = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 else \
             np.asarray(arr.is_valid())
         if dtype == dt.TIMESTAMP:
@@ -177,6 +198,10 @@ class Column:
         if dtype == dt.STRING:
             return Column(dtype, jnp.zeros((capacity, width), dtype=jnp.uint8), valid,
                           jnp.zeros(capacity, dtype=jnp.int32))
+        if dtype.var_width:              # ARRAY<primitive>
+            return Column(dtype,
+                          jnp.zeros((capacity, width), dtype=dtype.numpy_dtype),
+                          valid, jnp.zeros(capacity, dtype=jnp.int32))
         return Column(dtype, jnp.zeros(capacity, dtype=dtype.numpy_dtype), valid)
 
     @staticmethod
@@ -200,6 +225,14 @@ class Column:
 
     def to_pylist(self, num_rows: int) -> List[Any]:
         valid = np.asarray(self.validity[:num_rows])
+        if dt.is_array(self.dtype):
+            mat = np.asarray(self.data[:num_rows])
+            lens = np.asarray(self.lengths[:num_rows])
+            elem = self.dtype.element
+            conv = (int if elem.is_integral or elem in (dt.DATE, dt.TIMESTAMP)
+                    else bool if elem == dt.BOOL else float)
+            return [[conv(x) for x in mat[i, :lens[i]]] if valid[i] else None
+                    for i in range(num_rows)]
         if self.dtype == dt.STRING:
             mat = np.asarray(self.data[:num_rows])
             lens = np.asarray(self.lengths[:num_rows])
@@ -220,8 +253,9 @@ class Column:
     def to_arrow(self, num_rows: int):
         import pyarrow as pa
         valid = np.asarray(self.validity[:num_rows])
-        if self.dtype == dt.STRING:
-            return pa.array(self.to_pylist(num_rows), type=pa.string())
+        if self.dtype == dt.STRING or dt.is_array(self.dtype):
+            return pa.array(self.to_pylist(num_rows),
+                            type=dt.to_arrow(self.dtype))
         data = np.asarray(self.data[:num_rows])
         mask = ~valid  # pyarrow mask semantics: True = null
         if self.dtype == dt.DATE:
@@ -231,5 +265,5 @@ class Column:
         return pa.array(data, type=dt.to_arrow(self.dtype), mask=mask)
 
     def __repr__(self):
-        extra = f", width={self.data.shape[1]}" if self.dtype == dt.STRING else ""
+        extra = f", width={self.data.shape[1]}" if self.dtype.var_width else ""
         return f"Column({self.dtype}, cap={self.capacity}{extra})"

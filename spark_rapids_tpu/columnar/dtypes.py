@@ -25,6 +25,8 @@ class DType:
     is_integral: bool = False
     is_floating: bool = False
     byte_width: int = 0               # fixed-width storage bytes (0 for string)
+    var_width: bool = False           # 2-D padded data + lengths (string/array)
+    element: Optional["DType"] = None  # ARRAY element type
 
     def __repr__(self) -> str:
         return self.name
@@ -37,7 +39,7 @@ INT32 = DType("int", np.dtype(np.int32), True, True, byte_width=4)
 INT64 = DType("bigint", np.dtype(np.int64), True, True, byte_width=8)
 FLOAT32 = DType("float", np.dtype(np.float32), True, is_floating=True, byte_width=4)
 FLOAT64 = DType("double", np.dtype(np.float64), True, is_floating=True, byte_width=8)
-STRING = DType("string", None, byte_width=0)
+STRING = DType("string", None, byte_width=0, var_width=True)
 DATE = DType("date", np.dtype(np.int32), byte_width=4)            # days since epoch
 TIMESTAMP = DType("timestamp", np.dtype(np.int64), byte_width=8)  # micros since epoch
 NULLTYPE = DType("null", np.dtype(np.bool_), byte_width=1)
@@ -53,6 +55,28 @@ _ALIASES = {
 INTEGRAL_TYPES = [INT8, INT16, INT32, INT64]
 NUMERIC_TYPES = INTEGRAL_TYPES + [FLOAT32, FLOAT64]
 ORDERABLE_TYPES = NUMERIC_TYPES + [BOOL, STRING, DATE, TIMESTAMP]
+
+_ARRAY_CACHE: dict = {}
+
+
+def ARRAY(element: DType) -> DType:
+    """ARRAY<element> of a fixed-width primitive: stored like strings —
+    padded element matrix ``elem_dtype[cap, W]`` + per-row lengths
+    (complexTypeExtractors.scala's list scope, TPU-first layout)."""
+    if element.var_width:
+        raise TypeError(f"nested var-width array element {element} "
+                        "not supported")
+    t = _ARRAY_CACHE.get(element.name)
+    if t is None:
+        t = DType(f"array<{element.name}>", element.numpy_dtype,
+                  var_width=True, element=element)
+        _ARRAY_CACHE[element.name] = t
+        _BY_NAME[t.name] = t
+    return t
+
+
+def is_array(t: DType) -> bool:
+    return t.element is not None
 
 
 def of(name_or_dtype: Any) -> DType:
@@ -91,6 +115,8 @@ def from_arrow(arrow_type) -> DType:
     if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type): return STRING
     if pa.types.is_date32(arrow_type): return DATE
     if pa.types.is_timestamp(arrow_type): return TIMESTAMP
+    if pa.types.is_list(arrow_type) or pa.types.is_large_list(arrow_type):
+        return ARRAY(from_arrow(arrow_type.value_type))
     raise ValueError(f"unsupported arrow type {arrow_type}")
 
 
@@ -101,6 +127,8 @@ def to_arrow(t: DType):
         INT64: pa.int64(), FLOAT32: pa.float32(), FLOAT64: pa.float64(),
         STRING: pa.string(), DATE: pa.date32(), TIMESTAMP: pa.timestamp("us"),
     }
+    if is_array(t):
+        return pa.list_(to_arrow(t.element))
     return mapping[t]
 
 

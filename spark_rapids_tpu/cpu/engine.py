@@ -69,6 +69,25 @@ class CpuEvaluator:
     def _eval(self, e: ex.Expression):
         if isinstance(e, ex.Literal):
             return [e.value] * self.n
+        from ..ops import arrays as ar_ops
+        if isinstance(e, ar_ops.StringSplit):
+            vals = self._eval(e.children[0])
+            return [None if v is None else v.split(e.delimiter)
+                    for v in vals]
+        if isinstance(e, ar_ops.Size):
+            vals = self._eval(e.children[0])
+            # Spark 3.0 legacy sizeOfNull: size(NULL) = -1
+            return [-1 if v is None else len(v) for v in vals]
+        if isinstance(e, ar_ops.GetArrayItem):
+            arrs = self._eval(e.children[0])
+            idxs = self._eval(e.children[1])
+            out = []
+            for a, i in zip(arrs, idxs):
+                if a is None or i is None or not (0 <= int(i) < len(a)):
+                    out.append(None)
+                else:
+                    out.append(a[int(i)])
+            return out
         if isinstance(e, ex.ColumnRef):
             return self._col_by_name(e.col_name)
         if isinstance(e, ex.BoundReference):
@@ -827,6 +846,25 @@ def _exec(plan: lp.LogicalPlan) -> pd.DataFrame:
     if isinstance(plan, lp.Window):
         from .window import exec_window_cpu
         return exec_window_cpu(plan, _exec(plan.children[0]))
+    if isinstance(plan, lp.Generate):
+        child = _exec(plan.children[0])
+        ev = CpuEvaluator(child)
+        gen = plan.generator
+        arrays = ev.eval(gen.children[0])
+        rows, poss, elems = [], [], []
+        for i, a in enumerate(arrays):
+            if a is None:
+                continue
+            for p_i, v in enumerate(a):
+                rows.append(i)
+                poss.append(p_i)
+                elems.append(v)
+        out = child.iloc[rows].reset_index(drop=True) if len(child) else \
+            child.iloc[0:0]
+        if getattr(gen, "pos", False):
+            out[plan.pos_name] = pd.Series(poss, dtype=object)
+        out[plan.col_name] = pd.Series(elems, dtype=object)
+        return out
     raise NotImplementedError(f"CPU engine: {plan.name}")
 
 

@@ -126,7 +126,7 @@ class SpillableBuffer:
         cols: List[Column] = []
         i = 0
         for f in self.meta.schema:
-            if f.dtype == dt.STRING:
+            if f.dtype.var_width:
                 cols.append(Column(f.dtype, arrays[i], arrays[i + 1], arrays[i + 2]))
                 i += 3
             else:

@@ -1,0 +1,216 @@
+"""ARRAY expressions + explode kernels.
+
+Reference: ``complexTypeExtractors.scala`` (GetArrayItem), ``collection
+OperationsExprs`` (size), ``GpuGenerateExec.scala`` (explode/posexplode via
+per-row repeat + flatten), ``stringFunctions.scala`` StringSplit.
+
+TPU-first layout: ARRAY<primitive> is a padded element matrix
+``elem[cap, W]`` + ``lengths[cap]`` (same shape discipline as strings —
+static shapes, vectorizable). NULL elements inside arrays are out of scope
+(split/sequence-produced arrays never contain them).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar, bucket
+from . import kernels as K
+from .expressions import Expression
+
+
+class GetArrayItem(Expression):
+    """arr[i] (complexTypeExtractors.scala GetArrayItem): out-of-bounds or
+    NULL array -> NULL."""
+
+    def __init__(self, child: Expression, index: Expression):
+        super().__init__(child, index)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.element
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        from .expressions import materialize
+        arr = materialize(self.children[0].eval(batch), batch)
+        idx = self.children[1].eval(batch)
+        cap, w = arr.data.shape
+        if isinstance(idx, Scalar):
+            if idx.is_null:
+                return Column.full_null(self.dtype, cap)
+            i = jnp.full(cap, int(idx.value), jnp.int32)
+            ivalid = jnp.ones(cap, jnp.bool_)
+        else:
+            i = idx.data.astype(jnp.int32)
+            ivalid = idx.validity
+        ok = arr.validity & ivalid & (i >= 0) & (i < arr.lengths)
+        ic = jnp.clip(i, 0, w - 1)
+        data = jnp.take_along_axis(arr.data, ic[:, None], axis=1)[:, 0]
+        data = jnp.where(ok, data, jnp.zeros((), data.dtype))
+        return Column(self.dtype, data, ok)
+
+
+class Size(Expression):
+    """size(arr): Spark 3.0 legacy semantics — size(NULL) = -1
+    (spark.sql.legacy.sizeOfNull defaults true in the reference era)."""
+
+    @property
+    def dtype(self):
+        return dt.INT32
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, batch: ColumnarBatch):
+        from .expressions import materialize
+        arr = materialize(self.children[0].eval(batch), batch)
+        data = jnp.where(arr.validity, arr.lengths, jnp.int32(-1))
+        live = batch.row_mask()
+        return Column(dt.INT32, jnp.where(live, data, 0), live)
+
+
+class Explode(Expression):
+    """Generator marker: planned by TpuGenerateExec, never evaluated inline
+    (GpuGenerateExec.scala). ``pos=True`` = posexplode."""
+
+    def __init__(self, child: Expression, pos: bool = False):
+        super().__init__(child)
+        self.pos = pos
+
+    @property
+    def dtype(self):
+        t = self.children[0].dtype
+        if isinstance(self.children[0], StringSplit):
+            return dt.STRING
+        return t.element if t.element is not None else t
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch):
+        raise RuntimeError("Explode is planned by TpuGenerateExec")
+
+
+class StringSplit(Expression):
+    """split(str, delim) -> array<string>. Single-byte literal delimiters
+    run on-device fused with explode (GpuGenerateExec path); other shapes
+    tag off to the CPU engine (the reference likewise gates its regex
+    delimiters, GpuOverrides.scala:343-351)."""
+
+    def __init__(self, child: Expression, delimiter: str):
+        super().__init__(child)
+        self.delimiter = delimiter
+
+    @property
+    def dtype(self):
+        # array<string>: only consumed through explode (fused) or CPU
+        return dt.ARRAY(dt.INT32)   # placeholder element; see Explode.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch):
+        raise RuntimeError("StringSplit is planned (explode-fused) or "
+                           "runs on the CPU engine")
+
+
+# ---------------------------------------------------------------------------
+# Explode kernels
+# ---------------------------------------------------------------------------
+
+def explode_indices(lengths: jnp.ndarray, valid: jnp.ndarray,
+                    live: jnp.ndarray, out_cap: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(src_row, elem_pos, out_count) mapping output rows to (row, element).
+    NULL/empty arrays produce no rows (Spark explode)."""
+    n = jnp.where(live & valid, lengths, 0).astype(jnp.int64)
+    cum = jnp.cumsum(n)
+    total = cum[-1] if n.shape[0] else jnp.int64(0)
+    out_i = jnp.arange(out_cap, dtype=jnp.int64)
+    src = jnp.searchsorted(cum, out_i, side="right").astype(jnp.int32)
+    src = jnp.clip(src, 0, n.shape[0] - 1)
+    base = cum[src] - n[src]
+    elem = (out_i - base).astype(jnp.int32)
+    out_live = out_i < total
+    return (jnp.where(out_live, src, 0),
+            jnp.where(out_live, elem, 0),
+            total.astype(jnp.int32))
+
+
+def explode_array(arr: Column, other_cols: List[Column], live: jnp.ndarray,
+                  out_cap: int
+                  ) -> Tuple[List[Column], Column, Column, jnp.ndarray]:
+    """(repeated other columns, element column, pos column, out_count)."""
+    src, elem, count = explode_indices(arr.lengths, arr.validity, live,
+                                       out_cap)
+    out_live = jnp.arange(out_cap) < count
+    others = [K.gather_column(c, src, out_valid=out_live)
+              for c in other_cols]
+    w = arr.data.shape[1]
+    data = arr.data[src, jnp.clip(elem, 0, w - 1)]
+    data = jnp.where(out_live, data, jnp.zeros((), data.dtype))
+    elem_col = Column(arr.dtype.element, data, out_live)
+    pos_col = Column(dt.INT32, jnp.where(out_live, elem, 0), out_live)
+    return others, elem_col, pos_col, count
+
+
+def split_explode(col: Column, delim: int, other_cols: List[Column],
+                  live: jnp.ndarray, out_cap: int
+                  ) -> Tuple[List[Column], Column, Column, jnp.ndarray]:
+    """Fused split(str, d) + explode: one output STRING row per part,
+    without materializing the intermediate array<string>.
+
+    Spark split semantics: "a,b" -> ["a","b"]; "" -> [""]; NULL -> no rows.
+    """
+    cap, w = col.data.shape
+    in_len = col.lengths
+    is_delim = (col.data == jnp.uint8(delim)) & \
+        (jnp.arange(w)[None, :] < in_len[:, None])
+    n_parts = jnp.where(col.validity, 1 + jnp.sum(is_delim, axis=1), 0)
+
+    src, part, count = explode_indices(n_parts, col.validity, live, out_cap)
+    out_live = jnp.arange(out_cap) < count
+
+    # per-row part boundaries from delimiter ordinals: dpos[r, p] = byte
+    # position of the (p+1)-th delimiter (w when absent); then
+    #   start of part p = p == 0 ? 0 : dpos[p-1] + 1
+    #   end   of part p = min(dpos[p], len)   (last part ends at len)
+    W2 = w + 1
+    rank = jnp.cumsum(is_delim, axis=1)             # 1-based delim ordinal
+    pos_j = jnp.broadcast_to(jnp.arange(w)[None, :], (cap, w))
+    dpos = jnp.full((cap, W2), w, jnp.int32)
+    dpos = dpos.at[jnp.arange(cap)[:, None],
+                   jnp.where(is_delim, rank - 1, W2 - 1)].min(
+        jnp.where(is_delim, pos_j, w).astype(jnp.int32), mode="drop")
+
+    pc = jnp.clip(part, 0, W2 - 1)
+    prev = dpos[src, jnp.clip(pc - 1, 0, W2 - 1)]
+    p_start = jnp.where(pc == 0, 0, prev + 1)
+    p_end = jnp.minimum(dpos[src, pc], in_len[src].astype(jnp.int32))
+    p_len = jnp.maximum(p_end - p_start, 0)
+
+    # gather each part's bytes into a fresh padded matrix
+    out_w = w
+    gather_j = p_start[:, None] + jnp.arange(out_w)[None, :]
+    gather_j = jnp.clip(gather_j, 0, w - 1)
+    bytes_out = col.data[src[:, None], gather_j]
+    mask = jnp.arange(out_w)[None, :] < p_len[:, None]
+    bytes_out = jnp.where(mask & out_live[:, None], bytes_out, jnp.uint8(0))
+    elem_col = Column(dt.STRING, bytes_out, out_live,
+                      jnp.where(out_live, p_len, 0).astype(jnp.int32))
+    others = [K.gather_column(c, src, out_valid=out_live)
+              for c in other_cols]
+    pos_col = Column(dt.INT32, jnp.where(out_live, part, 0), out_live)
+    return others, elem_col, pos_col, count

@@ -200,12 +200,13 @@ def gather_column(col: Column, indices: jnp.ndarray,
     validity = col.validity[indices]
     if out_valid is not None:
         validity = validity & out_valid
-    if col.dtype == dt.STRING:
+    if col.dtype.var_width:
         keep = out_valid if out_valid is not None else None
         data = col.data[indices]
         lengths = col.lengths[indices]
         if keep is not None:
-            data = jnp.where(keep[:, None], data, jnp.uint8(0))
+            data = jnp.where(keep[:, None], data,
+                             jnp.zeros((), data.dtype))
             lengths = jnp.where(keep, lengths, jnp.int32(0))
         return Column(col.dtype, data, validity, lengths)
     data = col.data[indices]
@@ -261,7 +262,7 @@ def concat_columns(cols: Sequence[Column], counts: Sequence[int],
     counts (this runs at batch-coalesce boundaries, not inside fused stages).
     """
     dtype = cols[0].dtype
-    if dtype == dt.STRING:
+    if dtype.var_width:
         width = max(int(c.data.shape[1]) for c in cols)
         datas, valids, lens = [], [], []
         for c, n in zip(cols, counts):
@@ -273,7 +274,7 @@ def concat_columns(cols: Sequence[Column], counts: Sequence[int],
             lens.append(c.lengths[:n])
         total = sum(counts)
         pad = out_capacity - total
-        data = jnp.concatenate(datas + ([jnp.zeros((pad, width), jnp.uint8)] if pad else []))
+        data = jnp.concatenate(datas + ([jnp.zeros((pad, width), datas[0].dtype)] if pad else []))
         valid = jnp.concatenate(valids + ([jnp.zeros(pad, jnp.bool_)] if pad else []))
         lengths = jnp.concatenate(lens + ([jnp.zeros(pad, jnp.int32)] if pad else []))
         return Column(dtype, data, valid, lengths)

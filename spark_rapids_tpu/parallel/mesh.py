@@ -187,7 +187,7 @@ def _rebuild_columns(schema_dtypes: Sequence[dt.DType],
     cols = []
     i = 0
     for t in schema_dtypes:
-        if t == dt.STRING:
+        if t.var_width:
             cols.append(Column(t, arrays[i], arrays[i + 1], arrays[i + 2]))
             i += 3
         else:
@@ -250,7 +250,7 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
         # drop the leading worker axis shard_map leaves (size-1)
         arrays = [a[0] for a in arrays]
         local_n = local_n[0]
-        nk = sum(3 if t == dt.STRING else 2 for t in key_dtypes)
+        nk = sum(3 if t.var_width else 2 for t in key_dtypes)
         key_cols = _rebuild_columns(key_dtypes, arrays[:nk])
         val_cols = _rebuild_columns(val_dtypes, arrays[nk:])
 
@@ -302,8 +302,8 @@ def distributed_groupby_fn(mesh: Mesh, key_dtypes: Sequence[dt.DType],
         return tuple(a[None] for a in out)
 
     in_specs = tuple([P("workers")] * (
-        sum(3 if t == dt.STRING else 2 for t in key_dtypes) +
-        sum(3 if t == dt.STRING else 2 for t in val_dtypes) + 1))
+        sum(3 if t.var_width else 2 for t in key_dtypes) +
+        sum(3 if t.var_width else 2 for t in val_dtypes) + 1))
     return jax.jit(_shard_map(per_worker, mesh, in_specs, P("workers")))
 
 
@@ -322,7 +322,7 @@ def copartition_exchange_fn(mesh: Mesh, col_dtypes: Sequence[dt.DType],
     """
     n = mesh.devices.size
     out_cap = n * cap
-    n_arrays = sum(3 if t == dt.STRING else 2 for t in col_dtypes)
+    n_arrays = sum(3 if t.var_width else 2 for t in col_dtypes)
 
     def per_worker(*arrays_and_count):
         *arrays, local_n = arrays_and_count
@@ -426,7 +426,7 @@ def distributed_sort_fn(mesh: Mesh, col_dtypes: Sequence[dt.DType],
     """
     n = mesh.devices.size
     out_cap = n * cap
-    n_arrays = sum(3 if t == dt.STRING else 2 for t in col_dtypes)
+    n_arrays = sum(3 if t.var_width else 2 for t in col_dtypes)
     s = _SAMPLE_PER_WORKER
 
     def encode(cols: List[Column]) -> List[jnp.ndarray]:
@@ -561,7 +561,7 @@ def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
     # unpack per-worker results
     agg_out_dtypes = output_dtypes(agg_ops, val_dtypes)
     results = []
-    nk_arrays = sum(3 if t == dt.STRING else 2 for t in key_dtypes)
+    nk_arrays = sum(3 if t.var_width else 2 for t in key_dtypes)
     for w in range(n):
         arrays = [o[w] for o in outs[:-1]]
         n_groups = int(outs[-1][w])

@@ -359,6 +359,32 @@ class Expand(LogicalPlan):
             for n, e in zip(self.output_names, first)])
 
 
+class Generate(LogicalPlan):
+    """explode/posexplode generator (GpuGenerateExec.scala): output = child
+    columns ++ [pos?, col] with one row per array element; NULL/empty arrays
+    produce no rows (explode; outer variants out of scope)."""
+
+    def __init__(self, child: LogicalPlan, generator: ex.Expression,
+                 col_name: str = "col", pos_name: str = "pos"):
+        super().__init__(child)
+        self.generator = generator          # ops.arrays.Explode
+        self.col_name = col_name
+        self.pos_name = pos_name
+
+    def expressions(self):
+        return [self.generator]
+
+    def _compute_schema(self) -> dt.Schema:
+        fields = list(self.children[0].schema.fields)
+        if getattr(self.generator, "pos", False):
+            fields.append(dt.Field(self.pos_name, dt.INT32, False))
+        fields.append(dt.Field(self.col_name, self.generator.dtype, True))
+        return dt.Schema(fields)
+
+    def _node_string(self):
+        return f"Generate [{self.generator!r}]"
+
+
 class Window(LogicalPlan):
     """Window operator: adds window function columns to the child's output
     (GpuWindowExec). window_exprs: list of (name, WindowExpression)."""
@@ -552,5 +578,7 @@ def analyze(plan: LogicalPlan) -> LogicalPlan:
     elif isinstance(plan, Window):
         plan.window_exprs = [(n, w.resolve_refs(child_schema))
                              for n, w in plan.window_exprs]
+    elif isinstance(plan, Generate):
+        plan.generator = ra(plan.generator)
     plan._schema = None  # recompute after coercion
     return plan

@@ -85,6 +85,10 @@ for k in (_W.WindowExpression, _W.RowNumber, _W.Rank, _W.DenseRank,
           _W.Lead, _W.Lag):
     _expr(k)
 
+from ..ops import arrays as _AR  # noqa: E402
+for k in (_AR.Explode, _AR.StringSplit, _AR.GetArrayItem, _AR.Size):
+    _expr(k)
+
 # incompat expressions: results can differ from Spark in corner cases
 # (GpuOverrides incompat doc chaining, GpuOverrides.scala:84-97)
 _EXPR_RULES[st.Upper] = ExprRule(st.Upper, incompat="ASCII-only case mapping")
@@ -147,7 +151,10 @@ class ExprMeta(BaseMeta):
                     f"{type(self.expr).__name__} disabled by {rule.conf_key}")
         try:
             t = self.expr.dtype
-            if t not in SUPPORTED_TYPES and t != dt.NULLTYPE:
+            ok = (t in SUPPORTED_TYPES or t == dt.NULLTYPE or
+                  (dt.is_array(t) and t.element in SUPPORTED_TYPES and
+                   not t.element.var_width))
+            if not ok:
                 self.will_not_work(f"unsupported output type {t}")
         except Exception:
             pass
@@ -174,6 +181,7 @@ class PlanMeta(BaseMeta):
         lp.Union: "UnionExec", lp.Range: "RangeExec",
         lp.Distinct: "HashAggregateExec", lp.Repartition: "ShuffleExchangeExec",
         lp.Expand: "ExpandExec", lp.Window: "WindowExec",
+        lp.Generate: "GenerateExec",
         lp.WriteFile: "DataWritingCommandExec",
     }
 
@@ -204,9 +212,13 @@ class PlanMeta(BaseMeta):
                 for r in em.collect_reasons():
                     self.will_not_work(r)
         self._tag_self()
-        # output schema types
+        # output schema types (ARRAY<primitive> allowed)
         for f in self.plan.schema.fields:
-            if f.dtype not in SUPPORTED_TYPES:
+            ok = (f.dtype in SUPPORTED_TYPES or
+                  (dt.is_array(f.dtype) and
+                   f.dtype.element in SUPPORTED_TYPES and
+                   not f.dtype.element.var_width))
+            if not ok:
                 self.will_not_work(
                     f"unsupported column type {f.dtype} for {f.name}")
 
@@ -249,6 +261,33 @@ class PlanMeta(BaseMeta):
                         "non-equi join condition only supported for inner join")
         if isinstance(p, lp.FileScan) and p.fmt not in ("parquet", "csv", "orc"):
             self.will_not_work(f"file format {p.fmt} not supported")
+        if isinstance(p, lp.Generate):
+            from ..ops import arrays as AR
+            gen = p.generator
+            inner = gen.children[0]
+            if isinstance(inner, AR.StringSplit):
+                d = inner.delimiter
+                if not (isinstance(d, str) and len(d) == 1 and
+                        ord(d) < 128):
+                    self.will_not_work(
+                        "explode(split()) needs a single-byte literal "
+                        "delimiter (regex delimiters run on CPU)")
+            elif not dt.is_array(inner.dtype) or \
+                    inner.dtype.element.var_width:
+                self.will_not_work(
+                    f"explode over {inner.dtype} not supported "
+                    "(needs ARRAY<primitive> or split())")
+        else:
+            # split()/explode() are generator-position only: anywhere else
+            # they cannot evaluate inline -> CPU engine
+            from ..ops import arrays as AR
+            for e in p.expressions():
+                if e.collect(lambda x: isinstance(
+                        x, (AR.StringSplit, AR.Explode))):
+                    self.will_not_work(
+                        "split()/explode() outside a generate position "
+                        "runs on the CPU engine")
+                    break
         if isinstance(p, lp.Window):
             from ..ops import window as W
             RANGE_KEY_TYPES = (dt.INT8, dt.INT16, dt.INT32, dt.DATE)
@@ -418,6 +457,8 @@ class Overrides:
         if isinstance(p, lp.Window):
             from .window_exec import TpuWindowExec
             return TpuWindowExec(kids[0], p.window_exprs)
+        if isinstance(p, lp.Generate):
+            return ph.TpuGenerateExec(kids[0], p)
         if isinstance(p, lp.WriteFile):
             from ..io.write import TpuWriteFileExec
             return TpuWriteFileExec(kids[0], p)

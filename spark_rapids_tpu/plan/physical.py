@@ -1327,6 +1327,78 @@ class TpuExpandExec(TpuExec):
                 yield out
 
 
+class TpuGenerateExec(TpuExec):
+    """explode/posexplode (GpuGenerateExec.scala: per-row repeat + flatten).
+    ``Explode(StringSplit(s, d))`` fuses split+explode into one kernel —
+    the intermediate array<string> never materializes."""
+
+    def __init__(self, child: TpuExec, plan: lp.Generate):
+        super().__init__(child)
+        from ..ops import arrays as ar_ops
+        self.plan = plan
+        gen = plan.generator
+        self.pos = getattr(gen, "pos", False)
+        inner = gen.children[0]
+        if isinstance(inner, ar_ops.StringSplit):
+            self.split_delim = inner.delimiter
+            self.gen_input = bind_refs(inner.children[0], child.schema)
+        else:
+            self.split_delim = None
+            self.gen_input = bind_refs(inner, child.schema)
+        self._schema = plan.schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        from ..ops import arrays as ar_ops
+        for batch in part:
+            with self.metrics.timer("generateTime"):
+                arr = ex.materialize(self.gen_input.eval(batch), batch)
+                live = batch.row_mask()
+                # one host sync sizes the output bucket (the dynamic-size
+                # protocol's batch-boundary read, DESIGN.md)
+                if self.split_delim is not None:
+                    total = int(_split_total(arr, ord(self.split_delim),
+                                             live))
+                    out_cap = bucket(max(total, 1))
+                    others, elem, pos_col, count = ar_ops.split_explode(
+                        arr, ord(self.split_delim), batch.columns, live,
+                        out_cap)
+                else:
+                    total = int(jnp_total_len(arr, live))
+                    out_cap = bucket(max(total, 1))
+                    others, elem, pos_col, count = ar_ops.explode_array(
+                        arr, batch.columns, live, out_cap)
+                n = int(count)
+            if n == 0:
+                continue
+            cols = others + ([pos_col] if self.pos else []) + [elem]
+            out = ColumnarBatch(self._schema, cols, n)
+            self.metrics.inc("numOutputRows", n)
+            yield out
+
+
+def jnp_total_len(arr: Column, live) -> "jnp.ndarray":
+    import jax.numpy as jnp
+    return jnp.sum(jnp.where(live & arr.validity, arr.lengths, 0))
+
+
+def _split_total(col: Column, delim: int, live) -> "jnp.ndarray":
+    """Exact output rows of split+explode: delims-in-row + 1 per valid row."""
+    import jax.numpy as jnp
+    w = col.data.shape[1]
+    is_delim = (col.data == jnp.uint8(delim)) & \
+        (jnp.arange(w)[None, :] < col.lengths[:, None])
+    n_parts = jnp.where(live & col.validity,
+                        1 + jnp.sum(is_delim, axis=1), 0)
+    return jnp.sum(n_parts)
+
+
 # ---------------------------------------------------------------------------
 # Joins
 # ---------------------------------------------------------------------------

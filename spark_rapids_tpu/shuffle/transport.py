@@ -369,7 +369,7 @@ def _rebuild_batch(meta: BufferDesc, payload: bytes) -> ColumnarBatch:
     cols: List[Column] = []
     i = 0
     for f in fields:
-        if f.dtype == dt.STRING:
+        if f.dtype.var_width:
             cols.append(Column(f.dtype, jnp.asarray(arrays[i]),
                                jnp.asarray(arrays[i + 1]),
                                jnp.asarray(arrays[i + 2])))
