@@ -108,6 +108,9 @@ class DataFrame:
                 exprs.append(ex.ColumnRef(c))
         if not replaced:
             exprs.append(ex.Alias(_unwrap(col), name))
+        gen = self._lift_generator(exprs)     # explode() works here too
+        if gen is not None:
+            return gen
         return self._df(lp.Project(self._plan, exprs))
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
@@ -199,6 +202,14 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return self._df(lp.Limit(self._plan, n))
+
+    def mapInPandas(self, fn, schema) -> "DataFrame":
+        """fn(iterator of pandas DataFrames) -> iterator of DataFrames
+        (GpuMapInPandasExec analog)."""
+        from ..columnar import dtypes as dtm
+        if not isinstance(schema, dtm.Schema):
+            schema = dtm.Schema(schema)
+        return self._df(lp.MapInPandas(self._plan, fn, schema))
 
     def repartition(self, n: int, *cols: ColumnOrName) -> "DataFrame":
         by = [_to_expr(c) for c in cols] or None

@@ -388,3 +388,66 @@ def get_item(c, index) -> Col:
     from ..ops import arrays as ar_ops
     idx = _unwrap(index) if isinstance(index, Col) else ex.Literal(int(index), dt.INT32)
     return Col(ar_ops.GetArrayItem(_unwrap(c), idx))
+
+
+# -- python UDFs (§2.9: GpuArrowEvalPythonExec + udf-compiler analogs) -------
+
+def udf(fn=None, returnType="double"):
+    """Scalar python UDF. The udf-compiler first tries to translate the
+    function's BYTECODE into a native expression tree (the reference's
+    udf-compiler module); untranslatable functions fall back to the pandas
+    host path — same contract as Plugin.scala:28-94's resolution rule."""
+    rt = dt.of(returnType) if not isinstance(returnType, dt.DType) else returnType
+
+    def wrap(f):
+        def call(*cols):
+            from ..ops.udf_compiler import try_compile_udf
+            from ..ops.python_udf import PandasUDF
+            args = [_unwrap(c) if isinstance(c, Col) else ex.ColumnRef(c)
+                    for c in cols]
+            compiled = try_compile_udf(f, args)
+            if compiled is not None:
+                # unconditional cast: column refs are unresolved pre-analysis,
+                # so the result dtype is unknowable here; Cast to self is free
+                return Col(Cast(compiled, rt))
+            import pandas as pd
+
+            def elementwise(*series):
+                # Spark python UDFs receive None inputs as-is (they decide);
+                # this matches pyspark, NOT the compiled path's expression
+                # null-propagation — the same divergence the reference's
+                # udf-compiler has between translated and fallback UDFs
+                def norm(v):
+                    if not isinstance(v, (list, tuple)) and pd.isna(v):
+                        return None
+                    return v
+                return pd.Series([f(*[norm(v) for v in vals])
+                                  for vals in zip(*series)])
+            return Col(PandasUDF(elementwise, rt, *args,
+                                 name=getattr(f, "__name__", "udf")))
+        call.__name__ = getattr(f, "__name__", "udf")
+        return call
+    return wrap(fn) if fn is not None else wrap
+
+
+def pandas_udf(fn=None, returnType="double"):
+    """Vectorized pandas UDF: fn(pandas.Series...) -> Series (no bytecode
+    translation attempt; always the Arrow round-trip path)."""
+    rt = dt.of(returnType) if not isinstance(returnType, dt.DType) else returnType
+
+    def wrap(f):
+        def call(*cols):
+            from ..ops.python_udf import PandasUDF
+            args = [_unwrap(c) if isinstance(c, Col) else ex.ColumnRef(c)
+                    for c in cols]
+            return Col(PandasUDF(f, rt, *args,
+                                 name=getattr(f, "__name__", "pandas_udf")))
+        call.__name__ = getattr(f, "__name__", "pandas_udf")
+        return call
+    return wrap(fn) if fn is not None else wrap
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Col:
+    return Col(st.RegExpReplaceHost(_unwrap(c) if isinstance(c, Col)
+                                    else ex.ColumnRef(c),
+                                    pattern, replacement))

@@ -75,6 +75,12 @@ def ARRAY(element: DType) -> DType:
     return t
 
 
+# array<string>: only flows through the CPU engine / explode fusion —
+# the padded-matrix device layout is primitive-element only
+ARRAY_STRING = DType("array<string>", None, var_width=True, element=STRING)
+_BY_NAME[ARRAY_STRING.name] = ARRAY_STRING
+
+
 def is_array(t: DType) -> bool:
     return t.element is not None
 

@@ -69,6 +69,19 @@ class CpuEvaluator:
     def _eval(self, e: ex.Expression):
         if isinstance(e, ex.Literal):
             return [e.value] * self.n
+        if isinstance(e, st.RegExpReplaceHost):
+            return e.apply_list(self._eval(e.children[0]))
+        from ..ops.python_udf import PandasUDF
+        if isinstance(e, PandasUDF):
+            import pandas as pd
+            series = [pd.Series(self._eval(c), dtype=object)
+                      for c in e.children]
+            out = e.fn(*series)
+            if len(out) != self.n:        # same contract as the device path
+                raise ValueError(
+                    f"pandas UDF {e.udf_name!r} returned {len(out)} rows "
+                    f"for {self.n} input rows")
+            return [None if pd.isna(v) else v for v in out]
         from ..ops import arrays as ar_ops
         if isinstance(e, ar_ops.StringSplit):
             vals = self._eval(e.children[0])
@@ -846,6 +859,16 @@ def _exec(plan: lp.LogicalPlan) -> pd.DataFrame:
     if isinstance(plan, lp.Window):
         from .window import exec_window_cpu
         return exec_window_cpu(plan, _exec(plan.children[0]))
+    if isinstance(plan, lp.MapInPandas):
+        child = _exec(plan.children[0])
+        frames = list(plan.fn(iter([child])))
+        names = plan.out_schema.names()
+        if not frames:
+            return _obj_df({n: [] for n in names})
+        out = pd.concat(frames, ignore_index=True)
+        # coerce to the declared schema: order + presence (the TPU path
+        # rebuilds through _df_to_batch(out_schema) the same way)
+        return out[[n for n in names]]
     if isinstance(plan, lp.Generate):
         child = _exec(plan.children[0])
         ev = CpuEvaluator(child)

@@ -114,8 +114,7 @@ class StringSplit(Expression):
 
     @property
     def dtype(self):
-        # array<string>: only consumed through explode (fused) or CPU
-        return dt.ARRAY(dt.INT32)   # placeholder element; see Explode.dtype
+        return dt.ARRAY_STRING
 
     @property
     def nullable(self):
@@ -166,8 +165,20 @@ def explode_array(arr: Column, other_cols: List[Column], live: jnp.ndarray,
     return others, elem_col, pos_col, count
 
 
+def split_part_counts(col: Column, delim: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(is_delim[cap, w], n_parts[cap]) — shared by the output-sizing sync
+    and the explode kernel so the widest intermediate computes once."""
+    w = col.data.shape[1]
+    is_delim = (col.data == jnp.uint8(delim)) & \
+        (jnp.arange(w)[None, :] < col.lengths[:, None])
+    n_parts = jnp.where(col.validity, 1 + jnp.sum(is_delim, axis=1), 0)
+    return is_delim, n_parts
+
+
 def split_explode(col: Column, delim: int, other_cols: List[Column],
-                  live: jnp.ndarray, out_cap: int
+                  live: jnp.ndarray, out_cap: int,
+                  precomputed: Optional[Tuple] = None
                   ) -> Tuple[List[Column], Column, Column, jnp.ndarray]:
     """Fused split(str, d) + explode: one output STRING row per part,
     without materializing the intermediate array<string>.
@@ -176,9 +187,8 @@ def split_explode(col: Column, delim: int, other_cols: List[Column],
     """
     cap, w = col.data.shape
     in_len = col.lengths
-    is_delim = (col.data == jnp.uint8(delim)) & \
-        (jnp.arange(w)[None, :] < in_len[:, None])
-    n_parts = jnp.where(col.validity, 1 + jnp.sum(is_delim, axis=1), 0)
+    is_delim, n_parts = (precomputed if precomputed is not None
+                         else split_part_counts(col, delim))
 
     src, part, count = explode_indices(n_parts, col.validity, live, out_cap)
     out_live = jnp.arange(out_cap) < count

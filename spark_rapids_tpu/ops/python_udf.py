@@ -1,0 +1,101 @@
+"""Python / pandas UDF execution.
+
+Reference: ``GpuArrowEvalPythonExec.scala:58-500`` — device batches stream to
+a python worker as Arrow IPC, results stream back and re-join their input
+batches (``BatchQueue``), with ``RebatchingRoundoffIterator`` aligning batch
+sizes; plus ``GpuMapInPandasExec`` and friends (SURVEY.md §2.9).
+
+TPU-standalone: the engine IS python, so the "worker" boundary collapses —
+but the data contract is identical: device batch -> Arrow -> pandas ->
+user function -> Arrow -> device batch. The udf-compiler (ops/udf_compiler)
+tries to translate scalar python UDFs into native expressions first
+(Plugin.scala:28-94's resolution rule); only untranslatable UDFs pay the
+host round trip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, bucket
+from .expressions import Expression, materialize
+
+
+class PandasUDF(Expression):
+    """Scalar pandas UDF expression: fn(pandas.Series...) -> Series.
+    Host-side (non-fusable): evaluation crosses device -> Arrow -> pandas
+    and back, the GpuArrowEvalPythonExec data path minus the IPC socket."""
+
+    fusable = False
+
+    def __init__(self, fn: Callable, return_type: dt.DType,
+                 *children: Expression, name: Optional[str] = None):
+        super().__init__(*children)
+        self.fn = fn
+        self.return_type = return_type
+        self.udf_name = name or getattr(fn, "__name__", "udf")
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.return_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        import pandas as pd
+        n = batch.num_rows
+        series = []
+        for c in self.children:
+            col = materialize(c.eval(batch), batch)
+            series.append(pd.Series(col.to_arrow(n).to_pandas()))
+        out = self.fn(*series)
+        if not isinstance(out, pd.Series):
+            out = pd.Series(out)
+        if len(out) != n:
+            raise ValueError(
+                f"pandas UDF {self.udf_name!r} returned {len(out)} rows "
+                f"for {n} input rows")
+        vals = [None if pd.isna(v) else v for v in out]
+        return Column.from_pylist(vals, self.return_type,
+                                  capacity=batch.capacity)
+
+    def __repr__(self):
+        args = ", ".join(repr(c) for c in self.children)
+        return f"{self.udf_name}({args})"
+
+
+def rebatch_iterator(batches, target_rows: int):
+    """Align batch sizes to ~target_rows (RebatchingRoundoffIterator,
+    GpuArrowEvalPythonExec.scala): concat small batches, slice large ones,
+    so the python worker sees a steady batch cadence."""
+    from ..plan.physical import concat_batches
+    from ..ops import kernels as K
+    pending: List[ColumnarBatch] = []
+    pending_rows = 0
+    schema = None
+    for b in batches:
+        if b.num_rows == 0:
+            continue
+        schema = b.schema
+        pending.append(b)
+        pending_rows += b.num_rows
+        while pending_rows >= target_rows:
+            merged = concat_batches(schema, pending)
+            take = target_rows
+            head_cols = [K.slice_column(c, 0, bucket(take), take)
+                         for c in merged.columns]
+            yield ColumnarBatch(schema, head_cols, take)
+            rest = merged.num_rows - take
+            if rest > 0:
+                rest_cols = [K.slice_column(c, take, bucket(rest), rest)
+                             for c in merged.columns]
+                pending = [ColumnarBatch(schema, rest_cols, rest)]
+            else:
+                pending = []
+            pending_rows = rest
+    if pending:
+        yield concat_batches(schema, pending)

@@ -653,3 +653,42 @@ class RegExpExtractHost(Expression):
                 m = rx.search(x)
                 out.append(m.group(self.group) if m else "")
         return Column.from_pylist(out, dt.STRING, capacity=batch.capacity)
+
+
+class RegExpReplaceHost(Expression):
+    """Host-side regexp_replace (non-fusable; same gating stance as
+    RegExpExtractHost — the reference CPU-falls-back for general regex,
+    GpuOverrides.scala:343-351)."""
+    fusable = False
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        super().__init__(child)
+        self.pattern = pattern
+        self.replacement = replacement
+
+    @property
+    def dtype(self):
+        return dt.STRING
+
+    def _compiled(self):
+        import re
+        rx = re.compile(self.pattern)
+        # java-style group refs $1 -> python \1
+        repl = re.sub(r"\$(\d+)", r"\\\1", self.replacement)
+        return rx, repl
+
+    def apply_list(self, vals):
+        """Replacement over python values — ONE source of truth shared by
+        the device op and the CPU engine oracle."""
+        rx, repl = self._compiled()
+        return [None if x is None else rx.sub(repl, x) for x in vals]
+
+    def eval(self, batch: ColumnarBatch):
+        v = self.children[0].eval(batch)
+        if isinstance(v, Scalar):
+            if v.is_null:
+                return Scalar(None, dt.STRING)
+            rx, repl = self._compiled()
+            return Scalar(rx.sub(repl, str(v.value)), dt.STRING)
+        out = self.apply_list(v.to_pylist(batch.num_rows))
+        return Column.from_pylist(out, dt.STRING, capacity=batch.capacity)

@@ -1,0 +1,143 @@
+"""udf-compiler: python-bytecode scalar UDFs -> native expression trees.
+
+Reference: the ``udf-compiler`` module translates Scala UDF *bytecode* into
+Catalyst expressions via javassist reflection + CFG symbolic execution
+(``udf-compiler/.../LambdaReflection.scala``, ``CFG.scala:329``,
+``Instruction.scala:830``, ``CatalystExpressionBuilder.scala:45-126``),
+falling back to the original UDF when translation fails.
+
+TPU-standalone analog: ``dis`` disassembles the python function; a symbolic
+stack machine maps the instruction stream onto this framework's expression
+algebra. Scope: straight-line scalar lambdas — arithmetic, comparisons,
+boolean logic, ``abs``/``min``/``max``, constants, closure cells. Branching
+control flow (the reference handles it via CFG reconvergence) falls back to
+the pandas-UDF host path — identical contract to the reference's fallback
+(Plugin.scala:28-94).
+"""
+
+from __future__ import annotations
+
+import dis
+from typing import Any, Callable, List, Optional
+
+from ..columnar import dtypes as dt
+from . import arithmetic as ar
+from . import conditionals as co
+from . import math_ops as mo
+from . import predicates as pr
+from .expressions import Expression, Literal
+
+
+class UdfTranslationError(Exception):
+    pass
+
+
+_BINOPS = {
+    "+": ar.Add, "-": ar.Subtract, "*": ar.Multiply, "/": ar.Divide,
+    "%": ar.Remainder, "**": mo.Pow, "//": ar.IntegralDivide,
+}
+_CMPOPS = {
+    "==": pr.EqualTo, "!=": pr.NotEqual, "<": pr.LessThan,
+    "<=": pr.LessThanOrEqual, ">": pr.GreaterThan,
+    ">=": pr.GreaterThanOrEqual,
+}
+_CALLS = {
+    "abs": lambda args: ar.Abs(*args),
+    "min": lambda args: co.Least(*args),
+    "max": lambda args: co.Greatest(*args),
+}
+
+
+def try_compile_udf(fn: Callable, arg_exprs: List[Expression]
+                    ) -> Optional[Expression]:
+    """Expression tree for ``fn(*arg_exprs)`` or None when the bytecode uses
+    unsupported instructions (the caller falls back to the pandas UDF)."""
+    try:
+        return _compile(fn, arg_exprs)
+    except UdfTranslationError:
+        return None
+
+
+def _compile(fn: Callable, arg_exprs: List[Expression]) -> Expression:
+    try:
+        code = fn.__code__
+    except AttributeError:
+        raise UdfTranslationError("not a python function")
+    if code.co_argcount != len(arg_exprs):
+        raise UdfTranslationError("arity mismatch")
+    local_names = code.co_varnames
+    env = {local_names[i]: e for i, e in enumerate(arg_exprs)}
+    closure = {}
+    if fn.__closure__:
+        for name, cell in zip(code.co_freevars, fn.__closure__):
+            closure[name] = cell.cell_contents
+    globals_ = fn.__globals__
+
+    stack: List[Any] = []
+
+    def as_expr(v) -> Expression:
+        if isinstance(v, Expression):
+            return v
+        if isinstance(v, (int, float, bool, str)) or v is None:
+            return Literal(v)
+        raise UdfTranslationError(f"unliftable constant {v!r}")
+
+    for ins in dis.get_instructions(fn):
+        op = ins.opname
+        if op in ("RESUME", "PRECALL", "CACHE", "NOP", "COPY_FREE_VARS",
+                  "MAKE_CELL", "PUSH_NULL"):
+            continue
+        elif op == "LOAD_FAST":
+            if ins.argval not in env:
+                raise UdfTranslationError(f"unbound local {ins.argval}")
+            stack.append(env[ins.argval])
+        elif op == "LOAD_CONST":
+            stack.append(ins.argval)
+        elif op == "LOAD_DEREF":
+            if ins.argval not in closure:
+                raise UdfTranslationError(f"unknown cell {ins.argval}")
+            stack.append(closure[ins.argval])
+        elif op == "LOAD_GLOBAL":
+            name = ins.argval
+            if name in _CALLS:
+                stack.append(("call", name))
+            elif name in globals_ and isinstance(
+                    globals_[name], (int, float, bool, str)):
+                stack.append(globals_[name])
+            else:
+                raise UdfTranslationError(f"unsupported global {name}")
+        elif op == "BINARY_OP":
+            sym = ins.argrepr.rstrip("=")
+            if sym not in _BINOPS:
+                raise UdfTranslationError(f"binary op {ins.argrepr}")
+            r, l = stack.pop(), stack.pop()
+            stack.append(_BINOPS[sym](as_expr(l), as_expr(r)))
+        elif op == "COMPARE_OP":
+            sym = ins.argrepr.strip()
+            # 3.12 spells it "bool(<)" in argrepr sometimes; normalize
+            sym = sym.replace("bool(", "").replace(")", "")
+            if sym not in _CMPOPS:
+                raise UdfTranslationError(f"compare op {ins.argrepr}")
+            r, l = stack.pop(), stack.pop()
+            stack.append(_CMPOPS[sym](as_expr(l), as_expr(r)))
+        elif op == "UNARY_NEGATIVE":
+            stack.append(ar.UnaryMinus(as_expr(stack.pop())))
+        elif op == "UNARY_NOT":
+            stack.append(pr.Not(as_expr(stack.pop())))
+        elif op == "CALL":
+            argc = ins.arg
+            args = [as_expr(stack.pop()) for _ in range(argc)][::-1]
+            target = stack.pop()
+            if not (isinstance(target, tuple) and target[0] == "call"):
+                raise UdfTranslationError("indirect call")
+            stack.append(_CALLS[target[1]](args))
+        elif op == "RETURN_VALUE":
+            if len(stack) != 1:
+                raise UdfTranslationError("stack imbalance at return")
+            return as_expr(stack.pop())
+        elif op == "RETURN_CONST":
+            return as_expr(ins.argval)
+        else:
+            # branches (if/else), loops, attribute access, etc. -> fallback
+            raise UdfTranslationError(f"unsupported instruction {op}")
+    raise UdfTranslationError("no return")

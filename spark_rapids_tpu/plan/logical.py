@@ -385,6 +385,22 @@ class Generate(LogicalPlan):
         return f"Generate [{self.generator!r}]"
 
 
+class MapInPandas(LogicalPlan):
+    """mapInPandas(fn, schema) (GpuMapInPandasExec analog): the user fn
+    maps an iterator of pandas DataFrames to an iterator of DataFrames."""
+
+    def __init__(self, child: LogicalPlan, fn, schema: dt.Schema):
+        super().__init__(child)
+        self.fn = fn
+        self.out_schema = schema
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.out_schema
+
+    def _node_string(self):
+        return f"MapInPandas [{getattr(self.fn, '__name__', 'fn')}]"
+
+
 class Window(LogicalPlan):
     """Window operator: adds window function columns to the child's output
     (GpuWindowExec). window_exprs: list of (name, WindowExpression)."""

@@ -89,6 +89,9 @@ from ..ops import arrays as _AR  # noqa: E402
 for k in (_AR.Explode, _AR.StringSplit, _AR.GetArrayItem, _AR.Size):
     _expr(k)
 
+from ..ops import python_udf as _PU  # noqa: E402
+_expr(_PU.PandasUDF)
+
 # incompat expressions: results can differ from Spark in corner cases
 # (GpuOverrides incompat doc chaining, GpuOverrides.scala:84-97)
 _EXPR_RULES[st.Upper] = ExprRule(st.Upper, incompat="ASCII-only case mapping")
@@ -96,6 +99,8 @@ _EXPR_RULES[st.Lower] = ExprRule(st.Lower, incompat="ASCII-only case mapping")
 _EXPR_RULES[st.InitCap] = ExprRule(st.InitCap, incompat="ASCII-only case mapping")
 _EXPR_RULES[mo.Pow] = ExprRule(mo.Pow, incompat="pow lowers to exp(y*log x)")
 _EXPR_RULES[st.RegExpExtractHost] = ExprRule(st.RegExpExtractHost,
+                                             incompat="host regex engine")
+_EXPR_RULES[st.RegExpReplaceHost] = ExprRule(st.RegExpReplaceHost,
                                              incompat="host regex engine")
 
 
@@ -153,7 +158,9 @@ class ExprMeta(BaseMeta):
             t = self.expr.dtype
             ok = (t in SUPPORTED_TYPES or t == dt.NULLTYPE or
                   (dt.is_array(t) and t.element in SUPPORTED_TYPES and
-                   not t.element.var_width))
+                   not t.element.var_width) or
+                  (t == dt.ARRAY_STRING and
+                   isinstance(self.expr, _AR.StringSplit)))
             if not ok:
                 self.will_not_work(f"unsupported output type {t}")
         except Exception:
@@ -182,6 +189,7 @@ class PlanMeta(BaseMeta):
         lp.Distinct: "HashAggregateExec", lp.Repartition: "ShuffleExchangeExec",
         lp.Expand: "ExpandExec", lp.Window: "WindowExec",
         lp.Generate: "GenerateExec",
+        lp.MapInPandas: "MapInPandasExec",
         lp.WriteFile: "DataWritingCommandExec",
     }
 
@@ -459,6 +467,8 @@ class Overrides:
             return TpuWindowExec(kids[0], p.window_exprs)
         if isinstance(p, lp.Generate):
             return ph.TpuGenerateExec(kids[0], p)
+        if isinstance(p, lp.MapInPandas):
+            return ph.TpuMapInPandasExec(kids[0], p)
         if isinstance(p, lp.WriteFile):
             from ..io.write import TpuWriteFileExec
             return TpuWriteFileExec(kids[0], p)

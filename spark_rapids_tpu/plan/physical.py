@@ -1327,6 +1327,41 @@ class TpuExpandExec(TpuExec):
                 yield out
 
 
+class TpuMapInPandasExec(TpuExec):
+    """mapInPandas (GpuMapInPandasExec, SURVEY.md §2.9): device batches
+    cross to pandas through Arrow, the user fn maps an iterator of frames,
+    results re-enter the device columnar world. Input batches are re-aligned
+    to a steady size first (RebatchingRoundoffIterator analog)."""
+
+    def __init__(self, child: TpuExec, plan: "lp.MapInPandas",
+                 target_rows: int = 1 << 16):
+        super().__init__(child)
+        self.plan = plan
+        self.target_rows = target_rows
+
+    @property
+    def schema(self):
+        return self.plan.out_schema
+
+    def execute(self) -> List[Partition]:
+        return [self._map(p) for p in self.children[0].execute()]
+
+    def _map(self, part: Partition) -> Partition:
+        from ..ops.python_udf import rebatch_iterator
+
+        def frames():
+            for b in rebatch_iterator(part, self.target_rows):
+                yield b.to_pandas()
+
+        for out_df in self.plan.fn(frames()):
+            n = len(out_df)
+            if n == 0:
+                continue
+            out = _df_to_batch(out_df, self.plan.out_schema)
+            self.metrics.inc("numOutputRows", n)
+            yield out
+
+
 class TpuGenerateExec(TpuExec):
     """explode/posexplode (GpuGenerateExec.scala: per-row repeat + flatten).
     ``Explode(StringSplit(s, d))`` fuses split+explode into one kernel —
@@ -1363,12 +1398,14 @@ class TpuGenerateExec(TpuExec):
                 # one host sync sizes the output bucket (the dynamic-size
                 # protocol's batch-boundary read, DESIGN.md)
                 if self.split_delim is not None:
-                    total = int(_split_total(arr, ord(self.split_delim),
-                                             live))
+                    pre = ar_ops.split_part_counts(arr,
+                                                   ord(self.split_delim))
+                    import jax.numpy as jnp
+                    total = int(jnp.sum(jnp.where(live, pre[1], 0)))
                     out_cap = bucket(max(total, 1))
                     others, elem, pos_col, count = ar_ops.split_explode(
                         arr, ord(self.split_delim), batch.columns, live,
-                        out_cap)
+                        out_cap, precomputed=pre)
                 else:
                     total = int(jnp_total_len(arr, live))
                     out_cap = bucket(max(total, 1))
@@ -1388,15 +1425,7 @@ def jnp_total_len(arr: Column, live) -> "jnp.ndarray":
     return jnp.sum(jnp.where(live & arr.validity, arr.lengths, 0))
 
 
-def _split_total(col: Column, delim: int, live) -> "jnp.ndarray":
-    """Exact output rows of split+explode: delims-in-row + 1 per valid row."""
-    import jax.numpy as jnp
-    w = col.data.shape[1]
-    is_delim = (col.data == jnp.uint8(delim)) & \
-        (jnp.arange(w)[None, :] < col.lengths[:, None])
-    n_parts = jnp.where(live & col.validity,
-                        1 + jnp.sum(is_delim, axis=1), 0)
-    return jnp.sum(n_parts)
+
 
 
 # ---------------------------------------------------------------------------

@@ -5,6 +5,7 @@ import pandas as pd
 import pytest
 
 from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.api import functions as F
 
 
 def _session():
@@ -150,3 +151,31 @@ def test_trace_span_noop_and_enabled():
         del os.environ[
             "SPARK_RAPIDS_TPU_CONF__SPARK__RAPIDS__TPU__SQL__TRACING__ENABLED"]
         tracing.reset_cache()
+
+
+# -- regexp_replace + api_validation -----------------------------------------
+
+def test_regexp_replace_golden():
+    from golden import assert_tpu_and_cpu_equal
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"s": ["ab12cd", "x9", None, "zz"]})
+        .select(F.regexp_replace(F.col("s"), r"\d+", "#").alias("r")),
+        conf={"spark.rapids.tpu.sql.incompatibleOps.enabled": "true"})
+
+
+def test_regexp_replace_group_refs():
+    from golden import assert_tpu_and_cpu_equal
+    rows = assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"s": ["a-b", "c-d"]})
+        .select(F.regexp_replace(F.col("s"), r"(\w)-(\w)", "$2_$1")
+                .alias("r")),
+        conf={"spark.rapids.tpu.sql.incompatibleOps.enabled": "true"})
+    assert sorted(r[0] for r in rows) == ["b_a", "d_c"]
+
+
+def test_api_validation_tool():
+    from tools.api_validation import validate
+    report = validate()
+    assert report["ok"], report["problems"]
+    assert report["n_expressions"] > 100
+    assert report["n_execs"] >= 15

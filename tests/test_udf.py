@@ -1,0 +1,107 @@
+"""Python UDF path: udf-compiler bytecode translation, pandas UDF fallback,
+mapInPandas (SURVEY.md §2.9 analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+
+from golden import assert_tpu_and_cpu_equal
+
+
+def test_udf_compiler_translates_arithmetic():
+    """Straight-line arithmetic lambdas compile to native expressions —
+    NO PandasUDF appears in the plan (the udf-compiler's whole point)."""
+    from spark_rapids_tpu.ops.udf_compiler import try_compile_udf
+    from spark_rapids_tpu.ops import expressions as ex
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    f = lambda x, y: (x + y) * 2 - 7
+    e = try_compile_udf(f, [ex.BoundReference(0, dt.FLOAT64, True),
+                            ex.BoundReference(1, dt.FLOAT64, True)])
+    assert e is not None
+    from spark_rapids_tpu.ops.python_udf import PandasUDF
+    assert not e.collect(lambda n: isinstance(n, PandasUDF))
+
+
+def test_udf_compiler_rejects_branches():
+    from spark_rapids_tpu.ops.udf_compiler import try_compile_udf
+    from spark_rapids_tpu.ops import expressions as ex
+    from spark_rapids_tpu.columnar import dtypes as dt
+    f = lambda x: 1 if x > 0 else -1
+    assert try_compile_udf(f, [ex.BoundReference(0, dt.FLOAT64, True)]) \
+        is None
+
+
+def test_compiled_udf_golden():
+    my_udf = F.udf(lambda x, y: abs(x - y) * 2.0, "double")
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        return (s.createDataFrame(pd.DataFrame({
+            "a": [1.0, -2.0, None, 4.0], "b": [0.5, 1.5, 2.5, None]}))
+            .select(my_udf(col("a"), col("b")).alias("r")))
+
+    assert_tpu_and_cpu_equal(q, approx=1e-12)
+    captured["s"].assert_on_tpu()       # compiled: fully native plan
+
+
+def test_closure_constant_udf():
+    k = 10.0
+    my_udf = F.udf(lambda x: x * k + 1, "double")
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"a": [1.0, 2.0, 3.0]})
+        .select(my_udf(col("a")).alias("r")),
+        approx=1e-12)
+
+
+def test_untranslatable_udf_falls_back_to_pandas_path():
+    """String formatting can't compile: the pandas host path answers."""
+    weird = F.udf(lambda x: float(len(f"{x:.3f}")), "double")
+    rows = assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"a": [1.0, 22.5]})
+        .select(weird(col("a")).alias("n")),
+        approx=1e-12)
+    assert [r[0] for r in sorted(rows)] == [5.0, 6.0]
+
+
+def test_pandas_udf_vectorized():
+    @F.pandas_udf(returnType="double")
+    def plus_mean(v: pd.Series) -> pd.Series:
+        return v + 1.5
+
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"v": [1.0, 2.0, 3.0]})
+        .select(plus_mean(col("v")).alias("r")),
+        approx=1e-12)
+
+
+def test_map_in_pandas():
+    def double_rows(frames):
+        for f in frames:
+            yield f.assign(v=f.v * 2)
+
+    def q(s):
+        return (s.createDataFrame({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+                .mapInPandas(double_rows, [("k", "bigint"), ("v", "double")]))
+
+    rows = assert_tpu_and_cpu_equal(q, approx=1e-12)
+    assert sorted(r[1] for r in rows) == [2.0, 4.0, 6.0]
+
+
+def test_rebatch_iterator_alignment():
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.ops.python_udf import rebatch_iterator
+    batches = [ColumnarBatch.from_pydict({"x": list(range(i * 100, i * 100 + n))})
+               for i, n in enumerate([5, 300, 7, 120, 1])]
+    out = list(rebatch_iterator(iter(batches), 100))
+    sizes = [b.num_rows for b in out]
+    assert all(s == 100 for s in sizes[:-1])
+    assert sum(sizes) == 433
+    got = sorted(v for b in out for v in b.column(0).to_pylist(b.num_rows))
+    exp = sorted(v for b in batches
+                 for v in b.column(0).to_pylist(b.num_rows))
+    assert got == exp

@@ -1,0 +1,83 @@
+"""api_validation: diff this framework's registered surface against the
+reference's component inventory.
+
+Reference: ``api_validation/.../ApiValidation.scala:65-167`` diffs Gpu exec
+constructor signatures against Spark's per version. Standalone analog: walk
+the live registries (expression rules, exec conversions, conf keys) and
+report the covered surface plus any rule whose class no longer exists or
+whose conversion is missing — the drift this tool guards against.
+
+Usage: python -m tools.api_validation [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def validate() -> dict:
+    from spark_rapids_tpu.plan import overrides as ov
+    from spark_rapids_tpu.plan import logical as lp
+    from spark_rapids_tpu import config as cfg
+
+    report: dict = {"problems": []}
+
+    # expression rules: every registered class must be constructible and
+    # carry the eval/plan contract
+    exprs = []
+    for klass, rule in ov._EXPR_RULES.items():
+        entry = {"class": klass.__name__,
+                 "conf_key": rule.conf_key,
+                 "incompat": rule.incompat}
+        if not hasattr(klass, "eval"):
+            report["problems"].append(
+                f"expression rule {klass.__name__} has no eval")
+        exprs.append(entry)
+    report["expressions"] = sorted(exprs, key=lambda e: e["class"])
+
+    # exec rules: every logical node named in EXEC_NAMES must convert
+    execs = []
+    convertible = set()
+    import inspect
+    src = inspect.getsource(ov.Overrides)
+    for klass, name in ov.PlanMeta.EXEC_NAMES.items():
+        has_branch = f"lp.{klass.__name__}" in src
+        execs.append({"logical": klass.__name__, "exec": name,
+                      "converts": has_branch})
+        if not has_branch:
+            report["problems"].append(
+                f"exec {name} ({klass.__name__}) has no conversion branch")
+    report["execs"] = sorted(execs, key=lambda e: e["exec"])
+
+    # conf registry: keys must be unique and documented
+    keys = [e.key for e in cfg.REGISTRY.entries()]
+    if len(keys) != len(set(keys)):
+        report["problems"].append("duplicate conf keys")
+    undocumented = [e.key for e in cfg.REGISTRY.entries() if not e.doc]
+    if undocumented:
+        report["problems"].append(f"undocumented confs: {undocumented}")
+    report["conf_keys"] = len(keys)
+
+    report["n_expressions"] = len(exprs)
+    report["n_execs"] = len(execs)
+    report["ok"] = not report["problems"]
+    return report
+
+
+def main() -> int:
+    report = validate()
+    if "--json" in sys.argv:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"expressions: {report['n_expressions']}")
+        print(f"execs:       {report['n_execs']}")
+        print(f"conf keys:   {report['conf_keys']}")
+        for p in report["problems"]:
+            print(f"PROBLEM: {p}")
+        print("OK" if report["ok"] else "FAILED")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
