@@ -260,6 +260,18 @@ SPILL_COMPRESSION_CODEC = _conf("spark.rapids.tpu.memory.spill.compression.codec
     "Codec for the disk spill tier: none, zlib").string_conf.check(
         lambda v: v in ("none", "zlib")).create_with_default("none")
 
+ADAPTIVE_ENABLED = _conf("spark.rapids.tpu.sql.adaptive.enabled").doc(
+    "Adaptive execution: coalesce small shuffle partitions at runtime from "
+    "observed map-side sizes (ref: AQE + GpuCustomShuffleReaderExec, "
+    "GpuOverrides.scala:1920)").boolean_conf.create_with_default(True)
+
+ADAPTIVE_MIN_PARTITION_BYTES = _conf(
+    "spark.rapids.tpu.sql.adaptive.coalescePartitions.minPartitionSize").doc(
+    "Target minimum bytes per post-shuffle partition when adaptive "
+    "coalescing merges small ones (ref: spark.sql.adaptive."
+    "coalescePartitions.minPartitionSize)"
+).bytes_conf.create_with_default(8 * 1024 * 1024)
+
 AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "Build sides at or under this many bytes broadcast (materialize once, "

@@ -547,8 +547,14 @@ class Overrides:
                                               mode="partial")
             if grouping:
                 keys = [ex.ColumnRef(f"_k{i}") for i in range(len(grouping))]
+                # adaptive_ok: the final aggregate tolerates runtime
+                # partition coalescing (merged partitions keep disjoint
+                # key ownership) — the AQE shuffle-reader behavior
                 exch = TpuHashExchangeExec(
-                    partial, self.conf.shuffle_partitions, keys)
+                    partial, self.conf.shuffle_partitions, keys,
+                    adaptive_ok=bool(self.conf.get(cfg.ADAPTIVE_ENABLED)),
+                    adaptive_min_bytes=int(
+                        self.conf.get(cfg.ADAPTIVE_MIN_PARTITION_BYTES)))
             else:
                 # global aggregate: all partials meet on one partition
                 exch = TpuShuffleExchangeExec(partial, 1)

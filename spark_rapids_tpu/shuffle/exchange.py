@@ -60,13 +60,27 @@ class LocalShuffle:
 
 
 class TpuShuffleExchangeExec(TpuExec):
-    """Repartition(n) / repartition(n, cols) exchange."""
+    """Repartition(n) / repartition(n, cols) exchange.
+
+    ``adaptive_ok``: the planner marks exchanges whose consumer tolerates a
+    runtime-reduced partition count (aggregates: merged partitions keep key
+    ownership disjoint) — those coalesce small post-shuffle partitions from
+    OBSERVED map-side sizes, the AQE + GpuCustomShuffleReaderExec behavior
+    (GpuOverrides.scala:1920). Join exchanges stay fixed: both sides must
+    keep identical partitioning."""
 
     def __init__(self, child: TpuExec, num_partitions: int,
-                 by: Optional[List[ex.Expression]] = None):
+                 by: Optional[List[ex.Expression]] = None,
+                 adaptive_ok: bool = False,
+                 adaptive_min_bytes: Optional[int] = None):
         super().__init__(child)
         self.num_partitions = max(1, num_partitions)
         self.by = [bind_refs(e, child.schema) for e in by] if by else None
+        self.adaptive_ok = adaptive_ok
+        # resolved at PLAN time from the session conf (exec-level TpuConf()
+        # would read global defaults, not the session's settings)
+        self.adaptive_min_bytes = adaptive_min_bytes
+        self.coalesced_to: Optional[int] = None    # runtime observation
 
     @property
     def schema(self):
@@ -96,8 +110,45 @@ class TpuShuffleExchangeExec(TpuExec):
         with self.metrics.timer("shuffleWriteTime"):
             # map side: one task per upstream partition, drained concurrently
             run_partition_tasks(self.children[0].execute(), map_task)
-        return [shuffle.read(p, self.schema)
-                for p in range(self.num_partitions)]
+        groups = self._reduce_groups(shuffle)
+        return [self._read_group(shuffle, g) for g in groups]
+
+    def _reduce_groups(self, shuffle: LocalShuffle) -> List[List[int]]:
+        """Adaptive partition coalescing: group adjacent reduce partitions
+        below minPartitionSize using the map side's observed slice sizes."""
+        all_parts = [[p] for p in range(self.num_partitions)]
+        if not self.adaptive_ok or not self.adaptive_min_bytes:
+            return all_parts
+        target = int(self.adaptive_min_bytes)
+        sizes = [sum(s.size_bytes for s in shuffle.slices[p])
+                 for p in range(self.num_partitions)]
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for p, sz in enumerate(sizes):
+            cur.append(p)
+            cur_bytes += sz
+            if cur_bytes >= target:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            if groups:
+                groups[-1].extend(cur)   # tail merges into the last group
+            else:
+                groups.append(cur)
+        self.coalesced_to = len(groups)
+        if len(groups) < self.num_partitions:
+            self.metrics.inc("coalescedPartitions",
+                             self.num_partitions - len(groups))
+        return groups
+
+    def _read_group(self, shuffle: LocalShuffle, group: List[int]) -> Partition:
+        batches = []
+        for p in group:
+            for b in shuffle.read(p, self.schema):
+                batches.append(b)
+        if batches:
+            yield concat_batches(self.schema, batches)
 
     def _cleanup(self) -> None:
         sh = getattr(self, "_shuffle", None)
@@ -110,8 +161,11 @@ class TpuHashExchangeExec(TpuShuffleExchangeExec):
     """Hash exchange for aggregate/join key distribution (partial->final)."""
 
     def __init__(self, child: TpuExec, num_partitions: int,
-                 keys: List[ex.Expression]):
-        super().__init__(child, num_partitions, by=keys)
+                 keys: List[ex.Expression], adaptive_ok: bool = False,
+                 adaptive_min_bytes: Optional[int] = None):
+        super().__init__(child, num_partitions, by=keys,
+                         adaptive_ok=adaptive_ok,
+                         adaptive_min_bytes=adaptive_min_bytes)
 
 
 class TpuRangeExchangeExec(TpuExec):

@@ -288,3 +288,61 @@ def test_perfile_scan_partitions_drive_two_phase(tmp_path):
         conf={"spark.rapids.tpu.sql.format.parquet.reader.type": "PERFILE"})
     plan = captured["s"].last_plan()
     assert _find(plan, TpuHashAggregateExec, lambda n: n.mode == "partial")
+
+
+# -- adaptive partition coalescing (AQE analog; ref GpuCustomShuffleReader) --
+
+def test_adaptive_coalesces_small_agg_partitions():
+    """Tiny per-partition shuffle sizes collapse into fewer reduce
+    partitions at runtime, and results stay correct."""
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        return (s.createDataFrame(_seeded(400)).repartition(4)
+                .groupBy("k").agg(F.sum("v").alias("sv"),
+                                  F.count("*").alias("n")))
+
+    assert_tpu_and_cpu_equal(q, approx=1e-9)
+    exchanges = _find(captured["s"].last_plan(), TpuShuffleExchangeExec)
+    adaptive = [e for e in exchanges if e.adaptive_ok]
+    assert adaptive, "aggregate exchange should be adaptive"
+    assert any(e.coalesced_to is not None and e.coalesced_to < e.num_partitions
+               for e in adaptive), "tiny partitions should have coalesced"
+
+
+def test_adaptive_disabled_keeps_partition_count():
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        return (s.createDataFrame(_seeded(400)).repartition(4)
+                .groupBy("k").agg(F.sum("v").alias("sv")))
+
+    assert_tpu_and_cpu_equal(
+        q, approx=1e-9,
+        conf={"spark.rapids.tpu.sql.adaptive.enabled": "false"})
+    for e in _find(captured["s"].last_plan(), TpuShuffleExchangeExec):
+        assert e.coalesced_to is None or e.coalesced_to == e.num_partitions
+
+
+def test_join_exchanges_never_adaptive():
+    """Co-partitioned join sides must keep identical partition counts."""
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+    left, right = _join_frames()
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        return (s.createDataFrame(left)
+                .join(s.createDataFrame(right), on=(col("a") == col("b")),
+                      how="inner"))
+
+    assert_tpu_and_cpu_equal(q, approx=1e-9, conf=_FORCE_SHUFFLE)
+    from spark_rapids_tpu.plan.physical import TpuShuffledJoinExec
+    joins = _find(captured["s"].last_plan(), TpuShuffledJoinExec)
+    assert joins
+    for e in _find(joins[0], TpuShuffleExchangeExec):
+        assert not e.adaptive_ok
