@@ -1,0 +1,126 @@
+// AddressSpaceAllocator: first-fit sub-allocator over a flat address space.
+//
+// Reference: sql-plugin AddressSpaceAllocator.scala:22 — the reference
+// sub-allocates bounce-buffer pools for the shuffle transport out of one
+// large registered allocation (BounceBufferManager.scala:35). This is the
+// TPU build's native equivalent, used to carve receive/send staging windows
+// out of one pinned host arena without per-buffer malloc churn.
+//
+// Semantics (mirroring the Scala original):
+//   - allocate(size): first-fit over the free list; returns the offset or
+//     UINT64_MAX when no block fits. Zero-size allocations fail.
+//   - free(offset): releases a previously-allocated block; adjacent free
+//     blocks coalesce so fragmentation stays bounded.
+//   - counters: allocated bytes, block counts, largest free block (the
+//     metric the transport uses to decide whether a send window fits).
+//
+// Build: g++ -O2 -shared -fPIC (no dependencies). Loaded via ctypes —
+// CPython C-API bindings are unnecessary for a pure byte-range manager.
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+constexpr uint64_t kFail = ~0ULL;
+
+struct Allocator {
+  std::mutex mu;
+  uint64_t size;
+  // free blocks: offset -> length (ordered => adjacency checks are O(log n))
+  std::map<uint64_t, uint64_t> free_blocks;
+  // allocated blocks: offset -> length
+  std::map<uint64_t, uint64_t> used_blocks;
+  uint64_t allocated_bytes = 0;
+
+  explicit Allocator(uint64_t sz) : size(sz) {
+    if (sz > 0) free_blocks.emplace(0, sz);
+  }
+
+  uint64_t allocate(uint64_t want) {
+    if (want == 0) return kFail;
+    std::lock_guard<std::mutex> lock(mu);
+    for (auto it = free_blocks.begin(); it != free_blocks.end(); ++it) {
+      if (it->second < want) continue;
+      uint64_t off = it->first;
+      uint64_t len = it->second;
+      free_blocks.erase(it);
+      if (len > want) free_blocks.emplace(off + want, len - want);
+      used_blocks.emplace(off, want);
+      allocated_bytes += want;
+      return off;
+    }
+    return kFail;
+  }
+
+  int free_block(uint64_t off) {
+    std::lock_guard<std::mutex> lock(mu);
+    auto it = used_blocks.find(off);
+    if (it == used_blocks.end()) return -1;
+    uint64_t len = it->second;
+    used_blocks.erase(it);
+    allocated_bytes -= len;
+
+    // insert into the free map, then coalesce with neighbours
+    auto ins = free_blocks.emplace(off, len).first;
+    if (ins != free_blocks.begin()) {
+      auto prev = std::prev(ins);
+      if (prev->first + prev->second == ins->first) {
+        prev->second += ins->second;
+        free_blocks.erase(ins);
+        ins = prev;
+      }
+    }
+    auto next = std::next(ins);
+    if (next != free_blocks.end() &&
+        ins->first + ins->second == next->first) {
+      ins->second += next->second;
+      free_blocks.erase(next);
+    }
+    return 0;
+  }
+
+  uint64_t largest_free() {
+    std::lock_guard<std::mutex> lock(mu);
+    uint64_t best = 0;
+    for (auto& kv : free_blocks)
+      if (kv.second > best) best = kv.second;
+    return best;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* asa_create(uint64_t size) {
+  return new (std::nothrow) Allocator(size);
+}
+
+void asa_destroy(void* h) { delete static_cast<Allocator*>(h); }
+
+uint64_t asa_allocate(void* h, uint64_t size) {
+  return static_cast<Allocator*>(h)->allocate(size);
+}
+
+int asa_free(void* h, uint64_t offset) {
+  return static_cast<Allocator*>(h)->free_block(offset);
+}
+
+uint64_t asa_allocated_bytes(void* h) {
+  std::lock_guard<std::mutex> lock(static_cast<Allocator*>(h)->mu);
+  return static_cast<Allocator*>(h)->allocated_bytes;
+}
+
+uint64_t asa_free_block_count(void* h) {
+  std::lock_guard<std::mutex> lock(static_cast<Allocator*>(h)->mu);
+  return static_cast<Allocator*>(h)->free_blocks.size();
+}
+
+uint64_t asa_largest_free(void* h) {
+  return static_cast<Allocator*>(h)->largest_free();
+}
+
+}  // extern "C"

@@ -248,13 +248,19 @@ class ShuffleClient:
 
     def __init__(self, connect: Callable[[], Connection],
                  max_inflight_bytes: int = 8 << 20,
-                 max_retries: int = 3, retry_backoff_s: float = 0.05):
+                 max_retries: int = 3, retry_backoff_s: float = 0.05,
+                 bounce: Optional["BounceBufferManager"] = None):
+        from ..exec.native_alloc import BounceBufferManager
         self._connect = connect
         self.max_inflight_bytes = max_inflight_bytes
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
+        # receive staging: chunk reassembly sub-allocates windows out of one
+        # arena (BounceBufferManager.scala:35) instead of transient buffers
+        self.bounce = bounce or BounceBufferManager(
+            max(2 * max_inflight_bytes, 16 << 20))
         self.metrics: Dict[str, int] = {"retries": 0, "bytes_fetched": 0,
-                                        "chunks": 0}
+                                        "chunks": 0, "bounce_misses": 0}
 
     @staticmethod
     def for_address(host: str, port: int, **kw) -> "ShuffleClient":
@@ -334,8 +340,14 @@ class ShuffleClient:
                     from .compression import get_codec
                     payload = get_codec(codec_name).decompress(
                         payload, header.get("raw_len", 0))
-                buf = received.setdefault(
-                    bid, bytearray(inflight[bid].total_bytes))
+                buf = received.get(bid)
+                if buf is None:
+                    total = inflight[bid].total_bytes
+                    buf = self.bounce.acquire(total)
+                    if buf is None:              # arena exhausted: fall back
+                        self.metrics["bounce_misses"] += 1
+                        buf = bytearray(total)
+                    received[bid] = buf
                 buf[header["offset"]:header["offset"] + len(payload)] = \
                     payload
                 self.metrics["chunks"] += 1
@@ -344,7 +356,10 @@ class ShuffleClient:
                     m = inflight.pop(bid)
                     inflight_bytes -= m.total_bytes
                     self.metrics["bytes_fetched"] += m.total_bytes
-                    done.append(_rebuild_batch(m, bytes(received.pop(bid))))
+                    buf = received.pop(bid)
+                    done.append(_rebuild_batch(m, bytes(buf)))
+                    if isinstance(buf, memoryview):
+                        self.bounce.release(buf)
                     issue()
             return done
         finally:

@@ -278,3 +278,66 @@ def test_two_process_shuffle_over_tcp(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+# -- native AddressSpaceAllocator + bounce arena (ref:
+# AddressSpaceAllocator.scala:22, BounceBufferManager.scala:35) --------------
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("force_python", [False, True])
+def test_address_space_allocator(force_python):
+    from spark_rapids_tpu.exec.native_alloc import AddressSpaceAllocator
+    a = AddressSpaceAllocator(1000, force_python=force_python)
+    o1 = a.allocate(100)
+    o2 = a.allocate(200)
+    o3 = a.allocate(300)
+    assert (o1, o2, o3) == (0, 100, 300)
+    assert a.allocated_bytes == 600
+    a.free(o2)                            # hole at [100, 300)
+    assert a.free_block_count == 2
+    o4 = a.allocate(150)                  # first-fit into the hole
+    assert o4 == 100
+    a.free(o4)
+    a.free(o1)
+    a.free(o3)
+    assert a.allocated_bytes == 0
+    # full coalescing: one free block spanning everything
+    assert a.free_block_count == 1
+    assert a.largest_free == 1000
+    assert a.allocate(1000) == 0
+    assert a.allocate(1) is None          # exhausted
+    assert a.allocate(0) is None
+    a.close()
+
+
+def test_native_allocator_is_actually_native():
+    """g++ is in this image: the C++ build must succeed and load."""
+    from spark_rapids_tpu.exec.native_alloc import AddressSpaceAllocator
+    a = AddressSpaceAllocator(64)
+    assert a.native, "expected the C++ allocator to build via g++"
+    a.close()
+
+
+def test_free_unallocated_offset_raises():
+    from spark_rapids_tpu.exec.native_alloc import AddressSpaceAllocator
+    a = AddressSpaceAllocator(64)
+    if a.native:
+        with pytest.raises(ValueError):
+            a.free(7)
+    a.close()
+
+
+def test_fetch_through_bounce_arena():
+    """Client staging rides the arena: windows acquire and release across a
+    multi-buffer fetch."""
+    batches = [(r, _batch(1000, base=r * 5000)) for r in range(4)]
+    srv = _server_with(batches, chunk_bytes=2048)
+    client = loopback_client(srv)
+    got = client.fetch(7, [0, 1, 2, 3])
+    assert len(got) == 4
+    assert client.bounce.allocator.allocated_bytes == 0   # all released
+    all_got = sorted(r for g in got for r in g.rows())
+    all_exp = sorted(r for _rid, b in batches for r in b.rows())
+    assert all_got == all_exp
