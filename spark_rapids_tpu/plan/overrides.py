@@ -540,11 +540,23 @@ class Overrides:
                                              stats_bytes)
         if mesh_exec is not None:
             return mesh_exec
+        # fold a direct Filter child into the aggregate's fused update:
+        # the whole scan->filter->aggregate stage becomes the agg's own
+        # programs — no separate filter dispatch, compaction, or count sync
+        # per batch (DESIGN.md §2 whole-stage pipeline)
+        pre_filter = None
+        if (isinstance(child, ph.TpuFilterExec) and
+                child.condition.tree_fusable() and
+                not child.condition.collect(
+                    lambda x: not x.side_effect_free)):
+            pre_filter = child.condition          # bound to the grandchild
+            child = child.children[0]
         if child.output_partitions > 1:
             from ..shuffle.exchange import (TpuHashExchangeExec,
                                             TpuShuffleExchangeExec)
             partial = ph.TpuHashAggregateExec(child, grouping, outputs,
-                                              mode="partial")
+                                              mode="partial",
+                                              pre_filter=pre_filter)
             if grouping:
                 keys = [ex.ColumnRef(f"_k{i}") for i in range(len(grouping))]
                 # adaptive_ok: the final aggregate tolerates runtime
@@ -561,7 +573,8 @@ class Overrides:
             return ph.TpuHashAggregateExec(exch, grouping, outputs,
                                            mode="final",
                                            per_partition_final=True)
-        return ph.TpuHashAggregateExec(child, grouping, outputs)
+        return ph.TpuHashAggregateExec(child, grouping, outputs,
+                                       pre_filter=pre_filter)
 
     def _convert_distinct_agg(self, p: lp.Aggregate, child: ph.TpuExec,
                               leaves: List[lp.AggregateExpression]

@@ -685,9 +685,16 @@ class TpuHashAggregateExec(TpuExec):
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
                  aggregate_exprs: List[ex.Expression], mode: str = "complete",
-                 per_partition_final: bool = False):
+                 per_partition_final: bool = False,
+                 pre_filter: Optional[ex.Expression] = None):
         super().__init__(child)
         self.mode = mode
+        # pre_filter: a Filter condition the planner folded into this
+        # aggregate (bound to the child schema): the update phase compacts
+        # rows inside ITS OWN fused program, eliminating the separate
+        # filter program + count sync per batch (the whole-stage
+        # scan->filter->agg pipeline of DESIGN.md §2)
+        self.pre_filter = pre_filter
         # per_partition_final: the planner guarantees the child is hash-
         # partitioned on the grouping keys (an exchange directly below), so
         # each partition's groups are disjoint and the final merge runs
@@ -837,6 +844,7 @@ class TpuHashAggregateExec(TpuExec):
         fused = self._maybe_fused_phase(batch, "update")
         if fused is not None:
             return self._shrink_partial(fused)
+        batch = self._apply_pre_filter_eager(batch)
         keys, specs = self._build_update_specs(batch)
         cap = batch.capacity
         if not self.grouping:
@@ -860,6 +868,32 @@ class TpuHashAggregateExec(TpuExec):
         cols = [K.rebucket_column(c, batch.num_rows, ncap)
                 for c in batch.columns]
         return ColumnarBatch(batch.schema, cols, batch.num_rows)
+
+    def _apply_pre_filter_eager(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Eager fallback of the folded Filter (fused paths compact inside
+        their own traced programs)."""
+        if self.pre_filter is None or batch.num_rows == 0:
+            return batch
+        pred = self.pre_filter.eval(batch)
+        if isinstance(pred, Scalar):
+            if pred.value is True:
+                return batch
+            return ColumnarBatch(batch.schema, batch.columns, 0)
+        keep = pred.data & pred.validity & batch.row_mask()
+        cols, count = K.compact_columns(batch.columns, keep)
+        return ColumnarBatch(batch.schema, cols, int(count))
+
+    def _traced_pre_filter(self, b: ColumnarBatch) -> ColumnarBatch:
+        """In-trace compaction by the folded Filter (cumsum+scatter, cheap)."""
+        if self.pre_filter is None:
+            return b
+        pred = self.pre_filter.eval(b)
+        if isinstance(pred, Scalar):
+            raise _ScalarPredicate()
+        import jax.numpy as jnp
+        keep = pred.data & pred.validity & b.row_mask()
+        cols, count = K.compact_columns(b.columns, keep)
+        return ColumnarBatch(b.schema, cols, count)
 
     # -- whole-stage fused group-by (expression eval + kernel in <=2
     # device programs per batch; see the fusion section above) --------------
@@ -906,6 +940,8 @@ class TpuHashAggregateExec(TpuExec):
                 b is not None and not b.tree_fusable()
                 for b in self.bound_leaf_inputs):
             return None
+        if self.pre_filter is not None and not self.pre_filter.tree_fusable():
+            return None
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -916,8 +952,25 @@ class TpuHashAggregateExec(TpuExec):
         sig = self._fusion_sig(phase, in_schema)
         if sig is None:
             return None
-        build_eval = (self._build_update_specs if phase == "update"
-                      else self._merge_specs)
+        if self.pre_filter is not None:
+            fkey = _expr_cache_key(self.pre_filter)
+            if fkey is None:
+                return None
+            sig = sig + ("pre_filter", fkey)
+
+        def build_eval(b):
+            # the folded Filter compacts INSIDE the traced program (update
+            # phase only: merge/final consume already-filtered partials);
+            # returns (keys, specs, effective_row_count) — kernels must see
+            # the POST-filter count or dead rows would join the NULL group
+            n_eff = b.num_rows
+            if phase == "update":
+                b = self._traced_pre_filter(b)
+                n_eff = b.num_rows
+                keys, specs = self._build_update_specs(b)
+            else:
+                keys, specs = self._merge_specs(b)
+            return keys, specs, n_eff
         pschema = self._partial_schema()
 
         try:
@@ -926,8 +979,8 @@ class TpuHashAggregateExec(TpuExec):
                     def fn(num_rows, *arrays):
                         b = ColumnarBatch.from_flat_arrays(
                             in_schema, arrays, num_rows)
-                        _keys, specs = build_eval(b)
-                        aggs = agg_k.reduce_aggregate(specs, num_rows,
+                        _keys, specs, n_eff = build_eval(b)
+                        aggs = agg_k.reduce_aggregate(specs, n_eff,
                                                       b.capacity)
                         return tuple(a for c in aggs for a in c.arrays())
                     return jax.jit(fn)
@@ -950,12 +1003,12 @@ class TpuHashAggregateExec(TpuExec):
                     def fn(num_rows, *arrays):
                         b = ColumnarBatch.from_flat_arrays(
                             in_schema, arrays, num_rows)
-                        keys, specs = build_eval(b)
+                        keys, specs, n_eff = build_eval(b)
                         float_cols = [
                             s.column for s in specs
                             if s.op in ("sum", "avg") and s.column is not None
                             and s.column.dtype.is_floating]
-                        return agg_k.dense_key_stats(keys[0], num_rows,
+                        return agg_k.dense_key_stats(keys[0], n_eff,
                                                      float_cols=float_cols)
                     return jax.jit(fn)
                 probe = _fused_fn(sig + ("probe", cap), build_probe)
@@ -972,9 +1025,9 @@ class TpuHashAggregateExec(TpuExec):
                         def fn(num_rows, rmin_d, *arrays):
                             b = ColumnarBatch.from_flat_arrays(
                                 in_schema, arrays, num_rows)
-                            keys, specs = build_eval(b)
+                            keys, specs, n_eff = build_eval(b)
                             ok, oa, ng = agg_k.groupby_dense(
-                                keys[0], specs, num_rows, Kb, rmin_d)
+                                keys[0], specs, n_eff, Kb, rmin_d)
                             flat = [a for c in ok + oa for a in c.arrays()]
                             return tuple(flat) + (ng,)
                         return jax.jit(fn)
@@ -990,9 +1043,9 @@ class TpuHashAggregateExec(TpuExec):
                 def fn(num_rows, *arrays):
                     b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
                                                        num_rows)
-                    keys, specs = build_eval(b)
+                    keys, specs, n_eff = build_eval(b)
                     ok, oa, ng = agg_k.groupby_aggregate(
-                        keys, specs, num_rows, b.capacity)
+                        keys, specs, n_eff, b.capacity)
                     flat = [a for c in ok + oa for a in c.arrays()]
                     return tuple(flat) + (ng,)
                 return jax.jit(fn)

@@ -346,3 +346,42 @@ def test_join_exchanges_never_adaptive():
     assert joins
     for e in _find(joins[0], TpuShuffleExchangeExec):
         assert not e.adaptive_ok
+
+
+def test_filter_folds_into_aggregate():
+    """A direct Filter child folds into the aggregate's fused update: no
+    TpuFilterExec remains in the plan, and results match the oracle."""
+    from spark_rapids_tpu.plan.physical import (TpuFilterExec,
+                                                TpuHashAggregateExec)
+    captured = {}
+
+    def q(s):
+        captured["s"] = s
+        return (s.createDataFrame(_seeded())
+                .filter(F.col("v") > 0)
+                .groupBy("k").agg(F.sum("v").alias("sv"),
+                                  F.count("*").alias("n"),
+                                  F.avg("v").alias("av")))
+
+    assert_tpu_and_cpu_equal(q, approx=1e-9)
+    plan = captured["s"].last_plan()
+    assert not _find(plan, TpuFilterExec), plan
+    aggs = _find(plan, TpuHashAggregateExec,
+                 lambda n: n.pre_filter is not None)
+    assert aggs, plan
+
+
+def test_folded_filter_global_agg_and_empty_result():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded())
+        .filter(F.col("v") > 1e12)          # filters everything out
+        .agg(F.count("*").alias("n"), F.sum("v").alias("sv")))
+
+
+def test_folded_filter_two_phase():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_seeded()).repartition(4)
+        .filter(F.col("j") >= 0)
+        .groupBy("k").agg(F.sum("v").alias("sv"),
+                          F.count("v").alias("c")),
+        approx=1e-9)
