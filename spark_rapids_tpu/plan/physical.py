@@ -783,14 +783,37 @@ class TpuHashAggregateExec(TpuExec):
         return [self._stream_merge(stream(), project=(self.mode != "partial"))]
 
     # -- streaming update + merge loop ---------------------------------------
+    # pending update-phase partials accumulate (spillable) up to this many
+    # before one merge pass: merging every batch would dispatch a merge
+    # program per input batch; partials are tiny (bucket(n_groups)) so the
+    # fan-in costs little memory and cuts merge dispatches ~MERGE_FAN_IN x
+    MERGE_FAN_IN = 8
+
     def _stream_merge(self, batches, project: bool) -> Partition:
-        """Per-batch update-agg, concat with the running partial, merge-agg
-        (the reference's hot loop, aggregate.scala:427-485). The running
-        partial lives in the spill catalog between batches, so aggregation
-        state never exceeds one partial batch + one input batch of HBM."""
+        """Per-batch update-agg; pending partials merge in fan-in groups
+        (the reference's hot loop, aggregate.scala:427-485, with batched
+        merge cadence). All state lives in the spill catalog between
+        batches, so aggregation residency stays bounded."""
         from ..exec.spill import SpillableColumnarBatch
         pschema = self._partial_schema()
-        running = None
+        pending: List[SpillableColumnarBatch] = []
+
+        def merge_pending() -> None:
+            if len(pending) <= 1:
+                return
+            batches_ = []
+            total = 0
+            for s in pending:
+                b = s.get_batch()
+                total += b.device_size_bytes()
+                batches_.append(b)
+                s.close()
+            pending.clear()
+            _reserve(2 * total)
+            merged_in = concat_batches(pschema, batches_)
+            pending.append(SpillableColumnarBatch(
+                self._merge_to_partial(merged_in)))
+
         for batch in batches:
             # semaphore ordering contract: acquire only once the first input
             # batch exists (upstream host IO done), GpuSemaphore.scala:74-78
@@ -799,20 +822,16 @@ class TpuHashAggregateExec(TpuExec):
             with self.metrics.timer("computeAggTime"):
                 pb = batch if self.mode == "final" else \
                     self._update_partial_batch(batch)
-                if running is None:
-                    running = SpillableColumnarBatch(pb)
-                    continue
-                prev = running.get_batch()
-                running.close()
-                _reserve(prev.device_size_bytes() + pb.device_size_bytes())
-                merged_in = concat_batches(pschema, [prev, pb])
-                running = SpillableColumnarBatch(
-                    self._merge_to_partial(merged_in))
-        if running is None:
+                pending.append(SpillableColumnarBatch(pb))
+                if len(pending) >= self.MERGE_FAN_IN:
+                    merge_pending()
+        with self.metrics.timer("computeAggTime"):
+            merge_pending()
+        if not pending:
             final_in = ColumnarBatch.empty(pschema)
         else:
-            final_in = running.get_batch()
-            running.close()
+            final_in = pending[0].get_batch()
+            pending[0].close()
         if project:
             yield from self._final(final_in)
         else:
