@@ -1058,6 +1058,88 @@ class TpuHashAggregateExec(TpuExec):
                 if span + 2 > agg_k.DENSE_MAX_SLOTS:
                     self._dense_state["enabled"] = False
 
+            if _matmul_agg_enabled():
+                # staged sort path: probe (sort + segments + group-count
+                # sync) -> MXU matmul segment kernel with a static group
+                # bucket. TPU scatters serialize (the one-program scatter
+                # kernel ran ~850ms/batch on q1); matmul segment reductions
+                # at small Kb are ~10x faster (groupby_aggregate_fast's
+                # use_mm branch, fused)
+                def build_sort_probe():
+                    def fn(num_rows, *arrays):
+                        b = ColumnarBatch.from_flat_arrays(
+                            in_schema, arrays, num_rows)
+                        keys, specs, n_eff = build_eval(b)
+                        capb = b.capacity
+                        order = K.sort_indices(
+                            [K.SortKey(c) for c in keys], n_eff, capb)
+                        skeys = [K.gather_column(c, order) for c in keys]
+                        starts = K.segment_starts_from_sorted_keys(
+                            skeys, n_eff, capb)
+                        parts = [jnp.sum(starts).astype(jnp.float64)]
+                        for s in specs:
+                            if s.op in ("sum", "avg") and \
+                                    s.column is not None and \
+                                    s.column.dtype.is_floating:
+                                c = s.column
+                                a = jnp.where(
+                                    c.validity & ~jnp.isnan(c.data),
+                                    jnp.abs(c.data), 0.0)
+                                parts.append(jnp.max(a).astype(jnp.float64))
+                        return order, starts, n_eff, jnp.stack(parts)
+                    return jax.jit(fn)
+                probe = _fused_fn(sig + ("sort-probe", cap),
+                                  build_sort_probe)
+                order, starts, n_eff_dev, dec = probe(
+                    jnp.int32(batch.num_rows), *batch.flat_arrays())
+                stats = np.asarray(dec)              # the ONE sync
+                n_groups = int(stats[0])
+                f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX
+                                    for a in stats[1:]))
+                Kb = _bucket(max(n_groups, 1))
+                # per-spec mixing below: matmul where supported (count,
+                # float sum/avg), scatter-at-Kb otherwise (min/max, int sums)
+                use_mm = Kb <= agg_k.MATMUL_MAX_GROUPS and f32_safe
+
+                def build_sort_kernel(Kb=Kb, use_mm=use_mm):
+                    def fn(num_rows, order, starts, n_eff, *arrays):
+                        b = ColumnarBatch.from_flat_arrays(
+                            in_schema, arrays, num_rows)
+                        keys, specs, _n = build_eval(b)
+                        capb = b.capacity
+                        live = jnp.arange(capb) < n_eff
+                        seg_ids = K.segment_ids(starts)
+                        ng = jnp.sum(starts).astype(jnp.int32)
+                        start_perm, _cnt = K.compaction_indices(starts)
+                        kidx = start_perm[:Kb]
+                        glive = jnp.arange(Kb) < ng
+                        skeys = [K.gather_column(c, order) for c in keys]
+                        ok = [K.gather_column(c, kidx, out_valid=glive)
+                              for c in skeys]
+                        oa = []
+                        for s in specs:
+                            sc = s
+                            if s.column is not None:
+                                sc = s._replace(column=K.gather_column(
+                                    s.column, order))
+                            if use_mm and agg_k._matmul_supported(sc):
+                                agg = agg_k.segment_aggregate_matmul(
+                                    sc, seg_ids, live, Kb)
+                            else:
+                                agg = agg_k.segment_aggregate(
+                                    sc, seg_ids, live, capb,
+                                    num_segments=Kb)
+                            oa.append(agg_k._mask_to(agg, glive))
+                        flat = [a for c in ok + oa for a in c.arrays()]
+                        return tuple(flat) + (ng,)
+                    return jax.jit(fn)
+                fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm),
+                               build_sort_kernel)
+                outs = fn(jnp.int32(batch.num_rows), order, starts,
+                          n_eff_dev, *batch.flat_arrays())
+                return ColumnarBatch.from_flat_arrays(
+                    pschema, list(outs[:-1]), int(outs[-1]))
+
             def build_sort():
                 def fn(num_rows, *arrays):
                     b = ColumnarBatch.from_flat_arrays(in_schema, arrays,

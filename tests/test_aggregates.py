@@ -302,3 +302,40 @@ def test_dense_nan_poisons_only_its_group():
     assert keys[0] == [1, 2]
     assert math.isnan(aggs[0][0]) and math.isnan(aggs[1][0])
     assert aggs[0][1] == 7.0 and aggs[1][1] == 3.5
+
+
+def test_fused_staged_matmul_groupby_matches_exact():
+    """Force the MXU matmul segment path (off by default on CPU): the staged
+    probe+kernel fused sort group-by must match the exact path to float-agg
+    tolerance."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    rng = np.random.default_rng(41)
+    n = 5000
+    df = pd.DataFrame({
+        "k": [f"g{int(x)}" for x in rng.integers(0, 23, n)],  # string keys
+        "v": rng.normal(0, 10, n),
+        "q": rng.integers(0, 50, n)})
+    s = TpuSession.builder.config({
+        "spark.rapids.tpu.sql.explain": "NONE",
+        "spark.rapids.tpu.sql.agg.matmul.enabled": "true"}).getOrCreate()
+    got = {r[0]: r[1:] for r in
+           (s.createDataFrame(df).filter(F.col("v") > -5)
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.count("*").alias("n"),
+                              F.avg("v").alias("av"),
+                              F.sum("q").alias("sq"),
+                              F.min("v").alias("mv")).collect())}
+    sub = df[df.v > -5]
+    exp = sub.groupby("k").agg(sv=("v", "sum"), n=("v", "size"),
+                               av=("v", "mean"), sq=("q", "sum"),
+                               mv=("v", "min"))
+    assert len(got) == len(exp)
+    for k, row in exp.iterrows():
+        sv, cnt, av, sq, mv = got[k]
+        assert cnt == row["n"] and sq == row["sq"]
+        assert abs(sv - row["sv"]) <= 1e-6 * max(1, abs(row["sv"]))
+        assert abs(av - row["av"]) <= 1e-6 * max(1, abs(row["av"]))
+        assert abs(mv - row["mv"]) <= 1e-12
