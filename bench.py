@@ -147,12 +147,12 @@ def bench_engine(sf: float, query: str, iters: int = 2):
     n_rows = int(datagen.LINEITEM_PER_SF * sf)
     qfn = Q.QUERIES[query]
     t0 = time.perf_counter()
-    qfn(tables).collect_batch()
+    qfn(tables).collect_batch().fetch_to_host()
     cold_s = time.perf_counter() - t0
     hots = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        qfn(tables).collect_batch()
+        qfn(tables).collect_batch().fetch_to_host()
         hots.append(time.perf_counter() - t0)
     hot_s = min(hots)
 

@@ -39,7 +39,7 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         for it in range(iterations):
             t0 = time.perf_counter()
             df = qfn(tables)
-            batch = df.collect_batch()
+            batch = df.collect_batch().fetch_to_host()
             rows = batch.num_rows
             timings.append(round(time.perf_counter() - t0, 4))
         entry = {

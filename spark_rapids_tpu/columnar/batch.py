@@ -19,7 +19,7 @@ from .column import Column, Scalar, bucket
 
 
 class ColumnarBatch:
-    __slots__ = ("schema", "columns", "num_rows")
+    __slots__ = ("schema", "columns", "_num_rows")
 
     def __init__(self, schema: dt.Schema, columns: List[Column], num_rows: int):
         assert len(schema) == len(columns), "schema/column arity mismatch"
@@ -27,15 +27,38 @@ class ColumnarBatch:
         assert len(caps) <= 1, f"mixed capacities in batch: {caps}"
         self.schema = schema
         self.columns = columns
-        try:
-            self.num_rows = int(num_rows)
-        except Exception:
-            # traced device scalar: batches built inside fused (jitted)
-            # stages carry their row count as a tracer until the stage's
-            # host boundary syncs it
-            self.num_rows = num_rows
+        if isinstance(num_rows, (int, np.integer)):
+            self._num_rows = int(num_rows)
+        else:
+            # Traced tracer (batches built inside fused/jitted stages) or a
+            # CONCRETE device scalar: the count stays device-resident until a
+            # host consumer reads `num_rows` — so a streaming pipeline can
+            # dispatch batch after batch without a blocking readback per
+            # batch (the dominant engine cost on high-latency links).
+            self._num_rows = num_rows
 
     # -- shape ---------------------------------------------------------------
+    @property
+    def num_rows(self):
+        """Host row count. Lazily syncs a device-resident count on first
+        access (cross host boundaries with ``resolve_counts`` to batch the
+        readbacks); returns the tracer unchanged inside traced code."""
+        nr = self._num_rows
+        if isinstance(nr, int):
+            return nr
+        import jax
+        if isinstance(nr, jax.core.Tracer):
+            return nr
+        nr = int(nr)                       # device->host sync
+        self._num_rows = nr
+        return nr
+
+    @property
+    def num_rows_raw(self):
+        """The count in whatever form it currently has (int / device scalar /
+        tracer) — no sync."""
+        return self._num_rows
+
     @property
     def capacity(self) -> int:
         return self.columns[0].capacity if self.columns else bucket(self.num_rows)
@@ -58,7 +81,9 @@ class ColumnarBatch:
 
     def with_columns(self, schema: dt.Schema, columns: List[Column],
                      num_rows: Optional[int] = None) -> "ColumnarBatch":
-        return ColumnarBatch(schema, columns, self.num_rows if num_rows is None else num_rows)
+        return ColumnarBatch(
+            schema, columns,
+            self._num_rows if num_rows is None else num_rows)
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -91,13 +116,26 @@ class ColumnarBatch:
     @staticmethod
     def from_arrow(table, capacity: Optional[int] = None) -> "ColumnarBatch":
         """pyarrow Table/RecordBatch -> device batch (the HostColumnarToGpu analog,
-        ref HostColumnarToGpu.scala:30-235)."""
+        ref HostColumnarToGpu.scala:30-235).
+
+        All columns ride ONE staging-buffer upload + one cached unpack
+        program (per-array transfer overhead would otherwise dominate scan
+        streams on high-latency links — the bounce-buffer idea from the
+        reference's shuffle, applied at the scan boundary)."""
         n = table.num_rows
         cap = capacity or bucket(n)
-        cols = [Column.from_arrow(table.column(i), capacity=cap)
-                for i in range(table.num_columns)]
         fields = [dt.Field(table.schema.names[i], dt.from_arrow(table.schema.types[i]))
                   for i in range(table.num_columns)]
+        hosts = []
+        if n:
+            for i in range(table.num_columns):
+                hosts.append(Column.host_from_arrow(table.column(i),
+                                                    capacity=cap))
+        if n == 0 or any(h is None for h in hosts):
+            cols = [Column.from_arrow(table.column(i), capacity=cap)
+                    for i in range(table.num_columns)]
+            return ColumnarBatch(dt.Schema(fields), cols, n)
+        cols = _upload_packed(hosts)
         return ColumnarBatch(dt.Schema(fields), cols, n)
 
     @staticmethod
@@ -132,26 +170,125 @@ class ColumnarBatch:
         return ColumnarBatch(schema, cols, num_rows)
 
     # -- host extraction -----------------------------------------------------
+    def fetch_to_host(self) -> "ColumnarBatch":
+        """Materialize every column on host in ONE batched transfer
+        (GpuColumnarToRowExec's single device->host copy, vs a blocking
+        round-trip per array — which dominates on high-latency links).
+        Returns a batch whose columns are numpy-backed, sliced to
+        ``num_rows``."""
+        import jax
+        n = self.num_rows                     # the one count sync
+        if not self.columns:
+            return self
+        if all(isinstance(c.data, np.ndarray) for c in self.columns):
+            return self
+        # slice to a BUCKETED length before the transfer: padding beyond
+        # bucket(n) stays on device, while the power-of-two slice shapes
+        # keep the compile cache bounded (vs one slice program per n)
+        cap = self.capacity
+        m = cap if cap <= (1 << 14) else min(bucket(max(n, 1)), cap)
+        sliced: List[Any] = []
+        for c in self.columns:
+            sliced.append(c.data if m == cap else c.data[:m])
+            sliced.append(c.validity if m == cap else c.validity[:m])
+            if c.dtype.var_width:
+                sliced.append(c.lengths if m == cap else c.lengths[:m])
+        host = jax.device_get(sliced)         # one round trip for the batch
+        return ColumnarBatch.from_flat_arrays(self.schema, host, n)
+
     def to_pydict(self) -> Dict[str, List[Any]]:
-        return {f.name: c.to_pylist(self.num_rows)
-                for f, c in zip(self.schema, self.columns)}
+        host = self.fetch_to_host()
+        return {f.name: c.to_pylist(host.num_rows)
+                for f, c in zip(host.schema, host.columns)}
 
     def to_arrow(self):
         import pyarrow as pa
-        arrays = [c.to_arrow(self.num_rows) for c in self.columns]
-        return pa.table(arrays, names=self.schema.names())
+        host = self.fetch_to_host()
+        arrays = [c.to_arrow(host.num_rows) for c in host.columns]
+        return pa.table(arrays, names=host.schema.names())
 
     def to_pandas(self):
         return self.to_arrow().to_pandas()
 
     def rows(self) -> List[tuple]:
         """Materialize host rows (GpuColumnarToRowExec analog for small results)."""
-        cols = [c.to_pylist(self.num_rows) for c in self.columns]
-        return list(zip(*cols)) if cols else [()] * self.num_rows
+        host = self.fetch_to_host()
+        cols = [c.to_pylist(host.num_rows) for c in host.columns]
+        return list(zip(*cols)) if cols else [()] * host.num_rows
 
     def __repr__(self):
         return (f"ColumnarBatch(rows={self.num_rows}, cap={self.capacity}, "
                 f"schema={self.schema})")
+
+
+_UNPACK_CACHE: Dict[tuple, Any] = {}
+
+
+def _upload_packed(hosts) -> List[Column]:
+    """Pack every column's padded host arrays into one aligned uint8
+    staging buffer, upload it in a single transfer, and carve the device
+    arrays back out with one cached jitted unpack (slice + bitcast)."""
+    import jax
+    import jax.lax as lax
+
+    arrays: List[np.ndarray] = []
+    spec: List[tuple] = []        # (np dtype str, shape, offset, nbytes)
+    pos = 0
+    for _dtype, arrs in hosts:
+        for a in arrs:
+            a = np.ascontiguousarray(a)
+            nbytes = a.nbytes
+            spec.append((a.dtype.str, a.shape, pos, nbytes))
+            arrays.append(a)
+            pos += (nbytes + 7) & ~7          # 8-byte aligned segments
+    buf = np.zeros(pos, dtype=np.uint8)
+    for a, (_d, _s, off, nbytes) in zip(arrays, spec):
+        buf[off:off + nbytes] = a.view(np.uint8).ravel()
+
+    key = (tuple(spec), pos)
+    fn = _UNPACK_CACHE.get(key)
+    if fn is None:
+        if len(_UNPACK_CACHE) > 256:
+            _UNPACK_CACHE.clear()
+
+        def unpack(b):
+            outs = []
+            for dstr, shape, off, nbytes in spec:
+                seg = lax.slice(b, (off,), (off + nbytes,))
+                npdt = np.dtype(dstr)
+                if npdt == np.uint8:
+                    outs.append(seg.reshape(shape))
+                elif npdt == np.bool_:
+                    outs.append((seg != 0).reshape(shape))
+                else:
+                    flat = lax.bitcast_convert_type(
+                        seg.reshape(-1, npdt.itemsize), jnp.dtype(npdt))
+                    outs.append(flat.reshape(shape))
+            return tuple(outs)
+        fn = _UNPACK_CACHE[key] = jax.jit(unpack)
+
+    dev = fn(jnp.asarray(buf))               # ONE upload + ONE dispatch
+    cols: List[Column] = []
+    i = 0
+    for dtype, arrs in hosts:
+        cols.append(Column(dtype, *dev[i:i + len(arrs)]))
+        i += len(arrs)
+    return cols
+
+
+def resolve_counts(batches: Sequence["ColumnarBatch"]) -> None:
+    """Materialize every device-resident row count in ONE batched
+    device_get (a single host round-trip) instead of one blocking readback
+    per batch — the cheap way to cross a host boundary after a lazily
+    counted stream."""
+    lazy = [(b, b.num_rows_raw) for b in batches
+            if not isinstance(b.num_rows_raw, int)]
+    if not lazy:
+        return
+    import jax
+    vals = jax.device_get([r for _, r in lazy])
+    for (b, _), v in zip(lazy, vals):
+        b._num_rows = int(v)
 
 
 def _infer_dtype(values: Sequence[Any]) -> dt.DType:

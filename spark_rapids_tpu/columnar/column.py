@@ -173,6 +173,24 @@ class Column:
     def from_arrow(arr, capacity: Optional[int] = None,
                    width: Optional[int] = None) -> "Column":
         """Build a device column from a pyarrow Array/ChunkedArray (host boundary)."""
+        host = Column.host_from_arrow(arr, capacity, width)
+        if host is None:                      # ARRAY<...>: python-list path
+            import pyarrow as pa
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            dtype = dt.from_arrow(arr.type)
+            return Column.from_pylist(arr.to_pylist(), dtype, capacity, width)
+        dtype, arrays = host
+        return Column(dtype, *[jnp.asarray(a) for a in arrays])
+
+    @staticmethod
+    def host_from_arrow(arr, capacity: Optional[int] = None,
+                        width: Optional[int] = None):
+        """Arrow -> padded host numpy arrays [data, validity(, lengths)]
+        WITHOUT the device upload, so a batch-level caller can pack every
+        column into one staging buffer and upload once (per-array transfer
+        overhead dominates scan streams on high-latency links). Returns
+        (dtype, arrays) or None for types that need the pylist path."""
         import pyarrow as pa
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
@@ -211,10 +229,9 @@ class Column:
             lens_full[:n] = lens
             valid_full = np.zeros(cap, np.bool_)
             valid_full[:n] = valid
-            return Column(dt.STRING, jnp.asarray(mat),
-                          jnp.asarray(valid_full), jnp.asarray(lens_full))
+            return (dt.STRING, [mat, valid_full, lens_full])
         if dt.is_array(dtype):
-            return Column.from_pylist(arr.to_pylist(), dtype, capacity, width)
+            return None
         np_valid = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 else \
             np.asarray(arr.is_valid())
         if dtype == dt.TIMESTAMP:
@@ -226,7 +243,15 @@ class Column:
             values = np.asarray(arr.fill_null(False))
         else:
             values = np.asarray(arr.fill_null(0)).astype(dtype.numpy_dtype)
-        return Column.from_numpy(values, dtype, np_valid, capacity)
+        n = len(values)
+        cap = capacity or bucket(n)
+        storage = np.zeros(cap, dtype=dtype.numpy_dtype)
+        valid = np.zeros(cap, dtype=np.bool_)
+        storage[:n] = np.where(np_valid, values,
+                               np.zeros((), dtype=dtype.numpy_dtype)) \
+            if n else values
+        valid[:n] = np_valid
+        return (dtype, [storage, valid])
 
     @staticmethod
     def full_null(dtype: dt.DType, capacity: int, width: int = MIN_STRING_WIDTH) -> "Column":

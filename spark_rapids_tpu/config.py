@@ -355,6 +355,16 @@ MATMUL_AGG = _conf("spark.rapids.tpu.sql.agg.matmul.enabled").doc(
     "sequential order at ~1e-5 rel — the variableFloatAgg trade "
     "(ref: RapidsConf.scala variableFloatAgg)").string_conf.create_with_default("auto")
 
+AGG_PIPELINE_DEPTH = _conf("spark.rapids.tpu.sql.agg.pipelineDepth").doc(
+    "Input batches kept in flight by the streaming aggregation before the "
+    "oldest batch's partial result is landed: probe-stat readbacks overlap "
+    "device compute across this window, hiding dispatch/link latency "
+    "(dominant on tunneled or remote devices). The oldest half of the "
+    "window lands when it fills, so stat transfers get half a window of "
+    "dispatch work to hide behind. Device residency grows by one input "
+    "batch per slot"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(16)
+
 READER_THREADS = _conf("spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads").doc(
     "Background decode threads for the MULTITHREADED reader "
     "(ref: RapidsConf.scala:548)").integer_conf.create_with_default(4)

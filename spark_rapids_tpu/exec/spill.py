@@ -211,7 +211,7 @@ class BufferCatalog:
             col_dtypes.append(c.dtype)
         buf = SpillableBuffer(
             next_buffer_id(),
-            BufferMeta(batch.schema, batch.num_rows, batch.capacity),
+            BufferMeta(batch.schema, batch.num_rows_raw, batch.capacity),
             priority, arrays, col_dtypes)
         with self._mu:
             self.buffers[buf.id] = buf
@@ -302,11 +302,21 @@ class SpillableColumnarBatch:
                  priority: float = ACTIVE_ON_DECK_PRIORITY,
                  catalog: Optional[BufferCatalog] = None):
         self.catalog = catalog or BufferCatalog.get()
-        self.num_rows = batch.num_rows
+        # keep a device-resident count lazy: registering a streamed batch
+        # must not force a host sync (see ColumnarBatch.num_rows)
+        self._num_rows = batch.num_rows_raw
         self.schema = batch.schema
         self.size_bytes = batch.device_size_bytes()
         self._id = self.catalog.register_batch(batch, priority)
         self._closed = False
+
+    @property
+    def num_rows(self):
+        nr = self._num_rows
+        if not isinstance(nr, int):
+            nr = int(nr)
+            self._num_rows = nr
+        return nr
 
     def get_batch(self) -> ColumnarBatch:
         assert not self._closed, "use after close"

@@ -69,13 +69,68 @@ def bind_refs(e: ex.Expression, schema: dt.Schema) -> ex.Expression:
 # Metrics (GpuMetricNames, GpuExec.scala:27-56)
 # ---------------------------------------------------------------------------
 
+def _dev_count(batch) -> "Any":
+    """A batch's row count as a device int32 scalar for a fused-program
+    argument — WITHOUT forcing a host sync when the count is still
+    device-resident (lazy counts ride the stream; see ColumnarBatch)."""
+    import jax.numpy as jnp
+    nr = batch.num_rows_raw
+    if isinstance(nr, int):
+        return jnp.int32(nr)
+    if getattr(nr, "dtype", None) == jnp.int32:
+        return nr
+    return nr.astype(jnp.int32)
+
+
 class Metrics(dict):
     _lock = __import__("threading").Lock()
 
     def inc(self, key: str, amount: float = 1) -> None:
-        # partitions drain on concurrent task threads; keep counters exact
+        # partitions drain on concurrent task threads; keep counters exact.
+        # Device-resident amounts (lazy batch counts) are banked unresolved
+        # so metric accounting never forces a device sync on the hot path.
+        if not isinstance(amount, (int, float)):
+            with Metrics._lock:
+                if not hasattr(self, "_pending"):
+                    self._pending = []
+                self._pending.append((key, amount))
+                flush = len(self._pending) >= 256
+            if flush:          # bound the deferred-scalar backlog
+                self.resolve()
+            return
         with Metrics._lock:
-            self[key] = self.get(key, 0) + amount
+            self[key] = dict.get(self, key, 0) + amount
+
+    def resolve(self) -> "Metrics":
+        """Fold deferred device-scalar amounts into the counters in one
+        batched readback (reporting boundaries; readers below call it)."""
+        with Metrics._lock:
+            pend = getattr(self, "_pending", [])
+            self._pending = []
+        if pend:
+            import jax
+            try:
+                vals = jax.device_get([a for _k, a in pend])
+            except Exception:
+                vals = [0.0] * len(pend)
+            with Metrics._lock:
+                for (key, _a), v in zip(pend, vals):
+                    self[key] = dict.get(self, key, 0) + float(v)
+        return self
+
+    # readers see resolved counters (deferred amounts fold in lazily)
+    def __getitem__(self, key):
+        self.resolve()
+        return dict.__getitem__(self, key)
+
+    def get(self, key, default=None):
+        if getattr(self, "_pending", None):
+            self.resolve()
+        return dict.get(self, key, default)
+
+    def items(self):
+        self.resolve()
+        return dict.items(self)
 
     def timer(self, key: str):
         return _Timer(self, key)
@@ -225,7 +280,22 @@ def accumulate_spillable(parts) -> List["SpillableColumnarBatch"]:
     from ..exec.tasks import run_partition_tasks
 
     def drain(pid, p):
-        return [SpillableColumnarBatch(b) for b in p if b.num_rows > 0]
+        from ..columnar.batch import resolve_counts
+        out: List[SpillableColumnarBatch] = []
+        chunk: List[ColumnarBatch] = []
+
+        def flush():
+            resolve_counts(chunk)      # one round-trip per chunk, not per batch
+            out.extend(SpillableColumnarBatch(b) for b in chunk
+                       if b.num_rows > 0)
+            chunk.clear()
+
+        for b in p:
+            chunk.append(b)
+            if len(chunk) >= 8:
+                flush()
+        flush()
+        return out
 
     parts = list(parts)
     return [s for lst in run_partition_tasks(parts, drain) for s in lst]
@@ -246,19 +316,126 @@ def concat_spillable(schema: dt.Schema,
 
 def concat_batches(schema: dt.Schema, batches: List[ColumnarBatch],
                    target_capacity: Optional[int] = None) -> ColumnarBatch:
-    """Concatenate host-counted batches (GpuCoalesceBatches concat path)."""
-    batches = [b for b in batches if b.num_rows > 0]
+    """Concatenate batches in ONE fused device program (GpuCoalesceBatches
+    concat path). The eager per-column form dispatched 2-3 dynamic-slice
+    programs per column per batch — hundreds of tiny executions per merge
+    cycle, the dominant steady-state cost on dispatch-latency-bound links.
+    The fused program takes every batch's arrays + row counts (device
+    scalars welcome) and emits the packed output columns."""
+    from ..columnar.batch import resolve_counts
+    known_zero = [b for b in batches
+                  if isinstance(b.num_rows_raw, int) and b.num_rows_raw == 0]
+    batches = [b for b in batches if b not in known_zero]
     if not batches:
         return ColumnarBatch.empty(schema)
     if len(batches) == 1 and target_capacity is None:
         return batches[0]
-    total = sum(b.num_rows for b in batches)
-    cap = target_capacity or bucket(total)
-    cols = []
-    for ci in range(len(schema)):
-        cols.append(K.concat_columns([b.columns[ci] for b in batches],
-                                     [b.num_rows for b in batches], cap))
-    return ColumnarBatch(schema, cols, total)
+    if target_capacity is None:
+        resolve_counts(batches)          # one batched readback
+        batches = [b for b in batches if b.num_rows > 0]
+        if not batches:
+            return ColumnarBatch.empty(schema)
+        if len(batches) == 1:
+            return batches[0]
+        cap = bucket(sum(b.num_rows for b in batches))
+    else:
+        cap = target_capacity
+    return _concat_fused(schema, batches, cap)
+
+
+def _concat_fused(schema: dt.Schema, batches: List[ColumnarBatch],
+                  out_cap: int) -> ColumnarBatch:
+    import jax
+    import jax.numpy as jnp
+
+    nb = len(batches)
+    caps = tuple(b.capacity for b in batches)
+    max_cap = max(caps)
+    # static padded width per var-width column (inputs may differ)
+    widths = tuple(
+        max(int(b.columns[ci].data.shape[1]) for b in batches)
+        if schema[ci].dtype.var_width else 0
+        for ci in range(len(schema)))
+    sig = ("concat", _schema_sig(schema), caps, widths, out_cap)
+
+    def build():
+        def fn(*args):
+            counts = args[:nb]
+            flats = args[nb:]
+            # rebuild per-batch column arrays
+            per_batch = []
+            i = 0
+            for _bi in range(nb):
+                cols = []
+                for f in schema:
+                    if f.dtype.var_width:
+                        cols.append((flats[i], flats[i + 1], flats[i + 2]))
+                        i += 3
+                    else:
+                        cols.append((flats[i], flats[i + 1], None))
+                        i += 2
+                per_batch.append(cols)
+            offs = []
+            total = jnp.int32(0)
+            for bi in range(nb):
+                offs.append(total)
+                total = total + counts[bi].astype(jnp.int32)
+            live = jnp.arange(out_cap) < total
+            ext = out_cap + max_cap    # updates never clamp (see below)
+            out_cols = []
+            for ci, f in enumerate(schema):
+                W = widths[ci]
+                if f.dtype.var_width:
+                    data = jnp.zeros((ext, W),
+                                     per_batch[0][ci][0].dtype)
+                    valid = jnp.zeros(ext, jnp.bool_)
+                    lens = jnp.zeros(ext, jnp.int32)
+                else:
+                    data = jnp.zeros(ext, per_batch[0][ci][0].dtype)
+                    valid = jnp.zeros(ext, jnp.bool_)
+                    lens = None
+                # forward order: batch i+1's block starts exactly at
+                # offs[i]+counts[i], overwriting batch i's padding tail;
+                # the extended operand keeps dynamic_update_slice from
+                # clamping starts (offs[bi] <= out_cap, cap_bi <= max_cap)
+                for bi in range(nb):
+                    d, v, ln = per_batch[bi][ci]
+                    if f.dtype.var_width and d.shape[1] < W:
+                        d = jnp.pad(d, ((0, 0), (0, W - d.shape[1])))
+                    if f.dtype.var_width:
+                        data = jax.lax.dynamic_update_slice(
+                            data, d, (offs[bi], jnp.int32(0)))
+                    else:
+                        data = jax.lax.dynamic_update_slice(
+                            data, d, (offs[bi],))
+                    valid = jax.lax.dynamic_update_slice(valid, v,
+                                                         (offs[bi],))
+                    if lens is not None:
+                        lens = jax.lax.dynamic_update_slice(lens, ln,
+                                                            (offs[bi],))
+                # clip to out_cap and zero the padding (batch invariant)
+                data = data[:out_cap]
+                valid = valid[:out_cap] & live
+                if f.dtype.var_width:
+                    data = jnp.where(live[:, None], data,
+                                     jnp.zeros((), data.dtype))
+                    lens = jnp.where(live, lens[:out_cap], 0)
+                    out_cols.extend([data, valid, lens])
+                else:
+                    data = jnp.where(live, data,
+                                     jnp.zeros((), data.dtype))
+                    out_cols.extend([data, valid])
+            return tuple(out_cols) + (total,)
+        return jax.jit(fn)
+
+    fn = _fused_fn(sig, build)
+    args = [_dev_count(b) for b in batches]
+    for b in batches:
+        args.extend(b.flat_arrays())
+    outs = fn(*args)
+    total_host = sum(b.num_rows_raw for b in batches) \
+        if all(isinstance(b.num_rows_raw, int) for b in batches) else outs[-1]
+    return ColumnarBatch.from_flat_arrays(schema, list(outs[:-1]), total_host)
 
 
 # ---------------------------------------------------------------------------
@@ -398,7 +575,7 @@ class FusedStage:
                            tuple(ekeys))
                     self._fn = _fused_fn(key, self._build)
             with trace_span(f"fused_{self.mode}"):
-                outs = self._fn(jnp.int32(batch.num_rows),
+                outs = self._fn(_dev_count(batch),
                                 *batch.flat_arrays())
         except _ScalarPredicate:
             self.broken = True
@@ -485,7 +662,7 @@ class TpuLocalScanExec(TpuExec):
                 first = False
             _reserve(chunk.nbytes * 2)
             batch = ColumnarBatch.from_arrow(chunk)
-            self.metrics.inc("numOutputRows", batch.num_rows)
+            self.metrics.inc("numOutputRows", batch.num_rows_raw)
             self.metrics.inc("numOutputBatches")
             yield batch
             pos = end
@@ -570,7 +747,7 @@ class TpuProjectExec(TpuExec):
                     out = ColumnarBatch(self._schema, cols, batch.num_rows)
             for n in stateful:
                 n.advance(batch.num_rows)
-            self.metrics.inc("numOutputRows", out.num_rows)
+            self.metrics.inc("numOutputRows", out.num_rows_raw)
             self.metrics.inc("numOutputBatches")
             yield out
 
@@ -603,11 +780,11 @@ class TpuFilterExec(TpuExec):
                     res = fused(batch)
                     if res is not None:
                         cols, count = res
-                        n = int(count)   # host sync, as the eager path
-                        if n == 0:
-                            continue
-                        out = ColumnarBatch(self._schema, cols, n)
-                        self.metrics.inc("numOutputRows", n)
+                        # the count stays device-resident (possibly-empty
+                        # batches flow through) so a filter never serializes
+                        # the stream on a host readback
+                        out = ColumnarBatch(self._schema, cols, count)
+                        self.metrics.inc("numOutputRows", out.num_rows_raw)
                         self.metrics.inc("numOutputBatches")
                         yield out
                         continue
@@ -622,12 +799,9 @@ class TpuFilterExec(TpuExec):
                         continue
                 keep = pred.data & pred.validity & batch.row_mask()
                 cols, count = K.compact_columns(batch.columns, keep)
-                n = int(count)   # host sync — same cadence as cuDF filter
-            if n == 0:
-                continue
-            out = ColumnarBatch(self._schema, cols, n)
-            self.metrics.inc("numOutputRows", n)
-            self.metrics.inc("numOutputBatches")
+                out = ColumnarBatch(self._schema, cols, count)
+                self.metrics.inc("numOutputRows", out.num_rows_raw)
+                self.metrics.inc("numOutputBatches")
             yield out
 
 
@@ -651,19 +825,41 @@ class TpuCoalesceBatchesExec(TpuExec):
     def _map(self, part: Partition) -> Partition:
         # accumulated batches are spillable while more stream in — raw device
         # batches must not pin a whole partition in HBM below sort/window
-        # (the reference's GpuCoalesceBatches accumulates spillable batches)
+        # (the reference's GpuCoalesceBatches accumulates spillable batches).
+        # Device-resident counts resolve in chunked batched readbacks, not
+        # one blocking sync per streamed batch.
+        from ..columnar.batch import resolve_counts
         from ..exec.spill import SpillableColumnarBatch
         pending: List[SpillableColumnarBatch] = []
         pending_rows = 0
+        chunk: List[ColumnarBatch] = []
+
+        def admit() -> None:
+            nonlocal pending_rows
+            resolve_counts(chunk)        # one round-trip per chunk
+            for b in chunk:
+                if b.num_rows == 0:
+                    continue
+                pending.append(SpillableColumnarBatch(b))
+                pending_rows += b.num_rows
+            chunk.clear()
+
         for batch in part:
-            if batch.num_rows == 0:
+            if isinstance(batch.num_rows_raw, int) and batch.num_rows_raw == 0:
                 continue
-            pending.append(SpillableColumnarBatch(batch))
-            pending_rows += batch.num_rows
-            if self.goal != "single" and pending_rows >= self.target_rows:
-                with self.metrics.timer("concatTime"):
-                    yield concat_spillable(self.schema, pending)
-                pending, pending_rows = [], 0
+            chunk.append(batch)
+            if len(chunk) >= 8:
+                admit()
+                if self.goal != "single" and pending_rows >= self.target_rows:
+                    with self.metrics.timer("concatTime"):
+                        yield concat_spillable(self.schema, pending)
+                    pending, pending_rows = [], 0
+        admit()
+        if self.goal != "single" and pending_rows >= self.target_rows and \
+                pending:
+            with self.metrics.timer("concatTime"):
+                yield concat_spillable(self.schema, pending)
+            pending, pending_rows = [], 0
         if pending:
             with self.metrics.timer("concatTime"):
                 yield concat_spillable(self.schema, pending)
@@ -793,7 +989,17 @@ class TpuHashAggregateExec(TpuExec):
         """Per-batch update-agg; pending partials merge in fan-in groups
         (the reference's hot loop, aggregate.scala:427-485, with batched
         merge cadence). All state lives in the spill catalog between
-        batches, so aggregation residency stays bounded."""
+        batches, so aggregation residency stays bounded.
+
+        The update phase is PIPELINED: each input batch's fused probe is
+        dispatched immediately (with async host copies of its stats), but
+        the kernel half only runs once the batch is ``pipelineDepth`` deep
+        in the window — by then the stat readback has landed, so the
+        per-batch device->host round-trip (hundreds of ms on a tunneled
+        device) overlaps compute instead of serializing the stream."""
+        from collections import deque
+
+        from .. import config as cfg
         from ..exec.spill import SpillableColumnarBatch
         pschema = self._partial_schema()
         pending: List[SpillableColumnarBatch] = []
@@ -814,18 +1020,65 @@ class TpuHashAggregateExec(TpuExec):
             pending.append(SpillableColumnarBatch(
                 self._merge_to_partial(merged_in)))
 
+        def land_oldest(k: int) -> None:
+            """Second half for the k oldest in-flight batches: ONE batched
+            device_get fetches their probe stats (a single host round-trip
+            instead of one blocking readback per batch), then each batch's
+            kernel dispatches. The younger half of the window keeps its
+            stats in flight, so by the time THEY land the transfers have
+            had a full window of dispatch work to hide behind."""
+            k = min(k, len(inflight))
+            stats_for = {}
+            reads = [it[2] for it in list(inflight)[:k]
+                     if it[0] == "tok" and it[2][0] in ("dense", "sortmm")]
+            if reads:
+                import jax
+                try:
+                    vals = jax.device_get([t[-1] for t in reads])
+                    for t, v in zip(reads, vals):
+                        stats_for[id(t)] = v
+                except Exception:
+                    # a dispatched probe failed at execution time: leave
+                    # stats unset — _fused_finish re-raises per batch and
+                    # its handler degrades that batch to the eager path
+                    pass
+            for _ in range(k):
+                item = inflight.popleft()
+                if item[0] == "pb":
+                    pb = item[1]
+                else:
+                    _tag, batch, tok = item
+                    pb = self._fused_finish(tok, stats_for.get(id(tok)))
+                    pb = self._shrink_partial(pb) if pb is not None and \
+                        pb.capacity > agg_k.DENSE_MAX_SLOTS else pb
+                    if pb is None:
+                        pb = self._update_partial_eager(batch)
+                pending.append(SpillableColumnarBatch(pb))
+            if len(pending) >= self.MERGE_FAN_IN:
+                merge_pending()
+
+        depth = max(1, int(cfg.TpuConf().get(cfg.AGG_PIPELINE_DEPTH)))
+        inflight: deque = deque()
         for batch in batches:
             # semaphore ordering contract: acquire only once the first input
             # batch exists (upstream host IO done), GpuSemaphore.scala:74-78
             _task_begin()
             _reserve(batch.device_size_bytes())
             with self.metrics.timer("computeAggTime"):
-                pb = batch if self.mode == "final" else \
-                    self._update_partial_batch(batch)
-                pending.append(SpillableColumnarBatch(pb))
-                if len(pending) >= self.MERGE_FAN_IN:
-                    merge_pending()
+                if self.mode == "final":
+                    inflight.append(("pb", batch))
+                else:
+                    tok = self._fused_dispatch(batch, "update")
+                    if tok is None:
+                        inflight.append(
+                            ("pb", self._update_partial_eager(batch)))
+                    else:
+                        inflight.append(("tok", batch, tok))
+                if len(inflight) >= depth:
+                    land_oldest(max(depth // 2, 1))
         with self.metrics.timer("computeAggTime"):
+            while inflight:
+                land_oldest(max(depth // 2, 1))
             merge_pending()
         if not pending:
             final_in = ColumnarBatch.empty(pschema)
@@ -835,7 +1088,7 @@ class TpuHashAggregateExec(TpuExec):
         if project:
             yield from self._final(final_in)
         else:
-            self.metrics.inc("numOutputRows", final_in.num_rows)
+            self.metrics.inc("numOutputRows", final_in.num_rows_raw)
             yield final_in
 
     # -- update (per input batch) --------------------------------------------
@@ -863,6 +1116,11 @@ class TpuHashAggregateExec(TpuExec):
         fused = self._maybe_fused_phase(batch, "update")
         if fused is not None:
             return self._shrink_partial(fused)
+        return self._update_partial_eager(batch)
+
+    def _update_partial_eager(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Eager (per-op dispatch) update aggregation — the fallback when
+        whole-stage fusion does not apply."""
         batch = self._apply_pre_filter_eager(batch)
         keys, specs = self._build_update_specs(batch)
         cap = batch.capacity
@@ -952,31 +1210,17 @@ class TpuHashAggregateExec(TpuExec):
         path — the dominant engine cost). Dispatch mirrors
         groupby_aggregate_fast: single small-span integral key -> dense MXU
         one-hot path; otherwise the traced sort+scatter path. Falls back to
-        eager permanently on any trace failure."""
-        if getattr(self, "_fusion_broken", False) or not _fusion_enabled(self):
-            return None
-        if not all(e.tree_fusable() for e in self.grouping) or any(
-                b is not None and not b.tree_fusable()
-                for b in self.bound_leaf_inputs):
-            return None
-        if self.pre_filter is not None and not self.pre_filter.tree_fusable():
-            return None
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-        from ..columnar.column import bucket as _bucket
+        eager permanently on any trace failure.
 
-        in_schema = batch.schema
-        cap = batch.capacity
-        sig = self._fusion_sig(phase, in_schema)
-        if sig is None:
+        Single-shot form (merge/final phases). The streaming update loop
+        instead calls the `_fused_dispatch` / `_fused_finish` halves
+        directly so several batches' probe round-trips stay in flight."""
+        tok = self._fused_dispatch(batch, phase)
+        if tok is None:
             return None
-        if self.pre_filter is not None:
-            fkey = _expr_cache_key(self.pre_filter)
-            if fkey is None:
-                return None
-            sig = sig + ("pre_filter", fkey)
+        return self._fused_finish(tok)
 
+    def _build_eval_fn(self, phase: str):
         def build_eval(b):
             # the folded Filter compacts INSIDE the traced program (update
             # phase only: merge/final consume already-filtered partials);
@@ -990,6 +1234,36 @@ class TpuHashAggregateExec(TpuExec):
             else:
                 keys, specs = self._merge_specs(b)
             return keys, specs, n_eff
+        return build_eval
+
+    def _fused_dispatch(self, batch: ColumnarBatch, phase: str):
+        """First half of the fused phase: dispatch the probe (or, where no
+        probe is needed, the whole kernel) without any blocking sync. The
+        streaming loop keeps a window of these in flight and fetches every
+        pending probe's stats in one batched device_get (land_oldest).
+        Returns an opaque token for `_fused_finish`, or None -> eager."""
+        if getattr(self, "_fusion_broken", False) or not _fusion_enabled(self):
+            return None
+        if not all(e.tree_fusable() for e in self.grouping) or any(
+                b is not None and not b.tree_fusable()
+                for b in self.bound_leaf_inputs):
+            return None
+        if self.pre_filter is not None and not self.pre_filter.tree_fusable():
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        in_schema = batch.schema
+        cap = batch.capacity
+        sig = self._fusion_sig(phase, in_schema)
+        if sig is None:
+            return None
+        if self.pre_filter is not None:
+            fkey = _expr_cache_key(self.pre_filter)
+            if fkey is None:
+                return None
+            sig = sig + ("pre_filter", fkey)
+        build_eval = self._build_eval_fn(phase)
         pschema = self._partial_schema()
 
         try:
@@ -1004,8 +1278,17 @@ class TpuHashAggregateExec(TpuExec):
                         return tuple(a for c in aggs for a in c.arrays())
                     return jax.jit(fn)
                 fn = _fused_fn(sig + ("reduce", cap), build_reduce)
-                outs = fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
-                return ColumnarBatch.from_flat_arrays(pschema, list(outs), 1)
+                outs = fn(_dev_count(batch), *batch.flat_arrays())
+                return ("done", ColumnarBatch.from_flat_arrays(
+                    pschema, list(outs), 1))
+
+            if phase != "update" and cap <= (1 << 15):
+                # merge inputs are concatenated partials — small. The plain
+                # fused sort+scatter program handles them in ONE dispatch
+                # with no probe and no host readback (scatter serialization
+                # only bites at scan-batch capacities)
+                return self._dispatch_plain_sort(batch, sig, in_schema, cap,
+                                                 build_eval)
 
             spec_sig = self._spec_signature(phase)
             key_dtype = (self.grouping[0].dtype
@@ -1031,135 +1314,210 @@ class TpuHashAggregateExec(TpuExec):
                                                      float_cols=float_cols)
                     return jax.jit(fn)
                 probe = _fused_fn(sig + ("probe", cap), build_probe)
-                rmin, dec = probe(jnp.int32(batch.num_rows),
+                rmin, dec = probe(_dev_count(batch),
                                   *batch.flat_arrays())
-                stats = np.asarray(dec)          # the ONE dispatch sync
-                span, absmaxes = stats[0], stats[2:]
-                f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX
-                                    for a in absmaxes))
-                if span + 2 <= agg_k.DENSE_MAX_SLOTS and f32_safe:
-                    Kb = _bucket(int(span) + 2, 128)
+                return ("dense", batch, phase, sig, in_schema, cap,
+                        rmin, dec)
 
-                    def build_dense():
-                        def fn(num_rows, rmin_d, *arrays):
-                            b = ColumnarBatch.from_flat_arrays(
-                                in_schema, arrays, num_rows)
-                            keys, specs, n_eff = build_eval(b)
-                            ok, oa, ng = agg_k.groupby_dense(
-                                keys[0], specs, n_eff, Kb, rmin_d)
-                            flat = [a for c in ok + oa for a in c.arrays()]
-                            return tuple(flat) + (ng,)
-                        return jax.jit(fn)
-                    fn = _fused_fn(sig + ("dense", cap, Kb), build_dense)
-                    outs = fn(jnp.int32(batch.num_rows), rmin,
-                              *batch.flat_arrays())
-                    return ColumnarBatch.from_flat_arrays(
-                        pschema, list(outs[:-1]), int(outs[-1]))
-                if span + 2 > agg_k.DENSE_MAX_SLOTS:
-                    self._dense_state["enabled"] = False
-
-            if _matmul_agg_enabled():
-                # staged sort path: probe (sort + segments + group-count
-                # sync) -> MXU matmul segment kernel with a static group
-                # bucket. TPU scatters serialize (the one-program scatter
-                # kernel ran ~850ms/batch on q1); matmul segment reductions
-                # at small Kb are ~10x faster (groupby_aggregate_fast's
-                # use_mm branch, fused)
-                def build_sort_probe():
-                    def fn(num_rows, *arrays):
-                        b = ColumnarBatch.from_flat_arrays(
-                            in_schema, arrays, num_rows)
-                        keys, specs, n_eff = build_eval(b)
-                        capb = b.capacity
-                        order = K.sort_indices(
-                            [K.SortKey(c) for c in keys], n_eff, capb)
-                        skeys = [K.gather_column(c, order) for c in keys]
-                        starts = K.segment_starts_from_sorted_keys(
-                            skeys, n_eff, capb)
-                        parts = [jnp.sum(starts).astype(jnp.float64)]
-                        for s in specs:
-                            if s.op in ("sum", "avg") and \
-                                    s.column is not None and \
-                                    s.column.dtype.is_floating:
-                                c = s.column
-                                a = jnp.where(
-                                    c.validity & ~jnp.isnan(c.data),
-                                    jnp.abs(c.data), 0.0)
-                                parts.append(jnp.max(a).astype(jnp.float64))
-                        return order, starts, n_eff, jnp.stack(parts)
-                    return jax.jit(fn)
-                probe = _fused_fn(sig + ("sort-probe", cap),
-                                  build_sort_probe)
-                order, starts, n_eff_dev, dec = probe(
-                    jnp.int32(batch.num_rows), *batch.flat_arrays())
-                stats = np.asarray(dec)              # the ONE sync
-                n_groups = int(stats[0])
-                f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX
-                                    for a in stats[1:]))
-                Kb = _bucket(max(n_groups, 1))
-                # per-spec mixing below: matmul where supported (count,
-                # float sum/avg), scatter-at-Kb otherwise (min/max, int sums)
-                use_mm = Kb <= agg_k.MATMUL_MAX_GROUPS and f32_safe
-
-                def build_sort_kernel(Kb=Kb, use_mm=use_mm):
-                    def fn(num_rows, order, starts, n_eff, *arrays):
-                        b = ColumnarBatch.from_flat_arrays(
-                            in_schema, arrays, num_rows)
-                        keys, specs, _n = build_eval(b)
-                        capb = b.capacity
-                        live = jnp.arange(capb) < n_eff
-                        seg_ids = K.segment_ids(starts)
-                        ng = jnp.sum(starts).astype(jnp.int32)
-                        start_perm, _cnt = K.compaction_indices(starts)
-                        kidx = start_perm[:Kb]
-                        glive = jnp.arange(Kb) < ng
-                        skeys = [K.gather_column(c, order) for c in keys]
-                        ok = [K.gather_column(c, kidx, out_valid=glive)
-                              for c in skeys]
-                        oa = []
-                        for s in specs:
-                            sc = s
-                            if s.column is not None:
-                                sc = s._replace(column=K.gather_column(
-                                    s.column, order))
-                            if use_mm and agg_k._matmul_supported(sc):
-                                agg = agg_k.segment_aggregate_matmul(
-                                    sc, seg_ids, live, Kb)
-                            else:
-                                agg = agg_k.segment_aggregate(
-                                    sc, seg_ids, live, capb,
-                                    num_segments=Kb)
-                            oa.append(agg_k._mask_to(agg, glive))
-                        flat = [a for c in ok + oa for a in c.arrays()]
-                        return tuple(flat) + (ng,)
-                    return jax.jit(fn)
-                fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm),
-                               build_sort_kernel)
-                outs = fn(jnp.int32(batch.num_rows), order, starts,
-                          n_eff_dev, *batch.flat_arrays())
-                return ColumnarBatch.from_flat_arrays(
-                    pschema, list(outs[:-1]), int(outs[-1]))
-
-            def build_sort():
-                def fn(num_rows, *arrays):
-                    b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
-                                                       num_rows)
-                    keys, specs, n_eff = build_eval(b)
-                    ok, oa, ng = agg_k.groupby_aggregate(
-                        keys, specs, n_eff, b.capacity)
-                    flat = [a for c in ok + oa for a in c.arrays()]
-                    return tuple(flat) + (ng,)
-                return jax.jit(fn)
-            fn = _fused_fn(sig + ("sort", cap), build_sort)
-            outs = fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
-            return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
-                                                  int(outs[-1]))
+            return self._dispatch_sort(batch, phase, sig, in_schema, cap)
         except Exception as e:
             import logging
             logging.getLogger("spark_rapids_tpu.fusion").warning(
                 "fused %s group-by fell back to eager: %s", phase, e)
             self._fusion_broken = True
             return None
+
+    def _dispatch_sort(self, batch: ColumnarBatch, phase: str, sig, in_schema,
+                       cap):
+        """Sort-path dispatch half. With matmul enabled: a probe computing
+        the sort order + segment starts + group count/absmax stats (the
+        finish half picks the static group bucket from them). Otherwise the
+        whole scatter kernel in one dispatch, count left device-resident."""
+        import jax
+        import jax.numpy as jnp
+        build_eval = self._build_eval_fn(phase)
+
+        if not _matmul_agg_enabled():
+            return self._dispatch_plain_sort(batch, sig, in_schema, cap,
+                                             build_eval)
+
+        # staged sort path: probe (sort + segments + group-count stats) ->
+        # MXU matmul segment kernel with a static group bucket. TPU scatters
+        # serialize (the one-program scatter kernel ran ~850ms/batch on q1);
+        # matmul segment reductions at small Kb are ~10x faster
+        def build_sort_probe():
+            def fn(num_rows, *arrays):
+                b = ColumnarBatch.from_flat_arrays(
+                    in_schema, arrays, num_rows)
+                keys, specs, n_eff = build_eval(b)
+                capb = b.capacity
+                order = K.sort_indices(
+                    [K.SortKey(c) for c in keys], n_eff, capb)
+                skeys = [K.gather_column(c, order) for c in keys]
+                starts = K.segment_starts_from_sorted_keys(
+                    skeys, n_eff, capb)
+                parts = [jnp.sum(starts).astype(jnp.float64)]
+                for s in specs:
+                    if s.op in ("sum", "avg") and \
+                            s.column is not None and \
+                            s.column.dtype.is_floating:
+                        c = s.column
+                        a = jnp.where(
+                            c.validity & ~jnp.isnan(c.data),
+                            jnp.abs(c.data), 0.0)
+                        parts.append(jnp.max(a).astype(jnp.float64))
+                return order, starts, n_eff, jnp.stack(parts)
+            return jax.jit(fn)
+        probe = _fused_fn(sig + ("sort-probe", cap), build_sort_probe)
+        order, starts, n_eff_dev, dec = probe(
+            _dev_count(batch), *batch.flat_arrays())
+        return ("sortmm", batch, phase, sig, in_schema, cap,
+                order, starts, n_eff_dev, dec)
+
+    def _dispatch_plain_sort(self, batch: ColumnarBatch, sig, in_schema, cap,
+                             build_eval):
+        """Whole sort+scatter group-by in ONE dispatch, count left
+        device-resident (no probe, no readback)."""
+        import jax
+        pschema = self._partial_schema()
+
+        def build_sort():
+            def fn(num_rows, *arrays):
+                b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
+                                                   num_rows)
+                keys, specs, n_eff = build_eval(b)
+                ok, oa, ng = agg_k.groupby_aggregate(
+                    keys, specs, n_eff, b.capacity)
+                flat = [a for c in ok + oa for a in c.arrays()]
+                return tuple(flat) + (ng,)
+            return jax.jit(fn)
+        fn = _fused_fn(sig + ("sort", cap), build_sort)
+        outs = fn(_dev_count(batch), *batch.flat_arrays())
+        pb = ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
+                                            outs[-1])
+        return ("done", pb)
+
+    def _fused_finish(self, tok,
+                      stats=None) -> Optional[ColumnarBatch]:
+        """Second half of the fused phase: read the probe stats (or take
+        them pre-read — the streaming loop fetches every in-flight batch's
+        stats in ONE batched device_get) and dispatch the kernel. Returns
+        the partial batch, or None when fusion failed (caller goes eager on
+        the retained batch)."""
+        try:
+            kind = tok[0]
+            if kind == "done":
+                return tok[1]
+            if kind == "dense":
+                pb = self._finish_dense(tok, stats)
+                if pb is not None:
+                    return pb
+                # dense didn't fit this batch: stage it through the sort
+                # path (a blocking probe for THIS batch only; once the span
+                # check disables dense, later batches dispatch sort probes
+                # up front)
+                _, batch, phase, sig, in_schema, cap, _rmin, _dec = tok
+                tok = self._dispatch_sort(batch, phase, sig, in_schema, cap)
+                return self._fused_finish(tok)
+            assert kind == "sortmm", kind
+            return self._finish_sortmm(tok, stats)
+        except Exception as e:
+            import logging
+            logging.getLogger("spark_rapids_tpu.fusion").warning(
+                "fused group-by finish fell back to eager: %s", e)
+            self._fusion_broken = True
+            return None
+
+    def _finish_dense(self, tok, stats=None) -> Optional[ColumnarBatch]:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ..columnar.column import bucket as _bucket
+        _, batch, phase, sig, in_schema, cap, rmin, dec = tok
+        build_eval = self._build_eval_fn(phase)
+        pschema = self._partial_schema()
+        if stats is None:
+            stats = np.asarray(dec)
+        span, absmaxes = stats[0], stats[2:]
+        f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX for a in absmaxes))
+        if span + 2 > agg_k.DENSE_MAX_SLOTS:
+            self._dense_state["enabled"] = False
+        if not (span + 2 <= agg_k.DENSE_MAX_SLOTS and f32_safe):
+            return None
+        Kb = _bucket(int(span) + 2, 128)
+
+        def build_dense():
+            def fn(num_rows, rmin_d, *arrays):
+                b = ColumnarBatch.from_flat_arrays(
+                    in_schema, arrays, num_rows)
+                keys, specs, n_eff = build_eval(b)
+                ok, oa, ng = agg_k.groupby_dense(
+                    keys[0], specs, n_eff, Kb, rmin_d)
+                flat = [a for c in ok + oa for a in c.arrays()]
+                return tuple(flat) + (ng,)
+            return jax.jit(fn)
+        fn = _fused_fn(sig + ("dense", cap, Kb), build_dense)
+        outs = fn(_dev_count(batch), rmin, *batch.flat_arrays())
+        return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
+                                              outs[-1])
+
+    def _finish_sortmm(self, tok, stats=None) -> ColumnarBatch:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ..columnar.column import bucket as _bucket
+        (_, batch, phase, sig, in_schema, cap,
+         order, starts, n_eff_dev, dec) = tok
+        build_eval = self._build_eval_fn(phase)
+        pschema = self._partial_schema()
+        if stats is None:
+            stats = np.asarray(dec)
+        n_groups = int(stats[0])
+        f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX for a in stats[1:]))
+        Kb = _bucket(max(n_groups, 1))
+        # per-spec mixing below: matmul where supported (count, float
+        # sum/avg), scatter-at-Kb otherwise (min/max, int sums)
+        use_mm = Kb <= agg_k.MATMUL_MAX_GROUPS and f32_safe
+
+        def build_sort_kernel(Kb=Kb, use_mm=use_mm):
+            def fn(num_rows, order, starts, n_eff, *arrays):
+                b = ColumnarBatch.from_flat_arrays(
+                    in_schema, arrays, num_rows)
+                keys, specs, _n = build_eval(b)
+                capb = b.capacity
+                live = jnp.arange(capb) < n_eff
+                seg_ids = K.segment_ids(starts)
+                ng = jnp.sum(starts).astype(jnp.int32)
+                start_perm, _cnt = K.compaction_indices(starts)
+                kidx = start_perm[:Kb]
+                glive = jnp.arange(Kb) < ng
+                skeys = [K.gather_column(c, order) for c in keys]
+                ok = [K.gather_column(c, kidx, out_valid=glive)
+                      for c in skeys]
+                oa = []
+                for s in specs:
+                    sc = s
+                    if s.column is not None:
+                        sc = s._replace(column=K.gather_column(
+                            s.column, order))
+                    if use_mm and agg_k._matmul_supported(sc):
+                        agg = agg_k.segment_aggregate_matmul(
+                            sc, seg_ids, live, Kb)
+                    else:
+                        agg = agg_k.segment_aggregate(
+                            sc, seg_ids, live, capb,
+                            num_segments=Kb)
+                    oa.append(agg_k._mask_to(agg, glive))
+                flat = [a for c in ok + oa for a in c.arrays()]
+                return tuple(flat) + (ng,)
+            return jax.jit(fn)
+        fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm),
+                       build_sort_kernel)
+        outs = fn(_dev_count(batch), order, starts,
+                  n_eff_dev, *batch.flat_arrays())
+        # group count came back with the probe stats — no second readback
+        return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
+                                              n_groups)
 
     # -- final (merge partials) ---------------------------------------------
     def _merge_ops(self, leaf: lp.AggregateExpression):
@@ -1186,6 +1544,10 @@ class TpuHashAggregateExec(TpuExec):
         per group (the merge half of the CudfAggregate update/merge pairs)."""
         fused = self._maybe_fused_phase(batch, "merge")
         if fused is not None:
+            # already-small outputs keep their device-resident count — a
+            # shrink would force a blocking readback per merge cycle
+            if fused.capacity <= agg_k.DENSE_MAX_SLOTS:
+                return fused
             return self._shrink_partial(fused)
         keys, specs = self._merge_specs(batch)
         if not keys:
@@ -1202,7 +1564,7 @@ class TpuHashAggregateExec(TpuExec):
         with self.metrics.timer("computeAggTime"):
             fused = self._maybe_fused_final(batch)
             if fused is not None:
-                self.metrics.inc("numOutputRows", fused.num_rows)
+                self.metrics.inc("numOutputRows", fused.num_rows_raw)
                 yield fused
                 return
             keys, specs = self._merge_specs(batch)
@@ -1217,7 +1579,7 @@ class TpuHashAggregateExec(TpuExec):
                     allow_matmul=_matmul_agg_enabled(),
                     dense_state=self._dense_state)
         out = self._project_results(out_keys, aggs, n_groups)
-        self.metrics.inc("numOutputRows", out.num_rows)
+        self.metrics.inc("numOutputRows", out.num_rows_raw)
         yield out
 
     def _maybe_fused_final(self, batch: ColumnarBatch
@@ -1258,9 +1620,9 @@ class TpuHashAggregateExec(TpuExec):
 
         try:
             fn = _fused_fn(sig + ("final", tuple(rkeys), cap), build)
-            outs = fn(jnp.int32(batch.num_rows), *batch.flat_arrays())
+            outs = fn(_dev_count(batch), *batch.flat_arrays())
             return ColumnarBatch.from_flat_arrays(
-                self._out_schema, list(outs[:-1]), int(outs[-1]))
+                self._out_schema, list(outs[:-1]), outs[-1])
         except Exception as e:
             import logging
             logging.getLogger("spark_rapids_tpu.fusion").warning(
@@ -1376,7 +1738,7 @@ class TpuSortExec(TpuExec):
                     for o in self.orders]
             idx = K.sort_indices(keys, batch.num_rows, batch.capacity)
             cols = [K.gather_column(c, idx) for c in batch.columns]
-        self.metrics.inc("numOutputRows", batch.num_rows)
+        self.metrics.inc("numOutputRows", batch.num_rows_raw)
         yield ColumnarBatch(self.schema, cols, batch.num_rows)
 
 
@@ -1477,7 +1839,7 @@ class TpuExpandExec(TpuExec):
             for proj in self.projections:
                 cols = [ex.materialize(e.eval(batch), batch) for e in proj]
                 out = ColumnarBatch(self._schema, cols, batch.num_rows)
-                self.metrics.inc("numOutputRows", out.num_rows)
+                self.metrics.inc("numOutputRows", out.num_rows_raw)
                 yield out
 
 
