@@ -6,10 +6,26 @@ over padded Arrow-layout device buffers -> mesh/ICI shuffle. See SURVEY.md (refe
 blueprint) and DESIGN.md (TPU-first decisions).
 """
 
+import os
+
 import jax
 
 # Spark SQL semantics require 64-bit longs/doubles; jax defaults to 32-bit.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: fused-stage programs (sort-based
+# group-bys especially) can take minutes to compile, and every fresh
+# process would otherwise pay that again. Opt out / relocate with
+# SPARK_RAPIDS_TPU_COMPILE_CACHE=off|<dir>.
+_cache_dir = os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE", "")
+if _cache_dir.lower() != "off":
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _cache_dir or os.path.expanduser("~/.cache/spark_rapids_tpu/xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:                     # older jax without the knob
+        pass
 
 __version__ = "0.1.0"
 

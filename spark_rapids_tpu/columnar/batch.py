@@ -122,21 +122,50 @@ class ColumnarBatch:
         program (per-array transfer overhead would otherwise dominate scan
         streams on high-latency links — the bounce-buffer idea from the
         reference's shuffle, applied at the scan boundary)."""
+        return ColumnarBatch.upload_prepped(
+            ColumnarBatch.prep_from_arrow(table, capacity))
+
+    @staticmethod
+    def prep_from_arrow(table, capacity: Optional[int] = None):
+        """Host-only half of ``from_arrow``: arrow -> padded numpy arrays,
+        NO device work — safe to run on a prefetch thread before the task
+        holds the semaphore or has reserved memory. Feed the result to
+        ``upload_prepped`` (on the task thread, after admission)."""
         n = table.num_rows
         cap = capacity or bucket(n)
-        fields = [dt.Field(table.schema.names[i], dt.from_arrow(table.schema.types[i]))
+        fields = [dt.Field(table.schema.names[i],
+                           dt.from_arrow(table.schema.types[i]))
                   for i in range(table.num_columns)]
-        hosts = []
-        if n:
-            for i in range(table.num_columns):
-                hosts.append(Column.host_from_arrow(table.column(i),
-                                                    capacity=cap))
-        if n == 0 or any(h is None for h in hosts):
+        schema = dt.Schema(fields)
+        # ARRAY<...> columns need the python-list path (device-building):
+        # decide from the schema BEFORE converting anything twice
+        if n == 0 or any(dt.is_array(f.dtype) for f in fields):
+            return ("fallback", schema, table, cap, n)
+        hosts = [Column.host_from_arrow(table.column(i), capacity=cap)
+                 for i in range(table.num_columns)]
+        nbytes = sum(a.nbytes for _d, arrs in hosts for a in arrs)
+        return ("packed", schema, hosts, cap, n, nbytes)
+
+    @staticmethod
+    def upload_prepped(prep) -> "ColumnarBatch":
+        """Device half of ``from_arrow``: one packed staging upload + one
+        cached unpack program (or the per-column fallback path)."""
+        if prep[0] == "fallback":
+            _tag, schema, table, cap, n = prep
             cols = [Column.from_arrow(table.column(i), capacity=cap)
                     for i in range(table.num_columns)]
-            return ColumnarBatch(dt.Schema(fields), cols, n)
-        cols = _upload_packed(hosts)
-        return ColumnarBatch(dt.Schema(fields), cols, n)
+            return ColumnarBatch(schema, cols, n)
+        _tag, schema, hosts, _cap, n, _nbytes = prep
+        return ColumnarBatch(schema, _upload_packed(hosts), n)
+
+    @staticmethod
+    def prepped_size_bytes(prep) -> int:
+        """Approximate device bytes ``upload_prepped`` will allocate (for
+        admission before the upload)."""
+        if prep[0] == "packed":
+            return prep[5]
+        table = prep[2]
+        return int(getattr(table, "nbytes", 0)) * 2
 
     @staticmethod
     def empty(schema: dt.Schema, capacity: int = 128) -> "ColumnarBatch":

@@ -28,6 +28,57 @@ def _release_semaphore() -> None:
     TpuSemaphore.get().release_if_necessary()
 
 
+def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
+                 depth: int = 2) -> Iterable[T]:
+    """Map ``fn`` over ``items`` on a background thread, keeping up to
+    ``depth`` results ready ahead of the consumer — overlaps host-side
+    work (arrow decode/conversion) with downstream device compute, the
+    role of the reference's background fetch threads
+    (MultiFileCloudParquetPartitionReader, GpuParquetScan.scala:1145)."""
+    import queue
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    sentinel = object()
+    stop = threading.Event()
+    err: List[BaseException] = []
+
+    def worker() -> None:
+        try:
+            for it in items:
+                res = fn(it)
+                while not stop.is_set():
+                    try:
+                        q.put(res, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:          # re-raised on the consumer side
+            err.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="spark-rapids-tpu-prefetch")
+    t.start()
+    try:
+        while True:
+            v = q.get()
+            if v is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield v
+    finally:
+        stop.set()                          # unblock the worker on early exit
+
+
 def run_partition_tasks(parts: Sequence[Any],
                         fn: Callable[[int, Any], T],
                         max_workers: int = 0) -> List[T]:

@@ -112,10 +112,22 @@ class Metrics(dict):
             try:
                 vals = jax.device_get([a for _k, a in pend])
             except Exception:
-                vals = [0.0] * len(pend)
+                # one bad scalar must not zero the whole flush: fall back
+                # to per-value reads, dropping only the failed ones
+                vals = []
+                for _k, a in pend:
+                    try:
+                        vals.append(jax.device_get(a))
+                    except Exception:
+                        vals.append(None)
             with Metrics._lock:
                 for (key, _a), v in zip(pend, vals):
-                    self[key] = dict.get(self, key, 0) + float(v)
+                    if v is None:
+                        continue
+                    v = v.item() if hasattr(v, "item") else v
+                    if isinstance(v, float) and v.is_integer():
+                        v = int(v)     # row/batch counters stay integral
+                    self[key] = dict.get(self, key, 0) + v
         return self
 
     # readers see resolved counters (deferred amounts fold in lazily)
@@ -194,14 +206,11 @@ class TpuExec:
         accumulated results are spillable so N in-flight partitions cannot
         pin the whole dataset in HBM. Query-scoped state (broadcast builds,
         unread shuffle slices) is released afterwards."""
-        from ..exec.spill import SpillableColumnarBatch
         from ..exec.tasks import run_partition_tasks
 
-        def drain(pid, part):
-            return [SpillableColumnarBatch(b) for b in part if b.num_rows > 0]
-
         try:
-            per_part = run_partition_tasks(self.execute(), drain)
+            per_part = run_partition_tasks(
+                self.execute(), lambda pid, part: drain_spillable(part))
             return concat_spillable(
                 self.schema, [s for lst in per_part for s in lst])
         finally:
@@ -271,34 +280,48 @@ def _reserve(nbytes: int) -> None:
     BufferCatalog.get().reserve(nbytes)
 
 
+def drain_spillable(part, acquire: bool = False
+                    ) -> List["SpillableColumnarBatch"]:
+    """Drain one partition into spillable handles, resolving device-resident
+    row counts in chunked batched readbacks (one host round-trip per 8
+    batches, not one per batch) and dropping empties. ``acquire=True``
+    takes the task semaphore once the first batch exists (the reference's
+    acquire-after-host-IO ordering, GpuSemaphore.scala:74-78)."""
+    from ..columnar.batch import resolve_counts
+    from ..exec.spill import SpillableColumnarBatch
+    out: List[SpillableColumnarBatch] = []
+    chunk: List[ColumnarBatch] = []
+
+    def flush():
+        resolve_counts(chunk)          # one round-trip per chunk
+        out.extend(SpillableColumnarBatch(b) for b in chunk
+                   if b.num_rows > 0)
+        chunk.clear()
+
+    first = True
+    for b in part:
+        if first and acquire:
+            _task_begin()
+            first = False
+        if isinstance(b.num_rows_raw, int) and b.num_rows_raw == 0:
+            continue
+        chunk.append(b)
+        if len(chunk) >= 8:
+            flush()
+    flush()
+    return out
+
+
 def accumulate_spillable(parts) -> List["SpillableColumnarBatch"]:
     """Drain partitions into spillable handles: accumulated build/sort inputs
     must not pin HBM while more batches stream in (SpillableColumnarBatch
     treatment of build sides, GpuShuffledHashJoinExec / GpuSortExec).
     Partitions drain concurrently as tasks."""
-    from ..exec.spill import SpillableColumnarBatch
     from ..exec.tasks import run_partition_tasks
 
-    def drain(pid, p):
-        from ..columnar.batch import resolve_counts
-        out: List[SpillableColumnarBatch] = []
-        chunk: List[ColumnarBatch] = []
-
-        def flush():
-            resolve_counts(chunk)      # one round-trip per chunk, not per batch
-            out.extend(SpillableColumnarBatch(b) for b in chunk
-                       if b.num_rows > 0)
-            chunk.clear()
-
-        for b in p:
-            chunk.append(b)
-            if len(chunk) >= 8:
-                flush()
-        flush()
-        return out
-
     parts = list(parts)
-    return [s for lst in run_partition_tasks(parts, drain) for s in lst]
+    per_part = run_partition_tasks(parts, lambda pid, p: drain_spillable(p))
+    return [s for lst in per_part for s in lst]
 
 
 def concat_spillable(schema: dt.Schema,
@@ -323,9 +346,9 @@ def concat_batches(schema: dt.Schema, batches: List[ColumnarBatch],
     The fused program takes every batch's arrays + row counts (device
     scalars welcome) and emits the packed output columns."""
     from ..columnar.batch import resolve_counts
-    known_zero = [b for b in batches
-                  if isinstance(b.num_rows_raw, int) and b.num_rows_raw == 0]
-    batches = [b for b in batches if b not in known_zero]
+    batches = [b for b in batches
+               if not (isinstance(b.num_rows_raw, int)
+                       and b.num_rows_raw == 0)]
     if not batches:
         return ColumnarBatch.empty(schema)
     if len(batches) == 1 and target_capacity is None:
@@ -650,24 +673,30 @@ class TpuLocalScanExec(TpuExec):
         return parts
 
     def _part_iter(self, lo: int, hi: int) -> Partition:
-        pos = lo
+        from ..exec.tasks import prefetch_map
+
+        def chunks():
+            pos = lo
+            while pos < hi:
+                end = min(pos + self.batch_rows, hi)
+                yield self.table.slice(pos, end - pos)
+                pos = end
+
+        # HOST-side arrow->numpy conversion runs one batch ahead on a
+        # background thread; the device upload stays on the task thread
+        # BEHIND semaphore acquisition and memory admission, preserving the
+        # ordering contract (GpuSemaphore.scala:74: acquire after host IO,
+        # before device work)
         first = True
-        while pos < hi:
-            end = min(pos + self.batch_rows, hi)
-            chunk = self.table.slice(pos, end - pos)
+        for prep in prefetch_map(chunks(), ColumnarBatch.prep_from_arrow):
             if first:
-                # semaphore ordering contract: acquire after host-side input
-                # is ready, before the device upload (GpuSemaphore.scala:74)
                 _task_begin()
                 first = False
-            _reserve(chunk.nbytes * 2)
-            batch = ColumnarBatch.from_arrow(chunk)
+            _reserve(ColumnarBatch.prepped_size_bytes(prep))
+            batch = ColumnarBatch.upload_prepped(prep)
             self.metrics.inc("numOutputRows", batch.num_rows_raw)
             self.metrics.inc("numOutputBatches")
             yield batch
-            pos = end
-        if lo >= hi and lo == 0:
-            return
 
 
 class TpuRangeExec(TpuExec):
@@ -826,8 +855,10 @@ class TpuCoalesceBatchesExec(TpuExec):
         # accumulated batches are spillable while more stream in — raw device
         # batches must not pin a whole partition in HBM below sort/window
         # (the reference's GpuCoalesceBatches accumulates spillable batches).
-        # Device-resident counts resolve in chunked batched readbacks, not
-        # one blocking sync per streamed batch.
+        # Device-resident counts resolve in chunked batched readbacks (one
+        # host round-trip per 8 batches), and coalesced outputs still yield
+        # INCREMENTALLY so downstream consumes while upstream streams; the
+        # target-size check runs at chunk granularity.
         from ..columnar.batch import resolve_counts
         from ..exec.spill import SpillableColumnarBatch
         pending: List[SpillableColumnarBatch] = []
@@ -838,10 +869,9 @@ class TpuCoalesceBatchesExec(TpuExec):
             nonlocal pending_rows
             resolve_counts(chunk)        # one round-trip per chunk
             for b in chunk:
-                if b.num_rows == 0:
-                    continue
-                pending.append(SpillableColumnarBatch(b))
-                pending_rows += b.num_rows
+                if b.num_rows > 0:
+                    pending.append(SpillableColumnarBatch(b))
+                    pending_rows += b.num_rows
             chunk.clear()
 
         for batch in part:
@@ -855,11 +885,6 @@ class TpuCoalesceBatchesExec(TpuExec):
                         yield concat_spillable(self.schema, pending)
                     pending, pending_rows = [], 0
         admit()
-        if self.goal != "single" and pending_rows >= self.target_rows and \
-                pending:
-            with self.metrics.timer("concatTime"):
-                yield concat_spillable(self.schema, pending)
-            pending, pending_rows = [], 0
         if pending:
             with self.metrics.timer("concatTime"):
                 yield concat_spillable(self.schema, pending)
@@ -1723,12 +1748,7 @@ class TpuSortExec(TpuExec):
         return [self._sort(p) for p in self.children[0].execute()]
 
     def _sort(self, part: Partition) -> Partition:
-        from ..exec.spill import SpillableColumnarBatch
-        spillables = []
-        for b in part:
-            if b.num_rows:
-                _task_begin()        # after first host-side input is ready
-                spillables.append(SpillableColumnarBatch(b))
+        spillables = drain_spillable(part, acquire=True)
         if not spillables:
             return
         batch = concat_spillable(self.schema, spillables)
