@@ -385,9 +385,32 @@ def size(c) -> Col:
 
 
 def get_item(c, index) -> Col:
-    from ..ops import arrays as ar_ops
-    idx = _unwrap(index) if isinstance(index, Col) else ex.Literal(int(index), dt.INT32)
-    return Col(ar_ops.GetArrayItem(_unwrap(c), idx))
+    from ..ops import maps as mp_ops
+    key = _unwrap(index) if isinstance(index, Col) else ex.Literal(index)
+    return Col(mp_ops.GetItem(_unwrap(c), key))
+
+
+def element_at(c, key) -> Col:
+    """element_at(map, key) / element_at(array, 1-based index)."""
+    from ..ops import maps as mp_ops
+    k = _unwrap(key) if isinstance(key, Col) else ex.Literal(key)
+    return Col(mp_ops.GetItem(_unwrap(c), k, one_based=True))
+
+
+def create_map(*cols) -> Col:
+    """map(k1, v1, k2, v2, ...) — complexTypeCreator.scala CreateMap."""
+    from ..ops import maps as mp_ops
+    return Col(mp_ops.CreateMap(*[_unwrap(c) for c in cols]))
+
+
+def map_keys(c) -> Col:
+    from ..ops import maps as mp_ops
+    return Col(mp_ops.MapKeys(_unwrap(c)))
+
+
+def map_values(c) -> Col:
+    from ..ops import maps as mp_ops
+    return Col(mp_ops.MapValues(_unwrap(c)))
 
 
 # -- python UDFs (§2.9: GpuArrowEvalPythonExec + udf-compiler analogs) -------

@@ -100,7 +100,7 @@ class TpuSession:
         elif isinstance(data, pa.Table):
             table = data
         elif isinstance(data, dict):
-            table = pa.table(data)
+            table = self._table_from_pydict(data)
         else:
             # rows: list of tuples/dicts (+ schema names)
             if schema is not None and isinstance(schema, (list, tuple)):
@@ -119,6 +119,34 @@ class TpuSession:
             fields = [pa.field(f.name, dt.to_arrow(f.dtype)) for f in schema]
             table = table.cast(pa.schema(fields))
         return DataFrame(lp.LocalScan(table), self)
+
+    @staticmethod
+    def _table_from_pydict(data):
+        """pa.table() with MAP columns handled: pyarrow infers python
+        dicts as structs (and rejects non-string keys), so columns holding
+        dicts get an explicit arrow map type from the inferred SQL type."""
+        import pyarrow as pa
+        from ..columnar.batch import _infer_dtype
+        if not any(isinstance(values, list) and
+                   any(isinstance(v, dict) for v in values)
+                   for values in data.values()):
+            return pa.table(data)       # no map columns: the fast path
+        cols, fields = [], []
+        for name, values in data.items():
+            vals = list(values) if not hasattr(values, "dtype") else values
+            has_dict = isinstance(vals, list) and any(
+                isinstance(v, dict) for v in vals)
+            if has_dict:
+                t = dt.to_arrow(_infer_dtype(vals))
+                cols.append(pa.array(
+                    [None if v is None else list(v.items()) for v in vals],
+                    type=t))
+                fields.append(pa.field(name, t))
+            else:
+                arr = pa.array(vals)
+                cols.append(arr)
+                fields.append(pa.field(name, arr.type))
+        return pa.Table.from_arrays(cols, schema=pa.schema(fields))
 
     def range(self, start: int, end: Optional[int] = None, step: int = 1,
               numPartitions: int = 1) -> DataFrame:

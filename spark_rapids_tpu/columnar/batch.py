@@ -139,7 +139,8 @@ class ColumnarBatch:
         schema = dt.Schema(fields)
         # ARRAY<...> columns need the python-list path (device-building):
         # decide from the schema BEFORE converting anything twice
-        if n == 0 or any(dt.is_array(f.dtype) for f in fields):
+        if n == 0 or any(dt.is_array(f.dtype) or dt.is_map(f.dtype)
+                         for f in fields):
             return ("fallback", schema, table, cap, n)
         hosts = [Column.host_from_arrow(table.column(i), capacity=cap)
                  for i in range(table.num_columns)]
@@ -334,4 +335,16 @@ def _infer_dtype(values: Sequence[Any]) -> dt.DType:
             return dt.FLOAT64
         if isinstance(v, (str, bytes)):
             return dt.STRING
+        if isinstance(v, dict):
+            # prefer a non-empty dict for key/value inference; a column of
+            # only empty maps defaults to map<bigint,bigint>
+            src = next((d for d in values
+                        if isinstance(d, dict) and d), None)
+            if src is None:
+                return dt.MAP(dt.INT64, dt.INT64)
+            k0 = next(iter(src.keys()))
+            v0 = next((x for x in src.values() if x is not None), 0)
+            return dt.MAP(_infer_dtype([k0]), _infer_dtype([v0]))
+        if isinstance(v, (list, tuple)) and v:
+            return dt.ARRAY(_infer_dtype([v[0]]))
     return dt.STRING

@@ -25,6 +25,21 @@ MIN_CAPACITY = 128
 MIN_STRING_WIDTH = 8
 
 
+def _bits_from_values(vals, dtype: dt.DType) -> np.ndarray:
+    """Logical values -> int64 bitpatterns for the MAP layout: integral /
+    bool / date / timestamp store the int64 VALUE; floats store the
+    float64 bitpattern (f32 widens exactly)."""
+    if dtype.is_floating:
+        return np.asarray(vals, np.float64).view(np.int64)
+    return np.asarray([int(v) for v in vals], np.int64)
+
+
+def _values_from_bits(bits: np.ndarray, dtype: dt.DType) -> np.ndarray:
+    if dtype.is_floating:
+        return bits.view(np.float64).astype(dtype.numpy_dtype)
+    return bits.astype(dtype.numpy_dtype)
+
+
 def bucket(n: int, minimum: int = MIN_CAPACITY) -> int:
     """Smallest power of two >= max(n, minimum). Bounds XLA recompiles per DESIGN.md §1."""
     n = max(int(n), minimum)
@@ -128,6 +143,35 @@ class Column:
                     width: Optional[int] = None) -> "Column":
         n = len(values)
         valid_np = np.array([v is not None for v in values], dtype=np.bool_)
+        if dt.is_map(dtype):
+            # MAP<K,V>: int64[cap, 3W] INTERLEAVED bitpattern matrix
+            # ([k, v, value-valid] per entry lane — pad-safe, see
+            # dtypes.MAP) + entry counts; duplicate keys keep the LAST
+            # entry (spark.sql.mapKeyDedupPolicy=LAST_WIN)
+            dicts = [dict(v) if v is not None else None for v in values]
+            max_len = max((len(d) for d in dicts if d is not None), default=0)
+            w = width or bucket(max_len, 4)
+            cap = capacity or bucket(n)
+            mat = np.zeros((cap, 3 * w), dtype=np.int64)
+            lens = np.zeros(cap, dtype=np.int32)
+            for i, d in enumerate(dicts):
+                if d is None:
+                    continue
+                ks = list(d.keys())
+                vs = list(d.values())
+                ln = len(ks)
+                vv = np.array([v is not None for v in vs], np.bool_)
+                mat[i, 0:3 * ln:3] = _bits_from_values(ks, dtype.key)
+                mat[i, 1:3 * ln + 1:3] = np.where(
+                    vv, _bits_from_values(
+                        [v if v is not None else 0 for v in vs],
+                        dtype.element), 0)
+                mat[i, 2:3 * ln + 2:3] = vv.astype(np.int64)
+                lens[i] = ln
+            valid_full = np.zeros(cap, np.bool_)
+            valid_full[:n] = valid_np
+            return Column(dtype, jnp.asarray(mat), jnp.asarray(valid_full),
+                          jnp.asarray(lens))
         if dt.is_array(dtype):
             # ARRAY<primitive>: padded element matrix + per-row lengths
             # (NULL elements inside arrays are out of scope; see ops/arrays)
@@ -174,12 +218,16 @@ class Column:
                    width: Optional[int] = None) -> "Column":
         """Build a device column from a pyarrow Array/ChunkedArray (host boundary)."""
         host = Column.host_from_arrow(arr, capacity, width)
-        if host is None:                      # ARRAY<...>: python-list path
+        if host is None:            # ARRAY/MAP<...>: python-object path
             import pyarrow as pa
             if isinstance(arr, pa.ChunkedArray):
                 arr = arr.combine_chunks()
             dtype = dt.from_arrow(arr.type)
-            return Column.from_pylist(arr.to_pylist(), dtype, capacity, width)
+            vals = arr.to_pylist()
+            if dt.is_map(dtype):
+                # pyarrow maps materialize as lists of (k, v) tuples
+                vals = [dict(v) if v is not None else None for v in vals]
+            return Column.from_pylist(vals, dtype, capacity, width)
         dtype, arrays = host
         return Column(dtype, *[jnp.asarray(a) for a in arrays])
 
@@ -230,7 +278,7 @@ class Column:
             valid_full = np.zeros(cap, np.bool_)
             valid_full[:n] = valid
             return (dt.STRING, [mat, valid_full, lens_full])
-        if dt.is_array(dtype):
+        if dt.is_array(dtype) or dt.is_map(dtype):
             return None
         np_valid = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 else \
             np.asarray(arr.is_valid())
@@ -286,6 +334,26 @@ class Column:
 
     def to_pylist(self, num_rows: int) -> List[Any]:
         valid = np.asarray(self.validity[:num_rows])
+        if dt.is_map(self.dtype):
+            mat = np.asarray(self.data[:num_rows])
+            lens = np.asarray(self.lengths[:num_rows])
+            kt, vt = self.dtype.key, self.dtype.element
+            kconv = (float if kt.is_floating else
+                     bool if kt == dt.BOOL else int)
+            vconv = (float if vt.is_floating else
+                     bool if vt == dt.BOOL else int)
+            out: List[Any] = []
+            for i in range(num_rows):
+                if not valid[i]:
+                    out.append(None)
+                    continue
+                ln = int(lens[i])
+                ks = _values_from_bits(mat[i, 0:3 * ln:3], kt)
+                vs = _values_from_bits(mat[i, 1:3 * ln + 1:3], vt)
+                vv = mat[i, 2:3 * ln + 2:3] != 0
+                out.append({kconv(k): (vconv(v) if ok else None)
+                            for k, v, ok in zip(ks, vs, vv)})
+            return out
         if dt.is_array(self.dtype):
             mat = np.asarray(self.data[:num_rows])
             lens = np.asarray(self.lengths[:num_rows])
@@ -314,7 +382,8 @@ class Column:
     def to_arrow(self, num_rows: int):
         import pyarrow as pa
         valid = np.asarray(self.validity[:num_rows])
-        if self.dtype == dt.STRING or dt.is_array(self.dtype):
+        if self.dtype == dt.STRING or dt.is_array(self.dtype) or \
+                dt.is_map(self.dtype):
             return pa.array(self.to_pylist(num_rows),
                             type=dt.to_arrow(self.dtype))
         data = np.asarray(self.data[:num_rows])

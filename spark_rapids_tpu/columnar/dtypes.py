@@ -26,7 +26,8 @@ class DType:
     is_floating: bool = False
     byte_width: int = 0               # fixed-width storage bytes (0 for string)
     var_width: bool = False           # 2-D padded data + lengths (string/array)
-    element: Optional["DType"] = None  # ARRAY element type
+    element: Optional["DType"] = None  # ARRAY element type / MAP value type
+    key: Optional["DType"] = None      # MAP key type
 
     def __repr__(self) -> str:
         return self.name
@@ -80,9 +81,43 @@ def ARRAY(element: DType) -> DType:
 ARRAY_STRING = DType("array<string>", None, var_width=True, element=STRING)
 _BY_NAME[ARRAY_STRING.name] = ARRAY_STRING
 
+_MAP_CACHE: dict = {}
+
+
+def MAP(key: DType, value: DType) -> DType:
+    """MAP<key, value> of fixed-width primitives. Physical layout (DESIGN
+    stance: keep every transport/spill path ignorant of maps): ONE
+    ``int64[cap, 3W]`` matrix of INTERLEAVED per-entry lanes —
+    ``[k0, v0, ok0, k1, v1, ok1, ...]`` (key bitpattern, value bitpattern,
+    value-validity flag) — plus per-row entry counts in ``lengths``.
+    Interleaving makes the layout safe under the var-width width
+    harmonization every concat/join/conditional path performs: right-
+    padding appends whole empty lanes, which the entry count already
+    masks. Map ops bitcast the strided planes back to the logical dtypes
+    (complexTypeExtractors.scala's GetMapValue scope, TPU-first layout).
+    String keys/values take the CPU path."""
+    name = f"map<{key.name},{value.name}>"
+    t = _MAP_CACHE.get(name)
+    if t is None:
+        if key.var_width or value.var_width or key.numpy_dtype is None or \
+                value.numpy_dtype is None:
+            # string/nested keys or values: CPU-engine-only dtype (the
+            # planner's type gate tags it off the device, like ARRAY_STRING)
+            t = DType(name, None, var_width=True, element=value, key=key)
+        else:
+            t = DType(name, np.dtype(np.int64), var_width=True,
+                      element=value, key=key)
+        _MAP_CACHE[name] = t
+        _BY_NAME[name] = t
+    return t
+
 
 def is_array(t: DType) -> bool:
-    return t.element is not None
+    return t.element is not None and t.key is None
+
+
+def is_map(t: DType) -> bool:
+    return t.key is not None
 
 
 def of(name_or_dtype: Any) -> DType:
@@ -91,6 +126,21 @@ def of(name_or_dtype: Any) -> DType:
         return name_or_dtype
     if isinstance(name_or_dtype, str):
         t = _BY_NAME.get(name_or_dtype) or _ALIASES.get(name_or_dtype)
+        if t is None and name_or_dtype.startswith("array<") and \
+                name_or_dtype.endswith(">"):
+            return ARRAY(of(name_or_dtype[6:-1]))
+        if t is None and name_or_dtype.startswith("map<") and \
+                name_or_dtype.endswith(">"):
+            inner = name_or_dtype[4:-1]
+            depth = 0
+            for i, ch in enumerate(inner):
+                if ch == "<":
+                    depth += 1
+                elif ch == ">":
+                    depth -= 1
+                elif ch == "," and depth == 0:
+                    return MAP(of(inner[:i].strip()),
+                               of(inner[i + 1:].strip()))
         if t is None:
             raise ValueError(f"unknown SQL type name {name_or_dtype!r}")
         return t
@@ -123,6 +173,9 @@ def from_arrow(arrow_type) -> DType:
     if pa.types.is_timestamp(arrow_type): return TIMESTAMP
     if pa.types.is_list(arrow_type) or pa.types.is_large_list(arrow_type):
         return ARRAY(from_arrow(arrow_type.value_type))
+    if pa.types.is_map(arrow_type):
+        return MAP(from_arrow(arrow_type.key_type),
+                   from_arrow(arrow_type.item_type))
     raise ValueError(f"unsupported arrow type {arrow_type}")
 
 
@@ -133,6 +186,8 @@ def to_arrow(t: DType):
         INT64: pa.int64(), FLOAT32: pa.float32(), FLOAT64: pa.float64(),
         STRING: pa.string(), DATE: pa.date32(), TIMESTAMP: pa.timestamp("us"),
     }
+    if is_map(t):
+        return pa.map_(to_arrow(t.key), to_arrow(t.element))
     if is_array(t):
         return pa.list_(to_arrow(t.element))
     return mapping[t]

@@ -96,11 +96,68 @@ class CpuEvaluator:
             idxs = self._eval(e.children[1])
             out = []
             for a, i in zip(arrs, idxs):
-                if a is None or i is None or not (0 <= int(i) < len(a)):
+                if a is None or i is None:
                     out.append(None)
-                else:
-                    out.append(a[int(i)])
+                    continue
+                i = int(i)
+                if getattr(e, "one_based", False):
+                    if i == 0:
+                        out.append(None)
+                        continue
+                    i = i - 1 if i > 0 else len(a) + i
+                out.append(a[i] if 0 <= i < len(a) else None)
             return out
+        from ..ops import maps as mp_ops
+
+        def _as_map(o):
+            # pandas materializes arrow map cells as lists of (k, v)
+            # tuples; dict() also applies LAST_WIN dedup like the device
+            return o if o is None or isinstance(o, dict) else dict(o)
+
+        if isinstance(e, mp_ops.CreateMap):
+            cols = [self._eval(c) for c in e.children]
+            out = []
+            for row in zip(*cols):
+                ks, vs = row[0::2], row[1::2]
+                # NULL key -> NULL map; duplicate keys: LAST_WIN
+                out.append(None if any(k is None for k in ks)
+                           else dict(zip(ks, vs)))
+            return out
+        if isinstance(e, mp_ops.GetMapValue):
+            ms = [_as_map(m) for m in self._eval(e.children[0])]
+            ks = self._eval(e.children[1])
+            return [None if m is None or k is None else m.get(k)
+                    for m, k in zip(ms, ks)]
+        if isinstance(e, mp_ops.GetItem):
+            from ..columnar import dtypes as _dt
+            objs = self._eval(e.children[0])
+            if _dt.is_map(e.children[0].dtype):
+                objs = [_as_map(o) for o in objs]
+            ks = self._eval(e.children[1])
+            out = []
+            for o, k in zip(objs, ks):
+                if o is None or k is None:
+                    out.append(None)
+                elif isinstance(o, dict):
+                    out.append(o.get(k))
+                else:
+                    i = int(k)
+                    if e.one_based:
+                        if i == 0:
+                            out.append(None)
+                            continue
+                        i = i - 1 if i > 0 else len(o) + i
+                    out.append(o[i] if 0 <= i < len(o) else None)
+            return out
+        if isinstance(e, mp_ops.MapKeys):
+            ms = [_as_map(m) for m in self._eval(e.children[0])]
+            return [None if m is None else list(m.keys()) for m in ms]
+        if isinstance(e, mp_ops.MapValues):
+            ms = [_as_map(m) for m in self._eval(e.children[0])]
+            # device arrays carry no per-element validity: NULL map values
+            # surface as 0 there; mirror it so golden compares align
+            return [None if m is None else
+                    [0 if v is None else v for v in m.values()] for m in ms]
         if isinstance(e, ex.ColumnRef):
             return self._col_by_name(e.col_name)
         if isinstance(e, ex.BoundReference):

@@ -26,10 +26,14 @@ from .expressions import Expression
 
 class GetArrayItem(Expression):
     """arr[i] (complexTypeExtractors.scala GetArrayItem): out-of-bounds or
-    NULL array -> NULL."""
+    NULL array -> NULL. ``one_based=True`` is element_at's indexing:
+    1-based from the front, negative counts from the end, and 0 yields
+    NULL (Spark raises; returning NULL keeps execution total)."""
 
-    def __init__(self, child: Expression, index: Expression):
+    def __init__(self, child: Expression, index: Expression,
+                 one_based: bool = False):
         super().__init__(child, index)
+        self.one_based = one_based
 
     @property
     def dtype(self):
@@ -52,7 +56,13 @@ class GetArrayItem(Expression):
         else:
             i = idx.data.astype(jnp.int32)
             ivalid = idx.validity
-        ok = arr.validity & ivalid & (i >= 0) & (i < arr.lengths)
+        if self.one_based:
+            eff = jnp.where(i > 0, i - 1, arr.lengths + i)
+            ok = arr.validity & ivalid & (i != 0) & (eff >= 0) & \
+                (eff < arr.lengths)
+            i = eff
+        else:
+            ok = arr.validity & ivalid & (i >= 0) & (i < arr.lengths)
         ic = jnp.clip(i, 0, w - 1)
         data = jnp.take_along_axis(arr.data, ic[:, None], axis=1)[:, 0]
         data = jnp.where(ok, data, jnp.zeros((), data.dtype))

@@ -1,0 +1,240 @@
+"""MAP expressions: CreateMap, GetMapValue, map_keys/map_values.
+
+Reference: ``complexTypeExtractors.scala`` (GetMapValue),
+``complexTypeCreator.scala`` (CreateMap), ``collectionOperations.scala``
+(MapKeys/MapValues). TPU-first layout (columnar/dtypes.py MAP): one
+``int64[cap, 3W]`` bitpattern matrix — keys in columns ``[0, W)``, values
+in ``[W, 2W)``, per-entry value-validity flags in ``[2W, 3W)`` (Spark maps
+may hold NULL values) — plus per-row entry counts, so every
+transport/spill/concat path treats a map column like any other var-width
+column. Lookups are a
+vectorized compare + argmax over the W key lanes (no hashing — W is small
+and static, the VPU eats the whole compare in one pass).
+
+Only fixed-width primitive keys/values have this device layout; string
+keys/values tag off to the CPU engine (plan/overrides.py gating).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, Scalar, bucket
+from .expressions import Expression, materialize
+
+
+def _halves(col: Column):
+    """(keys[cap, W] in K-dtype, values[cap, W] in V-dtype,
+    value_valid[cap, W] bool, W) — strided views of the interleaved
+    [k, v, ok] entry lanes (see dtypes.MAP)."""
+    w = col.data.shape[1] // 3
+    kt, vt = col.dtype.key, col.dtype.element
+    return (_from_bits(col.data[:, 0:3 * w:3], kt),
+            _from_bits(col.data[:, 1:3 * w:3], vt),
+            col.data[:, 2:3 * w:3] != 0, w)
+
+
+def _from_bits(bits: jnp.ndarray, dtype: dt.DType) -> jnp.ndarray:
+    if dtype.is_floating:
+        import jax
+        f = jax.lax.bitcast_convert_type(bits, jnp.float64)
+        return f.astype(dtype.numpy_dtype) if dtype != dt.FLOAT64 else f
+    return bits.astype(dtype.numpy_dtype)
+
+
+def _to_bits(arr: jnp.ndarray, dtype: dt.DType) -> jnp.ndarray:
+    if dtype.is_floating:
+        import jax
+        return jax.lax.bitcast_convert_type(
+            arr.astype(jnp.float64), jnp.int64)
+    return arr.astype(jnp.int64)
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) — duplicate keys keep the LAST entry
+    (spark.sql.mapKeyDedupPolicy=LAST_WIN; the CPU oracle matches).
+    NULL keys are invalid in Spark; rows with a NULL key become NULL maps."""
+
+    def __init__(self, *kv: Expression):
+        assert kv and len(kv) % 2 == 0, "map() needs key/value pairs"
+        super().__init__(*kv)
+
+    @property
+    def dtype(self):
+        return dt.MAP(self.children[0].dtype, self.children[1].dtype)
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        n_pairs = len(self.children) // 2
+        keys = [materialize(self.children[2 * i].eval(batch), batch)
+                for i in range(n_pairs)]
+        vals = [materialize(self.children[2 * i + 1].eval(batch), batch)
+                for i in range(n_pairs)]
+        out_t = self.dtype
+        w = bucket(n_pairs, 4)
+        cap = batch.capacity
+        live = batch.row_mask()
+
+        kmat = jnp.stack([k.data for k in keys], axis=1)      # [cap, P]
+        vmat = jnp.stack([v.data for v in vals], axis=1)
+        vvalid = jnp.stack([v.validity for v in vals], axis=1)
+        vmat = jnp.where(vvalid, vmat, jnp.zeros((), vmat.dtype))
+        # LAST_WIN dedup: entry i survives if no later entry has its key
+        same = kmat[:, :, None] == kmat[:, None, :]           # [cap, P, P]
+        later = jnp.triu(jnp.ones((n_pairs, n_pairs), bool), k=1)[None]
+        dup = jnp.any(same & later, axis=2)                   # [cap, P]
+        keep = ~dup
+        # compact kept entries to the front of the W lanes
+        order = jnp.argsort(~keep, axis=1, stable=True)       # kept first
+        kc = jnp.take_along_axis(kmat, order, axis=1)
+        vc = jnp.take_along_axis(vmat, order, axis=1)
+        vvc = jnp.take_along_axis(vvalid, order, axis=1)
+        n_kept = jnp.sum(keep, axis=1).astype(jnp.int32)
+        lane = jnp.arange(n_pairs)[None, :]
+        kept_lane = lane < n_kept[:, None]
+        pad_k = jnp.where(kept_lane, kc, jnp.zeros((), kc.dtype))
+        pad_v = jnp.where(kept_lane, vc, jnp.zeros((), vc.dtype))
+        pad_vv = (vvc & kept_lane).astype(jnp.int64)
+
+        mat = jnp.zeros((cap, 3 * w), jnp.int64)
+        mat = mat.at[:, 0:3 * n_pairs:3].set(_to_bits(pad_k, out_t.key))
+        mat = mat.at[:, 1:3 * n_pairs + 1:3].set(
+            _to_bits(pad_v, out_t.element))
+        mat = mat.at[:, 2:3 * n_pairs + 2:3].set(pad_vv)
+        valid = live & jnp.all(
+            jnp.stack([k.validity for k in keys], axis=1), axis=1)
+        mat = jnp.where(valid[:, None], mat, 0)
+        lens = jnp.where(valid, n_kept, 0)
+        return Column(out_t, mat, valid, lens)
+
+
+class GetMapValue(Expression):
+    """map[key] / element_at(map, key): NULL when the key is absent
+    (complexTypeExtractors.scala GetMapValue)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__(child, key)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.element
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        kt = self.children[0].dtype.key
+        key_expr_t = self.children[1].dtype
+        if (key_expr_t.numpy_dtype is None) != (kt.numpy_dtype is None) or \
+                key_expr_t.var_width or kt.var_width:
+            raise TypeError(
+                f"map key lookup type {key_expr_t} incompatible with "
+                f"map<{kt},...> (planner should have tagged this off)")
+        m = materialize(self.children[0].eval(batch), batch)
+        key = self.children[1].eval(batch)
+        keys, vals, vvalid, w = _halves(m)
+        cap = m.capacity
+        # compare in float64 when exactly one side is floating: casting the
+        # lookup key INTO an integral key dtype would truncate (1.5 -> 1)
+        # and match the wrong entry
+        cmp_f = kt.is_floating != key_expr_t.is_floating or kt.is_floating
+        ck = keys.astype(jnp.float64) if cmp_f else keys
+        if isinstance(key, Scalar):
+            if key.is_null:
+                return Column.full_null(self.dtype, cap)
+            k = jnp.full((cap, 1), key.value,
+                         jnp.float64 if cmp_f else keys.dtype)
+            kvalid = jnp.ones(cap, jnp.bool_)
+        else:
+            k = key.data.astype(jnp.float64 if cmp_f
+                                else keys.dtype)[:, None]
+            kvalid = key.validity
+        lane_ok = jnp.arange(w)[None, :] < m.lengths[:, None]
+        match = (ck == k) & lane_ok
+        found = jnp.any(match, axis=1)
+        idx = jnp.argmax(match, axis=1)
+        data = jnp.take_along_axis(vals, idx[:, None], axis=1)[:, 0]
+        val_ok = jnp.take_along_axis(vvalid, idx[:, None], axis=1)[:, 0]
+        ok = m.validity & kvalid & found & val_ok
+        return Column(self.dtype, jnp.where(ok, data,
+                                            jnp.zeros((), data.dtype)), ok)
+
+
+class GetItem(Expression):
+    """col[x] / element_at(col, x) dispatcher: whether ``col`` is a MAP or
+    an ARRAY is unknown until column references resolve, so the choice
+    happens at eval time. ``one_based=True`` is element_at's array
+    indexing (1-based, negatives count from the end); maps ignore it."""
+
+    def __init__(self, child: Expression, key: Expression,
+                 one_based: bool = False):
+        super().__init__(child, key)
+        self.one_based = one_based
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.element
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        from .arrays import GetArrayItem
+        child, key = self.children
+        if dt.is_map(child.dtype):
+            return GetMapValue(child, key).eval(batch)
+        return GetArrayItem(child, key,
+                            one_based=self.one_based).eval(batch)
+
+
+class MapKeys(Expression):
+    """map_keys(m) -> array<K> (collectionOperations.scala MapKeys)."""
+
+    @property
+    def dtype(self):
+        return dt.ARRAY(self.children[0].dtype.key)
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        m = materialize(self.children[0].eval(batch), batch)
+        keys, _vals, _vv, w = _halves(m)
+        lane_ok = jnp.arange(w)[None, :] < m.lengths[:, None]
+        data = jnp.where(lane_ok & m.validity[:, None], keys,
+                         jnp.zeros((), keys.dtype))
+        return Column(self.dtype, data, m.validity,
+                      jnp.where(m.validity, m.lengths, 0))
+
+
+class MapValues(Expression):
+    """map_values(m) -> array<V> (collectionOperations.scala MapValues).
+
+    Limitation: ARRAY<primitive> carries no per-element validity, so NULL
+    map values surface as 0 in the produced array (GetMapValue does honor
+    them); the CPU engine mirrors this so golden compares stay aligned."""
+
+    @property
+    def dtype(self):
+        return dt.ARRAY(self.children[0].dtype.element)
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        m = materialize(self.children[0].eval(batch), batch)
+        _keys, vals, _vv, w = _halves(m)
+        lane_ok = jnp.arange(w)[None, :] < m.lengths[:, None]
+        data = jnp.where(lane_ok & m.validity[:, None], vals,
+                         jnp.zeros((), vals.dtype))
+        return Column(self.dtype, data, m.validity,
+                      jnp.where(m.validity, m.lengths, 0))

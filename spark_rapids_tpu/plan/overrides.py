@@ -89,6 +89,11 @@ from ..ops import arrays as _AR  # noqa: E402
 for k in (_AR.Explode, _AR.StringSplit, _AR.GetArrayItem, _AR.Size):
     _expr(k)
 
+from ..ops import maps as _MP  # noqa: E402
+for k in (_MP.CreateMap, _MP.GetMapValue, _MP.GetItem, _MP.MapKeys,
+          _MP.MapValues):
+    _expr(k)
+
 from ..ops import python_udf as _PU  # noqa: E402
 _expr(_PU.PandasUDF)
 
@@ -159,10 +164,21 @@ class ExprMeta(BaseMeta):
             ok = (t in SUPPORTED_TYPES or t == dt.NULLTYPE or
                   (dt.is_array(t) and t.element in SUPPORTED_TYPES and
                    not t.element.var_width) or
+                  (dt.is_map(t) and t.numpy_dtype is not None) or
                   (t == dt.ARRAY_STRING and
                    isinstance(self.expr, _AR.StringSplit)))
             if not ok:
                 self.will_not_work(f"unsupported output type {t}")
+            if isinstance(self.expr, (_MP.GetMapValue, _MP.GetItem)):
+                child_t = self.expr.children[0].dtype
+                if dt.is_map(child_t):
+                    key_t = self.expr.children[1].dtype
+                    if (key_t.numpy_dtype is None) != \
+                            (child_t.key.numpy_dtype is None) or \
+                            key_t.var_width != child_t.key.var_width:
+                        self.will_not_work(
+                            f"map key lookup type {key_t} does not match "
+                            f"map key type {child_t.key}")
         except Exception:
             pass
 
@@ -220,12 +236,14 @@ class PlanMeta(BaseMeta):
                 for r in em.collect_reasons():
                     self.will_not_work(r)
         self._tag_self()
-        # output schema types (ARRAY<primitive> allowed)
+        # output schema types (ARRAY/MAP of primitives allowed)
         for f in self.plan.schema.fields:
             ok = (f.dtype in SUPPORTED_TYPES or
                   (dt.is_array(f.dtype) and
                    f.dtype.element in SUPPORTED_TYPES and
-                   not f.dtype.element.var_width))
+                   not f.dtype.element.var_width) or
+                  (dt.is_map(f.dtype) and
+                   f.dtype.numpy_dtype is not None))
             if not ok:
                 self.will_not_work(
                     f"unsupported column type {f.dtype} for {f.name}")

@@ -1,0 +1,138 @@
+"""MAP type + map expressions (ref: complexTypeExtractors.scala GetMapValue,
+complexTypeCreator.scala CreateMap, collectionOperations.scala
+MapKeys/MapValues). Device layout: int64[cap, 3W] bitpattern matrix, see
+ops/maps.py."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+from golden import assert_tpu_and_cpu_equal
+
+
+def _map_table():
+    return pa.table({
+        "k": [1, 2, 3, 4],
+        "m": pa.array([[(1, 5.0), (2, 6.5)], [(2, 7.0)], None, []],
+                      type=pa.map_(pa.int64(), pa.float64())),
+    })
+
+
+def test_map_roundtrip_arrow():
+    b = ColumnarBatch.from_arrow(_map_table())
+    assert dt.is_map(b.schema["m"].dtype)
+    assert b.to_pydict()["m"] == [{1: 5.0, 2: 6.5}, {2: 7.0}, None, {}]
+    rt = ColumnarBatch.from_arrow(b.to_arrow())
+    assert rt.to_pydict() == b.to_pydict()
+
+
+def test_map_null_values_roundtrip():
+    sch = dt.Schema([dt.Field("m", dt.MAP(dt.INT64, dt.FLOAT64))])
+    b = ColumnarBatch.from_pydict({"m": [{1: 2.5, 3: None}, None]},
+                                  schema=sch)
+    assert b.to_pydict()["m"] == [{1: 2.5, 3: None}, None]
+
+
+def test_get_map_value_golden():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_map_table())
+        .select(col("k"), F.get_item(col("m"), 2).alias("two"),
+                F.element_at(col("m"), 1).alias("one")))
+
+
+def test_map_keys_values_size_golden():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_map_table())
+        .select(col("k"), F.map_keys(col("m")).alias("ks"),
+                F.map_values(col("m")).alias("vs"),
+                F.size(col("m")).alias("n")))
+
+
+def test_create_map_golden():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(
+            {"a": [1, 2, 3, 2], "x": [1.5, 2.5, None, 4.5],
+             "b": [10, 20, 30, 40]})
+        .select(F.create_map(col("a"), col("x"), col("b"),
+                             F.col("x") + lit(1.0)).alias("m")))
+
+
+def test_create_map_last_win_dedup():
+    """Duplicate keys keep the LAST entry (mapKeyDedupPolicy=LAST_WIN)."""
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"a": [7, 7], "x": [1.0, 2.0],
+                                     "y": [3.0, 4.0]})
+        .select(F.create_map(col("a"), col("x"), col("a"),
+                             col("y")).alias("m")))
+
+
+def test_map_then_filter_groupby():
+    """Map lookup feeding the filter->groupby pipeline end to end."""
+    rng = np.random.default_rng(11)
+    n = 5000
+    keys = rng.integers(0, 8, n)
+    maps = [{int(k): float(k) * 2 + 1, 99: -1.0} for k in keys]
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(
+            {"g": [int(x) for x in keys % 4], "m": maps})
+        .select(col("g"), F.get_item(col("m"), 99).alias("v"))
+        .groupBy("g").agg(F.sum("v").alias("sv"),
+                          F.count("*").alias("c")),
+        ignore_order=True)
+
+
+def test_float_key_map():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"m": [{1.5: 10}, {2.5: 20}, None]})
+        .select(F.get_item(col("m"), 1.5).alias("x")))
+
+
+def test_map_width_harmonization_concat():
+    """Interleaved lanes survive the var-width padding every concat path
+    applies (a side-by-side block layout would shift and corrupt)."""
+    from spark_rapids_tpu.plan.physical import concat_batches
+    sch = dt.Schema([dt.Field("m", dt.MAP(dt.INT64, dt.INT64))])
+    narrow = ColumnarBatch.from_pydict({"m": [{1: 10}]}, schema=sch)
+    wide = ColumnarBatch.from_pydict(
+        {"m": [{i: i * 2 for i in range(7)}]}, schema=sch)
+    assert narrow.columns[0].data.shape[1] < wide.columns[0].data.shape[1]
+    out = concat_batches(sch, [narrow, wide])
+    assert out.to_pydict()["m"] == [{1: 10},
+                                    {i: i * 2 for i in range(7)}]
+
+
+def test_empty_map_only_column():
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.getOrCreate()
+    out = s.createDataFrame({"m": [{}, None]}).select(
+        F.size(col("m")).alias("n")).collect()
+    assert out == [(0,), (-1,)]
+
+
+def test_float_lookup_key_on_int_map():
+    """A 1.5 lookup on an int-keyed map must NOT truncate-match entry 1."""
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"m": [{1: 2}, {2: 3}]})
+        .select(F.get_item(col("m"), 1.5).alias("x")))
+
+
+def test_element_at_negative_index_array():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(
+            {"a": [[1, 2, 3], [7], None, []]})
+        .select(F.element_at(col("a"), -1).alias("last"),
+                F.element_at(col("a"), 1).alias("first")))
+
+
+def test_string_key_map_falls_back():
+    """String-keyed maps have no device layout: CPU fallback, correct."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.getOrCreate()
+    df = s.createDataFrame({"m": [{"a": 1}, {"b": 2}, None]})
+    out = df.select(F.get_item(col("m"), lit("a")).alias("x")).collect()
+    assert out == [(1,), (None,), (None,)]

@@ -1,0 +1,61 @@
+"""Regenerate docs/configs.md and docs/supported_ops.md from the live
+registries (the reference generates docs/configs.md from RapidsConf.confHelp,
+RapidsConf.scala:133-168, and docs/supported_ops.md from its rule registry).
+
+Run: python tools/gen_docs.py
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    from spark_rapids_tpu.config import REGISTRY
+    from spark_rapids_tpu.plan.overrides import _EXPR_RULES, PlanMeta
+
+    with open(os.path.join(ROOT, "docs", "configs.md"), "w") as f:
+        f.write(REGISTRY.help_text())
+
+    lines = [
+        "# Supported operators and expressions",
+        "",
+        "Generated from the live replacement-rule registry "
+        "(`plan/overrides.py`), the analog of the reference's generated "
+        "`docs/supported_ops.md`. Every entry has an auto-generated "
+        "enable/disable conf key.",
+        "",
+        "## Execs",
+        "",
+        "| Logical operator | TPU exec | Conf key |",
+        "|---|---|---|",
+    ]
+    for lp_cls, exec_name in sorted(PlanMeta.EXEC_NAMES.items(),
+                                    key=lambda kv: kv[1]):
+        lines.append(
+            f"| {lp_cls.__name__} | Tpu{exec_name} | "
+            f"spark.rapids.tpu.sql.exec.{exec_name} |")
+    lines += [
+        "",
+        "## Expressions",
+        "",
+        "| Expression | Notes | Conf key |",
+        "|---|---|---|",
+    ]
+    for klass, rule in sorted(_EXPR_RULES.items(), key=lambda kv: kv[0].__name__):
+        notes = []
+        if rule.incompat:
+            notes.append(f"incompat: {rule.incompat}")
+        if rule.disabled_reason:
+            notes.append(f"disabled: {rule.disabled_reason}")
+        lines.append(f"| {klass.__name__} | {'; '.join(notes) or '—'} | "
+                     f"{rule.conf_key} |")
+    with open(os.path.join(ROOT, "docs", "supported_ops.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("regenerated docs/configs.md and docs/supported_ops.md")
+
+
+if __name__ == "__main__":
+    main()
