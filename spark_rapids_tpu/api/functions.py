@@ -384,16 +384,27 @@ def size(c) -> Col:
     return Col(ar_ops.Size(_unwrap(c)))
 
 
+def _key_literal(v) -> "ex.Expression":
+    import numpy as np
+    if isinstance(v, np.integer):
+        v = int(v)
+    elif isinstance(v, np.floating):
+        v = float(v)
+    elif isinstance(v, np.bool_):
+        v = bool(v)
+    return ex.Literal(v)
+
+
 def get_item(c, index) -> Col:
     from ..ops import maps as mp_ops
-    key = _unwrap(index) if isinstance(index, Col) else ex.Literal(index)
+    key = _unwrap(index) if isinstance(index, Col) else _key_literal(index)
     return Col(mp_ops.GetItem(_unwrap(c), key))
 
 
 def element_at(c, key) -> Col:
     """element_at(map, key) / element_at(array, 1-based index)."""
     from ..ops import maps as mp_ops
-    k = _unwrap(key) if isinstance(key, Col) else ex.Literal(key)
+    k = _unwrap(key) if isinstance(key, Col) else _key_literal(key)
     return Col(mp_ops.GetItem(_unwrap(c), k, one_based=True))
 
 

@@ -174,6 +174,37 @@ class TpuSession:
     def last_plan(self):
         return self._last_exec_plan
 
+    # -- per-query metrics (SQLMetrics-in-the-UI analog: GpuMetricNames +
+    # per-exec additionalMetrics, GpuExec.scala:27-56; spill volume feeds
+    # the query summary like TaskMetrics.memoryBytesSpilled) --------------
+    def last_query_metrics(self) -> dict:
+        """Structured metrics for the last executed query: per-operator
+        counters/timers in plan-tree order plus memory-runtime totals."""
+        assert self._last_exec_plan is not None, "no plan executed yet"
+        from ..exec.spill import BufferCatalog
+        cat = BufferCatalog.get()
+        return {
+            "operators": [
+                {"depth": d, "operator": name, "metrics": m}
+                for d, name, m in self._last_exec_plan.metrics_tree()],
+            "memory": {
+                "deviceBytesHeld": cat.device_bytes,
+                "hostBytesHeld": cat.host_bytes,
+                "spilledDeviceBytes": cat.spilled_device_bytes,
+                "spilledHostBytes": cat.spilled_host_bytes,
+            },
+        }
+
+    def explain_metrics(self) -> str:
+        """The last executed plan annotated with each operator's metrics
+        (the explain-with-SQLMetrics view of the Spark UI)."""
+        assert self._last_exec_plan is not None, "no plan executed yet"
+        rep = self.last_query_metrics()
+        mem = rep["memory"]
+        tail = ("memory: " +
+                ", ".join(f"{k}={v}" for k, v in sorted(mem.items())))
+        return self._last_exec_plan.metrics_string() + "\n" + tail
+
     def assert_on_tpu(self, allowed_fallbacks: Sequence[str] = ()) -> None:
         """assertIsOnTheGpu test mode (GpuTransitionOverrides.scala:311-367)."""
         from ..plan.physical import CpuFallbackExec

@@ -51,6 +51,12 @@ def next_buffer_id() -> int:
     return next(_id_counter)
 
 
+class BufferLostError(RuntimeError):
+    """A spillable buffer was released or evicted before its read — the
+    recoverable 'shuffle block lost' condition (consumers like the shuffle
+    exchange re-execute the producing stage, Spark FetchFailed style)."""
+
+
 @dataclass
 class BufferMeta:
     """Schema + shape info to rebuild a ColumnarBatch from raw arrays
@@ -319,8 +325,13 @@ class SpillableColumnarBatch:
         return nr
 
     def get_batch(self) -> ColumnarBatch:
-        assert not self._closed, "use after close"
-        return self.catalog.acquire_batch(self._id)
+        if self._closed:
+            raise BufferLostError(f"buffer {self._id} released")
+        try:
+            return self.catalog.acquire_batch(self._id)
+        except KeyError:
+            raise BufferLostError(f"buffer {self._id} missing from the "
+                                  "catalog") from None
 
     def close(self) -> None:
         if not self._closed:

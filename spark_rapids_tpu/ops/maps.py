@@ -57,6 +57,8 @@ class CreateMap(Expression):
     (spark.sql.mapKeyDedupPolicy=LAST_WIN; the CPU oracle matches).
     NULL keys are invalid in Spark; rows with a NULL key become NULL maps."""
 
+    fusable = False               # see module docstring: eager-only bitcast
+
     def __init__(self, *kv: Expression):
         assert kv and len(kv) % 2 == 0, "map() needs key/value pairs"
         super().__init__(*kv)
@@ -84,11 +86,16 @@ class CreateMap(Expression):
         vmat = jnp.stack([v.data for v in vals], axis=1)
         vvalid = jnp.stack([v.validity for v in vals], axis=1)
         vmat = jnp.where(vvalid, vmat, jnp.zeros((), vmat.dtype))
-        # LAST_WIN dedup: entry i survives if no later entry has its key
+        # LAST_WIN dedup with Spark's entry ORDER (ArrayBasedMapBuilder —
+        # python dicts agree): a key keeps its FIRST occurrence position
+        # but takes its LAST occurrence's value
         same = kmat[:, :, None] == kmat[:, None, :]           # [cap, P, P]
-        later = jnp.triu(jnp.ones((n_pairs, n_pairs), bool), k=1)[None]
-        dup = jnp.any(same & later, axis=2)                   # [cap, P]
-        keep = ~dup
+        earlier = jnp.tril(jnp.ones((n_pairs, n_pairs), bool), k=-1)[None]
+        keep = ~jnp.any(same & earlier, axis=2)               # first occur.
+        last_j = jnp.max(jnp.where(same, jnp.arange(n_pairs)[None, None, :],
+                                   -1), axis=2)               # [cap, P]
+        vmat = jnp.take_along_axis(vmat, last_j, axis=1)
+        vvalid = jnp.take_along_axis(vvalid, last_j, axis=1)
         # compact kept entries to the front of the W lanes
         order = jnp.argsort(~keep, axis=1, stable=True)       # kept first
         kc = jnp.take_along_axis(kmat, order, axis=1)
@@ -116,6 +123,8 @@ class CreateMap(Expression):
 class GetMapValue(Expression):
     """map[key] / element_at(map, key): NULL when the key is absent
     (complexTypeExtractors.scala GetMapValue)."""
+
+    fusable = False               # see module docstring: eager-only bitcast
 
     def __init__(self, child: Expression, key: Expression):
         super().__init__(child, key)
@@ -172,6 +181,8 @@ class GetItem(Expression):
     happens at eval time. ``one_based=True`` is element_at's array
     indexing (1-based, negatives count from the end); maps ignore it."""
 
+    fusable = False               # may dispatch to the map path
+
     def __init__(self, child: Expression, key: Expression,
                  one_based: bool = False):
         super().__init__(child, key)
@@ -197,6 +208,8 @@ class GetItem(Expression):
 class MapKeys(Expression):
     """map_keys(m) -> array<K> (collectionOperations.scala MapKeys)."""
 
+    fusable = False               # see module docstring: eager-only bitcast
+
     @property
     def dtype(self):
         return dt.ARRAY(self.children[0].dtype.key)
@@ -221,6 +234,8 @@ class MapValues(Expression):
     Limitation: ARRAY<primitive> carries no per-element validity, so NULL
     map values surface as 0 in the produced array (GetMapValue does honor
     them); the CPU engine mirrors this so golden compares stay aligned."""
+
+    fusable = False               # see module docstring: eager-only bitcast
 
     @property
     def dtype(self):

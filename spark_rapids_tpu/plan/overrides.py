@@ -169,6 +169,17 @@ class ExprMeta(BaseMeta):
                    isinstance(self.expr, _AR.StringSplit)))
             if not ok:
                 self.will_not_work(f"unsupported output type {t}")
+            if isinstance(self.expr, _MP.CreateMap):
+                mt = self.expr.dtype
+                if mt.key.is_floating or mt.element.is_floating:
+                    # float -> bitpattern (f64->s64 bitcast) is
+                    # unimplemented inside some backends' x64-emulation
+                    # rewrite; reading scanned maps only needs the working
+                    # s64->f64 direction, but BUILDING one on device does
+                    # not compile there
+                    self.will_not_work(
+                        "create_map with floating keys/values runs on CPU "
+                        "(device f64->bits reinterpret unsupported)")
             if isinstance(self.expr, (_MP.GetMapValue, _MP.GetItem)):
                 child_t = self.expr.children[0].dtype
                 if dt.is_map(child_t):

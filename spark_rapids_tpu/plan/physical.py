@@ -226,6 +226,32 @@ class TpuExec:
     def _cleanup(self) -> None:
         pass
 
+    def metrics_tree(self) -> List[tuple]:
+        """Per-exec metrics in plan-tree order: [(depth, node name,
+        resolved metrics dict)] — the SQLMetrics-per-operator surface the
+        reference renders in the Spark UI (GpuMetricNames,
+        GpuExec.scala:27-56)."""
+        out: List[tuple] = []
+
+        def walk(node, depth):
+            out.append((depth, node._node_string(),
+                        dict(node.metrics.resolve())))
+            for c in node.children:
+                walk(c, depth + 1)
+        walk(self, 0)
+        return out
+
+    def metrics_string(self) -> str:
+        """The executed plan annotated with each operator's metrics."""
+        lines = []
+        for depth, name, m in self.metrics_tree():
+            lines.append("  " * depth + name)
+            for k in sorted(m):
+                v = m[k]
+                v = round(v, 4) if isinstance(v, float) else v
+                lines.append("  " * depth + f"  {k}: {v}")
+        return "\n".join(lines)
+
     def _tree_string(self, depth: int = 0) -> str:
         out = "  " * depth + self._node_string()
         for c in self.children:

@@ -143,12 +143,53 @@ class TpuShuffleExchangeExec(TpuExec):
         return groups
 
     def _read_group(self, shuffle: LocalShuffle, group: List[int]) -> Partition:
+        """Reduce-side read with ELASTIC RECOVERY: a failed fetch (lost /
+        released buffers, transport give-up) triggers one re-execution of
+        the upstream map phase for the lost partitions — the analog of
+        RapidsShuffleFetchFailedException -> Spark FetchFailed -> map-stage
+        retry (RapidsShuffleIterator.scala:28,49)."""
+        from ..exec.spill import BufferLostError
+        from .transport import ShuffleFetchError
+        try:
+            batches = self._pull_group(shuffle, group)
+        except (ShuffleFetchError, BufferLostError) as e:
+            import logging
+            logging.getLogger("spark_rapids_tpu.shuffle").warning(
+                "shuffle fetch for partitions %s failed (%s); re-running "
+                "the map stage for them", group, e)
+            self.metrics.inc("fetchFailedRetries")
+            self._refill(shuffle, group)
+            batches = self._pull_group(shuffle, group)
+        if batches:
+            yield concat_batches(self.schema, batches)
+
+    def _pull_group(self, shuffle: LocalShuffle,
+                    group: List[int]) -> List[ColumnarBatch]:
         batches = []
         for p in group:
             for b in shuffle.read(p, self.schema):
                 batches.append(b)
-        if batches:
-            yield concat_batches(self.schema, batches)
+        return batches
+
+    def _refill(self, shuffle: LocalShuffle, group: List[int]) -> None:
+        """Re-run the upstream map tasks, keeping ONLY the lost reduce
+        partitions' slices (Spark recomputes lost map outputs from lineage;
+        other partitions' refills are discarded)."""
+        from ..exec.tasks import run_partition_tasks
+        lost = set(group)
+        partitioner = self._make_partitioner()
+        for p in group:
+            shuffle.slices[p] = []
+
+        def map_task(pid, part):
+            for batch in part:
+                for pi, piece in enumerate(partitioner.split(batch)):
+                    if pi in lost and piece.num_rows > 0:
+                        shuffle.slices[pi].append(SpillableColumnarBatch(
+                            piece, OUTPUT_FOR_SHUFFLE_PRIORITY,
+                            shuffle.catalog))
+
+        run_partition_tasks(self.children[0].execute(), map_task)
 
     def _cleanup(self) -> None:
         sh = getattr(self, "_shuffle", None)

@@ -179,3 +179,28 @@ def test_api_validation_tool():
     assert report["ok"], report["problems"]
     assert report["n_expressions"] > 100
     assert report["n_execs"] >= 15
+
+
+def test_last_query_metrics_surfaced():
+    """Per-query SQLMetrics analog (ref GpuMetricNames, GpuExec.scala:27-56):
+    operator counters surface in plan order with memory-runtime totals."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+
+    s = TpuSession.builder.getOrCreate()
+    df = s.createDataFrame({"k": [1, 2, 1, 3] * 50, "v": [1.0] * 200})
+    df.filter(col("v") > 0).groupBy("k").agg(
+        F.sum("v").alias("s")).collect()
+    rep = s.last_query_metrics()
+    ops = {o["operator"].split("[")[0]: o["metrics"] for o in rep["operators"]}
+    assert any("HashAggregate" in name for name in ops), ops.keys()
+    agg = next(m for name, m in ops.items() if "HashAggregate" in name)
+    assert agg.get("numOutputRows") == 3
+    assert "computeAggTime" in agg
+    scan = next(m for name, m in ops.items() if "Scan" in name)
+    assert scan.get("numOutputRows") == 200
+    assert set(rep["memory"]) == {"deviceBytesHeld", "hostBytesHeld",
+                                  "spilledDeviceBytes", "spilledHostBytes"}
+    text = s.explain_metrics()
+    assert "numOutputRows" in text and "memory:" in text

@@ -54,19 +54,26 @@ def test_map_keys_values_size_golden():
 
 
 def test_create_map_golden():
+    """Int keys/values build on device; float values fall back to the CPU
+    engine (the backend cannot bit-pack f64 on device) but stay correct."""
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(
+            {"a": [1, 2, 3, 2], "b": [10, 20, None, 40]})
+        .select(F.create_map(col("a"), col("b")).alias("m")))
     assert_tpu_and_cpu_equal(
         lambda s: s.createDataFrame(
             {"a": [1, 2, 3, 2], "x": [1.5, 2.5, None, 4.5],
              "b": [10, 20, 30, 40]})
         .select(F.create_map(col("a"), col("x"), col("b"),
-                             F.col("x") + lit(1.0)).alias("m")))
+                             F.col("x") + lit(1.0)).alias("m")),
+        expect_fallback=["Project"])
 
 
 def test_create_map_last_win_dedup():
     """Duplicate keys keep the LAST entry (mapKeyDedupPolicy=LAST_WIN)."""
     assert_tpu_and_cpu_equal(
-        lambda s: s.createDataFrame({"a": [7, 7], "x": [1.0, 2.0],
-                                     "y": [3.0, 4.0]})
+        lambda s: s.createDataFrame({"a": [7, 7], "x": [1, 2],
+                                     "y": [3, 4]})
         .select(F.create_map(col("a"), col("x"), col("a"),
                              col("y")).alias("m")))
 
@@ -90,6 +97,29 @@ def test_float_key_map():
     assert_tpu_and_cpu_equal(
         lambda s: s.createDataFrame({"m": [{1.5: 10}, {2.5: 20}, None]})
         .select(F.get_item(col("m"), 1.5).alias("x")))
+
+
+def test_create_map_dedup_keeps_first_position_last_value():
+    """Spark's ArrayBasedMapBuilder: a duplicate key keeps its FIRST
+    position in entry order but its LAST value — map_keys order proves it
+    (dict-compare alone cannot)."""
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"a": [2, 5], "x": [1, 2],
+                                     "y": [3, 4]})
+        .select(F.map_keys(F.create_map(
+            lit(1), col("x"), col("a"), col("y"),
+            lit(1), col("x") + lit(10))).alias("ks"),
+            F.map_values(F.create_map(
+                lit(1), col("x"), col("a"), col("y"),
+                lit(1), col("x") + lit(10))).alias("vs")))
+
+
+def test_get_item_numpy_key():
+    import numpy as _np
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame({"m": [{1: 2.0}, {3: 4.0}]})
+        .select(F.get_item(col("m"), _np.int64(1)).alias("x"),
+                F.element_at(col("m"), _np.int64(3)).alias("y")))
 
 
 def test_map_width_harmonization_concat():

@@ -341,3 +341,42 @@ def test_fetch_through_bounce_arena():
     all_got = sorted(r for g in got for r in g.rows())
     all_exp = sorted(r for _rid, b in batches for r in b.rows())
     assert all_got == all_exp
+
+
+def test_exchange_stage_retry_on_lost_buffers():
+    """Elastic recovery (RapidsShuffleIterator.scala:28,49): losing a reduce
+    partition's buffers mid-read triggers one map-stage re-execution for the
+    lost partitions and the query still returns correct results."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.shuffle.partitions": "4",
+         "spark.rapids.tpu.sql.adaptive.enabled": "false"}).getOrCreate()
+    df = s.createDataFrame({"k": list(range(40)) * 5, "v": [1.0] * 200})
+    agg = df.repartition(4, "k").groupBy("k").agg(F.sum("v").alias("sv"))
+
+    orig_execute = TpuShuffleExchangeExec.execute
+    state = {"sabotaged": False, "node": None}
+
+    def sabotaging_execute(self):
+        parts = orig_execute(self)
+        sh = self._shuffle
+        if not state["sabotaged"] and sh is not None:
+            # lose partition 0's slices AFTER the map phase wrote them
+            for sl in sh.slices[0]:
+                sl.close()
+            state["sabotaged"] = True
+            state["node"] = self
+        return parts
+
+    TpuShuffleExchangeExec.execute = sabotaging_execute
+    try:
+        out = dict(agg.collect())
+    finally:
+        TpuShuffleExchangeExec.execute = orig_execute
+    assert state["sabotaged"]
+    assert out == {k: 5.0 for k in range(40)}
+    assert state["node"].metrics.get("fetchFailedRetries", 0) >= 1
