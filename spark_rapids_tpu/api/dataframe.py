@@ -224,11 +224,17 @@ class DataFrame:
     # -- actions -------------------------------------------------------------
     def _execute(self):
         plan = self._analyzed()
+        from ..exec.spill import BufferCatalog
         from ..plan.overrides import Overrides
         ov = Overrides(self.session.conf)
         exec_plan = ov.apply(plan)
         self.session._last_exec_plan = exec_plan
         self.session._last_overrides = ov
+        # spill counters are process-cumulative; snapshot them so
+        # last_query_metrics() can report THIS query's deltas
+        cat = BufferCatalog.get()
+        self.session._mem_baseline = (cat.spilled_device_bytes,
+                                      cat.spilled_host_bytes)
         return exec_plan
 
     def collect_batch(self):

@@ -179,10 +179,14 @@ class TpuSession:
     # the query summary like TaskMetrics.memoryBytesSpilled) --------------
     def last_query_metrics(self) -> dict:
         """Structured metrics for the last executed query: per-operator
-        counters/timers in plan-tree order plus memory-runtime totals."""
-        assert self._last_exec_plan is not None, "no plan executed yet"
+        counters/timers in plan-tree order, spill DELTAS attributable to
+        that query (TaskMetrics.memoryBytesSpilled analog), and the
+        point-in-time catalog residency gauges."""
+        if self._last_exec_plan is None:
+            raise RuntimeError("no plan executed yet")
         from ..exec.spill import BufferCatalog
         cat = BufferCatalog.get()
+        base_dev, base_host = getattr(self, "_mem_baseline", (0, 0))
         return {
             "operators": [
                 {"depth": d, "operator": name, "metrics": m}
@@ -190,15 +194,16 @@ class TpuSession:
             "memory": {
                 "deviceBytesHeld": cat.device_bytes,
                 "hostBytesHeld": cat.host_bytes,
-                "spilledDeviceBytes": cat.spilled_device_bytes,
-                "spilledHostBytes": cat.spilled_host_bytes,
+                "spilledDeviceBytes": cat.spilled_device_bytes - base_dev,
+                "spilledHostBytes": cat.spilled_host_bytes - base_host,
             },
         }
 
     def explain_metrics(self) -> str:
         """The last executed plan annotated with each operator's metrics
         (the explain-with-SQLMetrics view of the Spark UI)."""
-        assert self._last_exec_plan is not None, "no plan executed yet"
+        if self._last_exec_plan is None:
+            raise RuntimeError("no plan executed yet")
         rep = self.last_query_metrics()
         mem = rep["memory"]
         tail = ("memory: " +

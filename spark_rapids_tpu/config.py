@@ -355,6 +355,13 @@ MATMUL_AGG = _conf("spark.rapids.tpu.sql.agg.matmul.enabled").doc(
     "sequential order at ~1e-5 rel — the variableFloatAgg trade "
     "(ref: RapidsConf.scala variableFloatAgg)").string_conf.create_with_default("auto")
 
+HASH_OPTIMIZE_SORT = _conf("spark.rapids.tpu.sql.hashOptimizeSort.enabled").doc(
+    "Insert a per-partition sort on hash-aggregate/join outputs so "
+    "downstream file writes compress better (ref: "
+    "spark.rapids.sql.hashOptimizeSort.enabled, "
+    "GpuTransitionOverrides.scala:268-304)"
+).boolean_conf.create_with_default(False)
+
 AGG_PIPELINE_DEPTH = _conf("spark.rapids.tpu.sql.agg.pipelineDepth").doc(
     "Input batches kept in flight by the streaming aggregation before the "
     "oldest batch's partial result is landed: probe-stat readbacks overlap "

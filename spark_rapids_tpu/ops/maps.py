@@ -181,12 +181,19 @@ class GetItem(Expression):
     happens at eval time. ``one_based=True`` is element_at's array
     indexing (1-based, negatives count from the end); maps ignore it."""
 
-    fusable = False               # may dispatch to the map path
-
     def __init__(self, child: Expression, key: Expression,
                  one_based: bool = False):
         super().__init__(child, key)
         self.one_based = one_based
+
+    @property
+    def fusable(self):
+        # only the MAP path carries the eager-only bitcast; plain array
+        # indexing keeps fusing into staged programs
+        try:
+            return not dt.is_map(self.children[0].dtype)
+        except Exception:
+            return False
 
     @property
     def dtype(self):

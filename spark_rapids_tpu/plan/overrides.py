@@ -385,7 +385,29 @@ class Overrides:
         self.last_explain = meta.explain(all_ops=(mode == "ALL"))
         if mode != "NONE" and self.last_explain:
             print(self.last_explain)
-        return self._insert_coalesce(self._convert(meta))
+        node = self._insert_coalesce(self._convert(meta))
+        if self.conf.get(cfg.HASH_OPTIMIZE_SORT):
+            node = self._insert_hash_optimize_sorts(node)
+        return node
+
+    def _insert_hash_optimize_sorts(self, node: ph.TpuExec) -> ph.TpuExec:
+        """Optional per-partition sort above hash-based ops so a downstream
+        file write sees clustered rows and compresses better
+        (insertHashOptimizeSorts, GpuTransitionOverrides.scala:268-304)."""
+        for i, child in enumerate(node.children):
+            node.children[i] = self._insert_hash_optimize_sorts(child)
+        is_final_agg = (isinstance(node, ph.TpuHashAggregateExec) and
+                        node.mode != "partial")
+        if is_final_agg or isinstance(node, ph.TpuSortMergeJoinExec):
+            # partial aggregates sit directly under a hash exchange that
+            # destroys any ordering — sorting them buys nothing
+            orders = [lp.SortOrder(ex.BoundReference(i, f.dtype, True),
+                                   ascending=True)
+                      for i, f in enumerate(node.schema)
+                      if f.dtype in dt.ORDERABLE_TYPES]
+            if orders:
+                return ph.TpuSortExec(node, orders, is_global=False)
+        return node
 
     def _insert_coalesce(self, node: ph.TpuExec) -> ph.TpuExec:
         """Transition pass: insert TpuCoalesceBatchesExec per the op's

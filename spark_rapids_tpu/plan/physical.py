@@ -226,6 +226,27 @@ class TpuExec:
     def _cleanup(self) -> None:
         pass
 
+    def subtree_deterministic(self) -> bool:
+        """False when any expression below draws per-execution state (Rand,
+        monotonically_increasing_id): re-executing such a subtree yields
+        different rows, so shuffle stage-retry must recompute ALL reduce
+        partitions (Spark's indeterminate-stage rule)."""
+        return self._node_deterministic() and all(
+            c.subtree_deterministic() for c in self.children)
+
+    def _node_deterministic(self) -> bool:
+        def exprs_ok(exprs):
+            return not any(e.collect(lambda x: not x.side_effect_free)
+                           for e in exprs)
+        for attr in ("exprs", "grouping", "aggregate_exprs"):
+            v = getattr(self, attr, None)
+            if v is not None and not exprs_ok(v):
+                return False
+        cond = getattr(self, "condition", None)
+        if cond is not None and not exprs_ok([cond]):
+            return False
+        return True
+
     def metrics_tree(self) -> List[tuple]:
         """Per-exec metrics in plan-tree order: [(depth, node name,
         resolved metrics dict)] — the SQLMetrics-per-operator surface the

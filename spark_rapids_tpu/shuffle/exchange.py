@@ -153,6 +153,12 @@ class TpuShuffleExchangeExec(TpuExec):
         try:
             batches = self._pull_group(shuffle, group)
         except (ShuffleFetchError, BufferLostError) as e:
+            if not self.children[0].subtree_deterministic():
+                # re-executing an indeterminate map stage re-partitions
+                # rows differently; partitions already consumed from the
+                # first run would silently duplicate/drop rows (Spark
+                # aborts the stage for the same reason)
+                raise
             import logging
             logging.getLogger("spark_rapids_tpu.shuffle").warning(
                 "shuffle fetch for partitions %s failed (%s); re-running "
@@ -174,11 +180,15 @@ class TpuShuffleExchangeExec(TpuExec):
     def _refill(self, shuffle: LocalShuffle, group: List[int]) -> None:
         """Re-run the upstream map tasks, keeping ONLY the lost reduce
         partitions' slices (Spark recomputes lost map outputs from lineage;
-        other partitions' refills are discarded)."""
+        other partitions' refills are discarded). Caller guarantees the
+        upstream is deterministic."""
         from ..exec.tasks import run_partition_tasks
         lost = set(group)
         partitioner = self._make_partitioner()
-        for p in group:
+        for p in lost:
+            for s in shuffle.slices[p]:
+                if not s._closed:     # release survivors before replacing
+                    s.close()
             shuffle.slices[p] = []
 
         def map_task(pid, part):

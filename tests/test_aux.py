@@ -204,3 +204,31 @@ def test_last_query_metrics_surfaced():
                                   "spilledDeviceBytes", "spilledHostBytes"}
     text = s.explain_metrics()
     assert "numOutputRows" in text and "memory:" in text
+
+
+def test_hash_optimize_sort_insertion():
+    """HashSortOptimizeSuite analog: with hashOptimizeSort.enabled a local
+    sort lands above hash-agg outputs; results unchanged; default off."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.plan.physical import TpuSortExec
+
+    data = {"k": [3, 1, 2, 1] * 10, "v": [1.0] * 40}
+
+    s1 = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.hashOptimizeSort.enabled": "true"}).getOrCreate()
+    out = dict(s1.createDataFrame(data).groupBy("k").agg(
+        F.sum("v").alias("sv")).collect())
+    assert out == {1: 20.0, 2: 10.0, 3: 10.0}
+
+    def has_sort_above_agg(node):
+        if isinstance(node, TpuSortExec) and not node.is_global:
+            return True
+        return any(has_sort_above_agg(c) for c in node.children)
+    assert has_sort_above_agg(s1.last_plan())
+    s1.stop()
+
+    s2 = TpuSession.builder.getOrCreate()
+    s2.createDataFrame(data).groupBy("k").agg(F.sum("v").alias("sv")).collect()
+    assert not has_sort_above_agg(s2.last_plan())
