@@ -235,16 +235,33 @@ class TpuExec:
             c.subtree_deterministic() for c in self.children)
 
     def _node_deterministic(self) -> bool:
-        def exprs_ok(exprs):
-            return not any(e.collect(lambda x: not x.side_effect_free)
-                           for e in exprs)
-        for attr in ("exprs", "grouping", "aggregate_exprs"):
+        from ..ops import expressions as _ex
+
+        def flat_exprs(v):
+            if isinstance(v, _ex.Expression):
+                yield v
+            elif isinstance(v, lp.SortOrder):
+                yield v.child
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    yield from flat_exprs(x)
+
+        for attr in ("exprs", "grouping", "aggregate_exprs", "condition",
+                     "orders", "projections", "left_keys", "right_keys",
+                     "generator", "pre_filter"):
             v = getattr(self, attr, None)
-            if v is not None and not exprs_ok(v):
-                return False
-        cond = getattr(self, "condition", None)
-        if cond is not None and not exprs_ok([cond]):
-            return False
+            if v is None:
+                continue
+            for e in flat_exprs(v):
+                if e.collect(lambda x: not x.side_effect_free):
+                    return False
+        # execs that carry their logical node (generate, write, python-UDF
+        # wrappers) expose its expression list
+        p = getattr(self, "plan", None)
+        if p is not None and hasattr(p, "expressions"):
+            for e in p.expressions():
+                if e.collect(lambda x: not x.side_effect_free):
+                    return False
         return True
 
     def metrics_tree(self) -> List[tuple]:
@@ -719,6 +736,44 @@ class TpuLocalScanExec(TpuExec):
             parts.append(self._part_iter(lo, hi))
         return parts
 
+    # host-prep cache for in-memory tables: arrow tables are immutable, so
+    # the padded/PACKED numpy form of each scan batch is reusable across
+    # query runs (the reference's InMemoryTableScan / cached-table path,
+    # GpuInMemoryTableScanExec) — the DEVICE upload still happens per run.
+    # pa.Table is unhashable, so entries key by id(table) and a weakref
+    # finalizer drops them (and returns their budget) when the table is
+    # collected. Only "packed" preps cache (fallback preps hold the table
+    # and redo the conversion anyway); admission charges the PREPPED bytes
+    # (padding can exceed the arrow size by a large factor) against a
+    # process-wide budget.
+    _PREP_CACHE: Dict[int, dict] = {}
+    _PREP_CACHE_MAX_BYTES = 2 << 30
+    _prep_cache_bytes = 0
+    _prep_cache_lock = __import__("threading").Lock()
+
+    @classmethod
+    def _evict_table(cls, table_id: int) -> None:
+        with cls._prep_cache_lock:
+            ent = cls._PREP_CACHE.pop(table_id, None)
+            if ent:
+                cls._prep_cache_bytes -= sum(
+                    p[5] for p in ent.values() if p[0] == "packed")
+
+    def _table_cache(self):
+        import weakref
+        cls = TpuLocalScanExec
+        tid = id(self.table)
+        with cls._prep_cache_lock:
+            ent = cls._PREP_CACHE.get(tid)
+            if ent is not None:
+                return ent
+            try:
+                weakref.finalize(self.table, cls._evict_table, tid)
+            except TypeError:
+                return None
+            ent = cls._PREP_CACHE[tid] = {}
+            return ent
+
     def _part_iter(self, lo: int, hi: int) -> Partition:
         from ..exec.tasks import prefetch_map
 
@@ -726,8 +781,27 @@ class TpuLocalScanExec(TpuExec):
             pos = lo
             while pos < hi:
                 end = min(pos + self.batch_rows, hi)
-                yield self.table.slice(pos, end - pos)
+                yield (pos, self.table.slice(pos, end - pos))
                 pos = end
+
+        cache = self._table_cache()
+
+        def prep(item):
+            pos, chunk = item
+            key = (pos, chunk.num_rows, self.batch_rows)
+            if cache is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    return hit
+            p = ColumnarBatch.prep_from_arrow(chunk)
+            if cache is not None and p[0] == "packed":
+                cls = TpuLocalScanExec
+                with cls._prep_cache_lock:
+                    if cls._prep_cache_bytes + p[5] <= \
+                            cls._PREP_CACHE_MAX_BYTES:
+                        cache[key] = p
+                        cls._prep_cache_bytes += p[5]
+            return p
 
         # HOST-side arrow->numpy conversion runs one batch ahead on a
         # background thread; the device upload stays on the task thread
@@ -735,12 +809,12 @@ class TpuLocalScanExec(TpuExec):
         # ordering contract (GpuSemaphore.scala:74: acquire after host IO,
         # before device work)
         first = True
-        for prep in prefetch_map(chunks(), ColumnarBatch.prep_from_arrow):
+        for prepped in prefetch_map(chunks(), prep):
             if first:
                 _task_begin()
                 first = False
-            _reserve(ColumnarBatch.prepped_size_bytes(prep))
-            batch = ColumnarBatch.upload_prepped(prep)
+            _reserve(ColumnarBatch.prepped_size_bytes(prepped))
+            batch = ColumnarBatch.upload_prepped(prepped)
             self.metrics.inc("numOutputRows", batch.num_rows_raw)
             self.metrics.inc("numOutputBatches")
             yield batch
