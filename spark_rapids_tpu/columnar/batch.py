@@ -74,6 +74,11 @@ class ColumnarBatch:
         """Bool[capacity] mask of live rows (True for rows < num_rows)."""
         return jnp.arange(self.capacity) < self.num_rows
 
+    def row_mask_raw(self) -> jnp.ndarray:
+        """row_mask built from the count in whatever form it has — never
+        forces a device-resident count to host (sync-free hot paths)."""
+        return jnp.arange(self.capacity) < self._num_rows
+
     def column(self, name_or_idx) -> Column:
         if isinstance(name_or_idx, int):
             return self.columns[name_or_idx]

@@ -248,20 +248,25 @@ class TpuExec:
 
         for attr in ("exprs", "grouping", "aggregate_exprs", "condition",
                      "orders", "projections", "left_keys", "right_keys",
-                     "generator", "pre_filter"):
+                     "generator", "pre_filter", "window_exprs", "by"):
             v = getattr(self, attr, None)
             if v is None:
                 continue
             for e in flat_exprs(v):
                 if e.collect(lambda x: not x.side_effect_free):
                     return False
-        # execs that carry their logical node (generate, write, python-UDF
-        # wrappers) expose its expression list
+        # execs that carry a logical node/subtree (generate, write,
+        # python-UDF wrappers, CPU fallback): walk the WHOLE subtree —
+        # expressions() is per-node
         p = getattr(self, "plan", None)
         if p is not None and hasattr(p, "expressions"):
-            for e in p.expressions():
-                if e.collect(lambda x: not x.side_effect_free):
-                    return False
+            stack = [p]
+            while stack:
+                node = stack.pop()
+                for e in node.expressions():
+                    if e.collect(lambda x: not x.side_effect_free):
+                        return False
+                stack.extend(getattr(node, "children", ()))
         return True
 
     def metrics_tree(self) -> List[tuple]:
@@ -797,7 +802,10 @@ class TpuLocalScanExec(TpuExec):
             if cache is not None and p[0] == "packed":
                 cls = TpuLocalScanExec
                 with cls._prep_cache_lock:
-                    if cls._prep_cache_bytes + p[5] <= \
+                    # re-check under the lock: a concurrent prep of the
+                    # same key must not double-charge the budget
+                    if key not in cache and \
+                            cls._prep_cache_bytes + p[5] <= \
                             cls._PREP_CACHE_MAX_BYTES:
                         cache[key] = p
                         cls._prep_cache_bytes += p[5]
@@ -2183,29 +2191,45 @@ class TpuSortMergeJoinExec(TpuExec):
                     "left" if self.how == "full" else "inner")
                 m = join_k.join_match(bkey_cols, build.num_rows,
                                       skey_cols, batch.num_rows, batch.capacity)
-                total = int(m.total_pairs)
-                if how == "left":
-                    counts = np.asarray(m.count)[:batch.num_rows]
-                    total = int(np.maximum(counts, 1).sum())
-                out_cap = bucket(max(total, 1))
+                # ONE batched scalar readback sizes the static output
+                # bucket (left-outer's emit total computes on DEVICE — a
+                # full per-row counts download costs ~capacity bytes over
+                # a slow link)
+                import jax
+                import jax.numpy as jnp
+                if how in ("left_semi", "left_anti"):
+                    # semi/anti outputs compact at STREAM capacity —
+                    # join_gather ignores out_capacity, so no readback
+                    out_cap = batch.capacity
+                elif how == "left":
+                    live = batch.row_mask_raw()
+                    left_total = jnp.sum(
+                        jnp.where(live, jnp.maximum(m.count, 1), 0))
+                    total = int(jax.device_get(left_total))
+                    out_cap = bucket(max(total, 1))
+                else:
+                    total = int(jax.device_get(m.total_pairs))
+                    out_cap = bucket(max(total, 1))
                 s_out, b_out, cnt = join_k.join_gather(
                     m, batch.columns, build.columns, out_cap, how,
                     n_stream=batch.num_rows)
-                n = int(cnt)
+            # the output count stays device-resident; downstream boundaries
+            # resolve it in batched readbacks (possibly-empty batches flow)
             if self.how in ("left_semi", "left_anti"):
-                out = ColumnarBatch(self._out_schema, s_out, n)
+                out = ColumnarBatch(self._out_schema, s_out, cnt)
             else:
-                out = ColumnarBatch(self._out_schema, s_out + b_out, n)
+                out = ColumnarBatch(self._out_schema, s_out + b_out, cnt)
             if self.condition is not None and self.how == "inner":
                 # conditional join: post-filter (reference: inner-only
-                # conditional joins via post-join filter)
+                # conditional joins via post-join filter). Row mask from the
+                # device-resident count — row_mask() would force a sync.
                 pred = self.condition.eval(out)
-                keep = pred.data & pred.validity & out.row_mask()
+                keep = pred.data & pred.validity & out.row_mask_raw()
                 cols, count = K.compact_columns(out.columns, keep)
-                n = int(count)
-                out = ColumnarBatch(self._out_schema, cols, n)
-            if n > 0:
-                self.metrics.inc("numOutputRows", n)
+                out = ColumnarBatch(self._out_schema, cols, count)
+            if not (isinstance(out.num_rows_raw, int)
+                    and out.num_rows_raw == 0):
+                self.metrics.inc("numOutputRows", out.num_rows_raw)
                 yield out
             if self.how == "full":
                 # append unmatched build rows with NULL left columns
