@@ -82,6 +82,25 @@ def gen_customer(sf: float, seed: int = 44) -> Dict[str, np.ndarray]:
     }
 
 
+_TYPE_SYLLABLES = (["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
+                    "PROMO"],
+                   ["ANODIZED", "BURNISHED", "PLATED", "POLISHED",
+                    "BRUSHED"],
+                   ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"])
+
+
+def gen_part(sf: float, seed: int = 45) -> Dict[str, np.ndarray]:
+    n = max(int(PART_PER_SF * sf), 1)
+    rng = np.random.default_rng(seed)
+    syl = [np.array(s)[rng.integers(0, len(s), n)] for s in _TYPE_SYLLABLES]
+    p_type = np.array([f"{a} {b} {c}" for a, b, c in zip(*syl)])
+    return {
+        "p_partkey": np.arange(1, n + 1, dtype=np.int64),
+        "p_type": p_type,
+        "p_retailprice": np.round(rng.uniform(900, 2000, n), 2),
+    }
+
+
 def to_arrow(cols: Dict[str, np.ndarray]):
     import pyarrow as pa
     arrays = {}
@@ -99,6 +118,7 @@ def register_tables(session, sf: float):
         "lineitem": to_arrow(gen_lineitem(sf)),
         "orders": to_arrow(gen_orders(sf)),
         "customer": to_arrow(gen_customer(sf)),
+        "part": to_arrow(gen_part(sf)),
     }
     dfs = {}
     for name, tbl in tables.items():

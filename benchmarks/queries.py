@@ -82,4 +82,37 @@ def q12(t):
             .orderBy("l_shipmode"))
 
 
-QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q12": q12}
+def q14(t):
+    """Promotion effect: join + conditional aggregate ratio."""
+    l = t["lineitem"].filter((col("l_shipdate") >= lit(_D_1995_09_01)) &
+                             (col("l_shipdate") < lit(_D_1995_09_01 + 30)))
+    p = t["part"]
+    disc_price = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    promo = F.when(col("p_type").startswith("PROMO"),
+                   disc_price).otherwise(lit(0.0))
+    return (l.join(p, on=(col("l_partkey") == col("p_partkey")))
+            .agg((lit(100.0) * F.sum(promo) / F.sum(disc_price))
+                 .alias("promo_revenue")))
+
+
+def q18(t):
+    """Large-volume customers: self-join through a filtered aggregate
+    (the HAVING-subquery shape), then a 3-way join + top-N. Threshold
+    tuned to this generator's order sizes (TPC-H uses 300)."""
+    l = t["lineitem"]
+    big = (l.groupBy("l_orderkey")
+           .agg(F.sum("l_quantity").alias("sum_qty"))
+           .filter(col("sum_qty") > lit(120)))
+    o = t["orders"]
+    c = t["customer"]
+    return (big.join(o, on=(col("l_orderkey") == col("o_orderkey")))
+            .join(c, on=(col("o_custkey") == col("c_custkey")))
+            .select(col("c_name"), col("c_custkey"), col("o_orderkey"),
+                    col("o_orderdate"), col("o_totalprice"),
+                    col("sum_qty"))
+            .orderBy(col("o_totalprice").desc(), col("o_orderdate").asc())
+            .limit(100))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q12": q12, "q14": q14,
+           "q18": q18}

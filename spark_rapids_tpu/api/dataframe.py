@@ -237,6 +237,35 @@ class DataFrame:
                                       cat.spilled_host_bytes)
         return exec_plan
 
+    def cache(self) -> "DataFrame":
+        """Materialize this DataFrame once and serve later queries from the
+        in-memory result, IN PLACE like Spark's df.cache() (InMemoryTableScan
+        analog — the reference accelerates cached tables via
+        GpuInMemoryTableScanExec; here the cached arrow table rides the
+        LocalScan prep cache, so repeated queries skip both re-execution
+        and host re-conversion). Returns self."""
+        if isinstance(self._plan, lp.LocalScan):
+            return self                     # already an in-memory table
+        table = self.collect_batch().to_arrow()
+        self._uncached_plan = self._plan
+        self._plan = lp.LocalScan(table)
+        return self
+
+    def persist(self, storageLevel=None) -> "DataFrame":
+        """Spark-compat alias of cache(); the storage level is accepted and
+        ignored (one in-memory tier here)."""
+        return self.cache()
+
+    def unpersist(self) -> "DataFrame":
+        """Drop the cached form: later queries re-execute the original
+        plan (no-op for frames never cached). The prep cache's weakref
+        finalizer releases the host bytes when the table is collected."""
+        orig = getattr(self, "_uncached_plan", None)
+        if orig is not None:
+            self._plan = orig
+            self._uncached_plan = None
+        return self
+
     def collect_batch(self):
         return self._execute().execute_collect()
 

@@ -2227,10 +2227,10 @@ class TpuSortMergeJoinExec(TpuExec):
                 keep = pred.data & pred.validity & out.row_mask_raw()
                 cols, count = K.compact_columns(out.columns, keep)
                 out = ColumnarBatch(self._out_schema, cols, count)
-            if not (isinstance(out.num_rows_raw, int)
-                    and out.num_rows_raw == 0):
-                self.metrics.inc("numOutputRows", out.num_rows_raw)
-                yield out
+            # counts are device-resident here: possibly-empty batches flow
+            # and downstream boundaries drop them after a batched resolve
+            self.metrics.inc("numOutputRows", out.num_rows_raw)
+            yield out
             if self.how == "full":
                 # append unmatched build rows with NULL left columns
                 un_cols, ucnt = join_k.unmatched_build_gather(

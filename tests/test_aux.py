@@ -232,3 +232,31 @@ def test_hash_optimize_sort_insertion():
     s2 = TpuSession.builder.getOrCreate()
     s2.createDataFrame(data).groupBy("k").agg(F.sum("v").alias("sv")).collect()
     assert not has_sort_above_agg(s2.last_plan())
+
+
+def test_dataframe_cache_golden():
+    """df.cache(): later queries serve from the materialized in-memory
+    table (cache_test analog; ref GpuInMemoryTableScanExec)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.plan import logical as lp
+
+    s = TpuSession.builder.getOrCreate()
+    base = s.createDataFrame({"k": [1, 2, 1, 3] * 25, "v": [2.0] * 100})
+    filtered = base.filter(col("v") > 0)
+    orig_plan = filtered._plan
+    filtered.cache()                 # Spark idiom: in-place side effect
+    assert isinstance(filtered._plan, lp.LocalScan)
+    out1 = dict(filtered.groupBy("k").agg(F.sum("v").alias("s")).collect())
+    out2 = dict(filtered.groupBy("k").agg(F.count("*").alias("c")).collect())
+    assert out1 == {1: 100.0, 2: 50.0, 3: 50.0}
+    assert out2 == {1: 50, 2: 25, 3: 25}
+    # cache of a cache is a no-op; persist accepts a storage level;
+    # unpersist restores the original plan
+    assert filtered.cache() is filtered
+    assert filtered.persist("MEMORY_ONLY") is filtered
+    filtered.unpersist()
+    assert filtered._plan is orig_plan
+    assert dict(filtered.groupBy("k").agg(
+        F.sum("v").alias("s")).collect()) == out1

@@ -1,0 +1,17 @@
+"""TPC-H-like benchmark queries, golden-compared at tiny scale (the
+tpch_test.py analog of the reference's integration suite, SURVEY.md §4)."""
+
+import pytest
+
+from benchmarks import datagen, queries as Q
+
+from golden import assert_tpu_and_cpu_equal
+
+_SF = 0.002
+
+
+@pytest.mark.parametrize("qname", sorted(Q.QUERIES))
+def test_tpch_query_golden(qname):
+    assert_tpu_and_cpu_equal(
+        lambda s: Q.QUERIES[qname](datagen.register_tables(s, _SF)),
+        approx=1e-5, ignore_order=False)
