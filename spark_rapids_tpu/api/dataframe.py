@@ -238,28 +238,31 @@ class DataFrame:
         return exec_plan
 
     def cache(self) -> "DataFrame":
-        """Materialize this DataFrame once and serve later queries from the
-        in-memory result, IN PLACE like Spark's df.cache() (InMemoryTableScan
-        analog — the reference accelerates cached tables via
-        GpuInMemoryTableScanExec; here the cached arrow table rides the
-        LocalScan prep cache, so repeated queries skip both re-execution
-        and host re-conversion). Returns self."""
-        if isinstance(self._plan, lp.LocalScan):
-            return self                     # already an in-memory table
-        table = self.collect_batch().to_arrow()
+        """Materialize this DataFrame once into a SPILLABLE device batch
+        and serve later queries straight from it, IN PLACE like Spark's
+        df.cache() (GpuInMemoryTableScanExec analog): no re-execution, no
+        host re-conversion, no re-upload; memory pressure spills the
+        cached batch through the normal tiers. Returns self."""
+        if isinstance(self._plan, lp.CachedScan):
+            return self                     # already cached
+        from ..exec.spill import CACHE_PRIORITY, SpillableColumnarBatch
+        batch = self.collect_batch()
+        handle = SpillableColumnarBatch(batch, CACHE_PRIORITY)
         self._uncached_plan = self._plan
-        self._plan = lp.LocalScan(table)
+        self._plan = lp.CachedScan(batch.schema, lp._CacheOwner(handle))
         return self
 
     def persist(self, storageLevel=None) -> "DataFrame":
         """Spark-compat alias of cache(); the storage level is accepted and
-        ignored (one in-memory tier here)."""
+        ignored (the spill tiers decide residency here)."""
         return self.cache()
 
     def unpersist(self) -> "DataFrame":
-        """Drop the cached form: later queries re-execute the original
-        plan (no-op for frames never cached). The prep cache's weakref
-        finalizer releases the host bytes when the table is collected."""
+        """Restore the original plan: later queries on THIS frame
+        re-execute it (no-op for frames never cached). The cached batch
+        itself is released when its last reference dies — derived frames
+        still sharing it keep working, matching Spark's always-safe
+        unpersist."""
         orig = getattr(self, "_uncached_plan", None)
         if orig is not None:
             self._plan = orig

@@ -858,7 +858,7 @@ def _from_arrow(table) -> pd.DataFrame:
 
 
 def _exec(plan: lp.LogicalPlan) -> pd.DataFrame:
-    if isinstance(plan, lp.LocalScan):
+    if isinstance(plan, (lp.LocalScan, lp.CachedScan)):
         return _from_arrow(plan.data)
     if isinstance(plan, lp.FileScan):
         from ..io import read_to_arrow

@@ -35,6 +35,7 @@ from ..columnar.column import Column
 # Spill priority constants (SpillPriorities.scala:26-60): lower spills first.
 OUTPUT_FOR_SHUFFLE_PRIORITY = -100.0   # shuffle outputs idle longest
 HOST_MEMORY_BUFFER_PRIORITY = -50.0
+CACHE_PRIORITY = -75.0                 # cached tables yield to active work
 ACTIVE_ON_DECK_PRIORITY = 100.0        # actively-used batches spill last
 
 

@@ -133,6 +133,65 @@ class LocalScan(LogicalPlan):
         return f"LocalScan [{', '.join(self.schema.names())}]"
 
 
+class _CacheOwner:
+    """Shared ownership token for a cached batch: every CachedScan copy
+    (plan analysis deep-copies trees) references the SAME owner, and a
+    weakref finalizer on it closes the spillable handle when the last
+    reference — frames, derived plans, executed-plan captures — dies.
+    No explicit unpersist is required for reclamation (Spark's
+    cache-lifetime contract: unpersist is advisory, GC is the backstop)."""
+
+    def __init__(self, handle):
+        import weakref
+        self.handle = handle
+        weakref.finalize(self, handle.close)
+
+
+class CachedScan(LogicalPlan):
+    """Scan over a df.cache()-materialized columnar batch held in the
+    SPILLABLE store: queries read the device-resident (or re-promoted)
+    batch with zero host conversion — the reference's cached-table path
+    (GpuInMemoryTableScanExec, spark310 shim). Falls back to an arrow
+    rendering for the CPU engine."""
+
+    def __init__(self, schema: "dt.Schema", owner: "_CacheOwner",
+                 name: str = "cached"):
+        super().__init__()
+        # NOT ``_schema`` — analyze() nulls that cache slot to force
+        # recomputation, which must return this fixed schema again
+        self._fixed_schema = schema
+        self.owner = owner
+        self.scan_name = name
+        self._arrow = None
+
+    @property
+    def handle(self):
+        return self.owner.handle
+
+    def _compute_schema(self) -> dt.Schema:
+        return self._fixed_schema
+
+    def stats_bytes(self) -> int:
+        return self.handle.size_bytes
+
+    @property
+    def data(self):
+        """Arrow rendering for CPU-engine / host consumers (built once)."""
+        if self._arrow is None:
+            self._arrow = self.handle.get_batch().to_arrow()
+        return self._arrow
+
+    def __deepcopy__(self, memo):
+        # plan analysis deep-copies trees; the owner (and its spillable
+        # handle) is SHARED state by design — never copied
+        c = CachedScan(self._fixed_schema, self.owner, self.scan_name)
+        c._arrow = self._arrow
+        return c
+
+    def _node_string(self):
+        return f"InMemoryTableScan [{', '.join(self.schema.names())}]"
+
+
 class FileScan(LogicalPlan):
     """File source scan (GpuFileSourceScanExec / GpuBatchScanExec analog)."""
 

@@ -209,6 +209,7 @@ class PlanMeta(BaseMeta):
 
     EXEC_NAMES = {
         lp.LocalScan: "LocalScanExec", lp.FileScan: "FileSourceScanExec",
+        lp.CachedScan: "InMemoryTableScanExec",
         lp.Project: "ProjectExec", lp.Filter: "FilterExec",
         lp.Aggregate: "HashAggregateExec", lp.Join: "SortMergeJoinExec",
         lp.Sort: "SortExec", lp.Limit: "GlobalLimitExec",
@@ -458,6 +459,8 @@ class Overrides:
     def _to_exec(self, meta: PlanMeta) -> ph.TpuExec:
         p = meta.plan
         kids = [self._convert(c) for c in meta.children]
+        if isinstance(p, lp.CachedScan):
+            return ph.TpuCachedScanExec(p)
         if isinstance(p, lp.LocalScan):
             return ph.TpuLocalScanExec(
                 p.data, p.schema,

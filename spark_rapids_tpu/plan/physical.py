@@ -828,6 +828,42 @@ class TpuLocalScanExec(TpuExec):
             yield batch
 
 
+class TpuCachedScanExec(TpuExec):
+    """Scan over a df.cache()-materialized spillable batch: the device (or
+    re-promoted) columns serve directly, no host conversion or upload
+    (GpuInMemoryTableScanExec, reference spark310 shim)."""
+
+    def __init__(self, plan):
+        super().__init__()
+        self.plan = plan
+
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    @property
+    def output_partitions(self) -> int:
+        return 1
+
+    def execute(self) -> List[Partition]:
+        def part():
+            _task_begin()
+            # no _reserve: a device-resident cached batch is already in
+            # the catalog's accounting, and acquire_batch performs
+            # admission itself when re-promoting a spilled one
+            batch = self.plan.handle.get_batch()
+            self.metrics.inc("numOutputRows", batch.num_rows_raw)
+            self.metrics.inc("numOutputBatches")
+            yield batch
+        return [part()]
+
+    # the handle is DataFrame-owned (released by unpersist/GC), never by
+    # query-scoped cleanup
+
+    def _node_string(self):
+        return "TpuCachedScanExec"
+
+
 class TpuRangeExec(TpuExec):
     """range() generated on device (GpuRangeExec, basicPhysicalOperators.scala:187)."""
 

@@ -247,7 +247,7 @@ def test_dataframe_cache_golden():
     filtered = base.filter(col("v") > 0)
     orig_plan = filtered._plan
     filtered.cache()                 # Spark idiom: in-place side effect
-    assert isinstance(filtered._plan, lp.LocalScan)
+    assert isinstance(filtered._plan, lp.CachedScan)
     out1 = dict(filtered.groupBy("k").agg(F.sum("v").alias("s")).collect())
     out2 = dict(filtered.groupBy("k").agg(F.count("*").alias("c")).collect())
     assert out1 == {1: 100.0, 2: 50.0, 3: 50.0}
@@ -256,7 +256,21 @@ def test_dataframe_cache_golden():
     # unpersist restores the original plan
     assert filtered.cache() is filtered
     assert filtered.persist("MEMORY_ONLY") is filtered
+    # a frame derived from the cached one keeps working after unpersist
+    derived = filtered.groupBy("k").agg(F.count("*").alias("c"))
     filtered.unpersist()
     assert filtered._plan is orig_plan
     assert dict(filtered.groupBy("k").agg(
         F.sum("v").alias("s")).collect()) == out1
+    assert dict(derived.collect()) == out2
+    # dropping every reference reclaims the cached batch (the session's
+    # last-plan capture holds one until the next query replaces it)
+    import gc
+    import weakref
+    owner_ref = weakref.ref(derived._plan.children[0].owner)
+    del derived
+    s._last_exec_plan = None
+    s._last_overrides = None
+    gc.collect()
+    gc.collect()
+    assert owner_ref() is None
