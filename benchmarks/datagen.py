@@ -88,16 +88,60 @@ _TYPE_SYLLABLES = (["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
                     "BRUSHED"],
                    ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"])
 
+CONTAINERS = ["SM CASE", "SM BOX", "MED BOX", "MED BAG", "LG CASE",
+              "LG BOX", "JUMBO PKG", "WRAP PACK"]
+
+NATIONS = ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+           "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ",
+           "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU",
+           "CHINA", "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA",
+           "UNITED KINGDOM", "UNITED STATES"]
+# region index per nation, TPC-H appendix layout
+_NATION_REGION = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4,
+                  2, 4, 0, 0, 0, 1, 2, 3, 4, 2, 3, 3, 1]
+
 
 def gen_part(sf: float, seed: int = 45) -> Dict[str, np.ndarray]:
     n = max(int(PART_PER_SF * sf), 1)
     rng = np.random.default_rng(seed)
     syl = [np.array(s)[rng.integers(0, len(s), n)] for s in _TYPE_SYLLABLES]
     p_type = np.array([f"{a} {b} {c}" for a, b, c in zip(*syl)])
+    brands = np.array([f"Brand#{i}{j}" for i, j in
+                       zip(rng.integers(1, 6, n), rng.integers(1, 6, n))])
     return {
         "p_partkey": np.arange(1, n + 1, dtype=np.int64),
         "p_type": p_type,
+        "p_brand": brands,
+        "p_container": np.array(CONTAINERS)[
+            rng.integers(0, len(CONTAINERS), n)],
+        "p_size": rng.integers(1, 51, n).astype(np.int64),
         "p_retailprice": np.round(rng.uniform(900, 2000, n), 2),
+    }
+
+
+def gen_supplier(sf: float, seed: int = 46) -> Dict[str, np.ndarray]:
+    n = max(int(SUPPLIER_PER_SF * sf), 1)
+    rng = np.random.default_rng(seed)
+    return {
+        "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
+        "s_name": np.array([f"Supplier#{i:09d}" for i in range(1, n + 1)]),
+        "s_nationkey": rng.integers(0, 25, n).astype(np.int64),
+        "s_acctbal": np.round(rng.uniform(-999, 9999, n), 2),
+    }
+
+
+def gen_nation() -> Dict[str, np.ndarray]:
+    return {
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": np.array(NATIONS),
+        "n_regionkey": np.array(_NATION_REGION, dtype=np.int64),
+    }
+
+
+def gen_region() -> Dict[str, np.ndarray]:
+    return {
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": np.array(REGIONS),
     }
 
 
@@ -119,10 +163,114 @@ def register_tables(session, sf: float):
         "orders": to_arrow(gen_orders(sf)),
         "customer": to_arrow(gen_customer(sf)),
         "part": to_arrow(gen_part(sf)),
+        "supplier": to_arrow(gen_supplier(sf)),
+        "nation": to_arrow(gen_nation()),
+        "region": to_arrow(gen_region()),
     }
     dfs = {}
     for name, tbl in tables.items():
         df = session.createDataFrame(tbl)
+        df.createOrReplaceTempView(name)
+        dfs[name] = df
+    return dfs
+
+
+# ---------------------------------------------------------------------------
+# TPC-DS-like tables (the subset q5/q97 exercise; analog of the reference's
+# TpcdsLikeSpark.scala table defs). SF1 ~= 2.9M store_sales rows.
+# ---------------------------------------------------------------------------
+
+STORE_SALES_PER_SF = 2_880_000
+CATALOG_SALES_PER_SF = 1_440_000
+WEB_SALES_PER_SF = 720_000
+RETURN_FRACTION = 10          # 1/10th of sales volume as returns
+DS_CUSTOMER_PER_SF = 100_000
+DS_ITEM_PER_SF = 18_000
+N_STORES = 12
+N_CATALOG_PAGES = 60
+N_WEB_SITES = 6
+_D_DATE_BASE = 2450815        # d_date_sk epoch used by date_dim
+
+
+def gen_date_dim() -> Dict[str, np.ndarray]:
+    """5 years of days: d_date_sk plus month_seq for q97's window."""
+    n = 365 * 5
+    sk = np.arange(_D_DATE_BASE, _D_DATE_BASE + n, dtype=np.int64)
+    return {
+        "d_date_sk": sk,
+        "d_month_seq": (1176 + (np.arange(n) // 30)).astype(np.int64),
+        "d_year": (1998 + np.arange(n) // 365).astype(np.int64),
+    }
+
+
+def _sales_channel(n: int, rng, key_prefix: str, n_units: int,
+                   date_span: int) -> Dict[str, np.ndarray]:
+    return {
+        f"{key_prefix}_sold_date_sk": (
+            _D_DATE_BASE + rng.integers(0, date_span, n)).astype(np.int64),
+        f"{key_prefix}_customer_sk": rng.integers(
+            1, DS_CUSTOMER_PER_SF + 1, n).astype(np.int64),
+        f"{key_prefix}_item_sk": rng.integers(
+            1, DS_ITEM_PER_SF + 1, n).astype(np.int64),
+        f"{key_prefix}_unit_sk": rng.integers(1, n_units + 1, n
+                                              ).astype(np.int64),
+        f"{key_prefix}_ext_sales_price": np.round(
+            rng.uniform(1, 300, n), 2),
+        f"{key_prefix}_net_profit": np.round(rng.uniform(-50, 120, n), 2),
+    }
+
+
+def _returns_channel(n: int, rng, key_prefix: str, n_units: int,
+                     date_span: int) -> Dict[str, np.ndarray]:
+    return {
+        f"{key_prefix}_returned_date_sk": (
+            _D_DATE_BASE + rng.integers(0, date_span, n)).astype(np.int64),
+        f"{key_prefix}_unit_sk": rng.integers(1, n_units + 1, n
+                                              ).astype(np.int64),
+        f"{key_prefix}_return_amt": np.round(rng.uniform(1, 200, n), 2),
+        f"{key_prefix}_net_loss": np.round(rng.uniform(0, 80, n), 2),
+    }
+
+
+def register_tpcds_tables(session, sf: float, date_span: int = 365 * 5):
+    """TPC-DS-like subset: three sales channels + returns + dims."""
+    rng = np.random.default_rng(52)
+    n_ss = max(int(STORE_SALES_PER_SF * sf), 10)
+    n_cs = max(int(CATALOG_SALES_PER_SF * sf), 10)
+    n_ws = max(int(WEB_SALES_PER_SF * sf), 10)
+    tables = {
+        "store_sales": _sales_channel(n_ss, rng, "ss", N_STORES, date_span),
+        "store_returns": _returns_channel(
+            n_ss // RETURN_FRACTION, rng, "sr", N_STORES, date_span),
+        "catalog_sales": _sales_channel(
+            n_cs, rng, "cs", N_CATALOG_PAGES, date_span),
+        "catalog_returns": _returns_channel(
+            n_cs // RETURN_FRACTION, rng, "cr", N_CATALOG_PAGES, date_span),
+        "web_sales": _sales_channel(n_ws, rng, "ws", N_WEB_SITES, date_span),
+        "web_returns": _returns_channel(
+            n_ws // RETURN_FRACTION, rng, "wr", N_WEB_SITES, date_span),
+        "date_dim": gen_date_dim(),
+        "store": {
+            "s_store_sk": np.arange(1, N_STORES + 1, dtype=np.int64),
+            "s_store_id": np.array(
+                [f"AAAAAAAA{i:04d}" for i in range(1, N_STORES + 1)]),
+        },
+        "catalog_page": {
+            "cp_catalog_page_sk": np.arange(1, N_CATALOG_PAGES + 1,
+                                            dtype=np.int64),
+            "cp_catalog_page_id": np.array(
+                [f"AAAAAAAA{i:04d}" for i in range(1, N_CATALOG_PAGES + 1)]),
+        },
+        "web_site": {
+            "web_site_sk": np.arange(1, N_WEB_SITES + 1, dtype=np.int64),
+            "web_site_id": np.array(
+                [f"AAAAAAAA{i:04d}" for i in range(1, N_WEB_SITES + 1)]),
+        },
+    }
+    dfs = {}
+    for name, cols in tables.items():
+        df = session.createDataFrame(to_arrow(
+            {k: np.asarray(v) for k, v in cols.items()}))
         df.createOrReplaceTempView(name)
         dfs[name] = df
     return dfs

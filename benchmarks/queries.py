@@ -114,5 +114,123 @@ def q18(t):
             .limit(100))
 
 
-QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q12": q12, "q14": q14,
-           "q18": q18}
+def q4(t):
+    """Order priority checking: EXISTS subquery -> left semi join."""
+    o = t["orders"].filter((col("o_orderdate") >= lit(_D_1994_01_01)) &
+                           (col("o_orderdate") < lit(_D_1994_01_01 + 91)))
+    l = t["lineitem"].filter(col("l_commitdate") < col("l_receiptdate"))
+    return (o.join(l, on=(col("o_orderkey") == col("l_orderkey")),
+                   how="left_semi")
+            .groupBy("o_orderpriority")
+            .agg(F.count("*").alias("order_count"))
+            .orderBy("o_orderpriority"))
+
+
+def q5(t):
+    """Local supplier volume: 6-way join through nation/region."""
+    revenue = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    r = t["region"].filter(col("r_name") == lit("ASIA"))
+    n = t["nation"].join(r, on=(col("n_regionkey") == col("r_regionkey")))
+    s = t["supplier"].join(n, on=(col("s_nationkey") == col("n_nationkey")))
+    o = t["orders"].filter((col("o_orderdate") >= lit(_D_1994_01_01)) &
+                           (col("o_orderdate") < lit(_D_1995_01_01)))
+    c = t["customer"]
+    return (c.join(o, on=(col("c_custkey") == col("o_custkey")))
+            .join(t["lineitem"],
+                  on=(col("o_orderkey") == col("l_orderkey")))
+            .join(s, on=[col("l_suppkey") == col("s_suppkey"),
+                         col("c_nationkey") == col("s_nationkey")])
+            .groupBy("n_name")
+            .agg(F.sum(revenue).alias("revenue"))
+            .orderBy(col("revenue").desc()))
+
+
+def q7(t):
+    """Volume shipping between two nations: nation joined twice."""
+    n1 = (t["nation"].filter(col("n_name").isin("FRANCE", "GERMANY"))
+          .withColumnRenamed("n_name", "supp_nation")
+          .withColumnRenamed("n_nationkey", "supp_nationkey"))
+    n2 = (t["nation"].filter(col("n_name").isin("FRANCE", "GERMANY"))
+          .withColumnRenamed("n_name", "cust_nation")
+          .withColumnRenamed("n_nationkey", "cust_nationkey"))
+    s = t["supplier"].join(
+        n1, on=(col("s_nationkey") == col("supp_nationkey")))
+    c = t["customer"].join(
+        n2, on=(col("c_nationkey") == col("cust_nationkey")))
+    # inclusive 1995-01-01 .. 1996-12-31: 365 + 366 days -> start + 730
+    l = t["lineitem"].filter((col("l_shipdate") >= lit(_D_1995_01_01)) &
+                             (col("l_shipdate") <= lit(_D_1995_01_01 + 730)))
+    volume = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    joined = (l.join(s, on=(col("l_suppkey") == col("s_suppkey")))
+              .join(t["orders"],
+                    on=(col("l_orderkey") == col("o_orderkey")))
+              .join(c, on=(col("o_custkey") == col("c_custkey")))
+              .filter(((col("supp_nation") == lit("FRANCE")) &
+                       (col("cust_nation") == lit("GERMANY"))) |
+                      ((col("supp_nation") == lit("GERMANY")) &
+                       (col("cust_nation") == lit("FRANCE")))))
+    return (joined
+            .withColumn("l_year", F.year(col("l_shipdate")))
+            .groupBy("supp_nation", "cust_nation", "l_year")
+            .agg(F.sum(volume).alias("revenue"))
+            .orderBy("supp_nation", "cust_nation", "l_year"))
+
+
+def q10(t):
+    """Returned item reporting: 4-way join + revenue top-20."""
+    o = t["orders"].filter((col("o_orderdate") >= lit(_D_1994_01_01)) &
+                           (col("o_orderdate") < lit(_D_1994_01_01 + 91)))
+    l = t["lineitem"].filter(col("l_returnflag") == lit("R"))
+    revenue = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    return (t["customer"]
+            .join(o, on=(col("c_custkey") == col("o_custkey")))
+            .join(l, on=(col("o_orderkey") == col("l_orderkey")))
+            .join(t["nation"],
+                  on=(col("c_nationkey") == col("n_nationkey")))
+            .groupBy("c_custkey", "c_name", "c_acctbal", "n_name")
+            .agg(F.sum(revenue).alias("revenue"))
+            .orderBy(col("revenue").desc(), col("c_custkey").asc())
+            .limit(20))
+
+
+def q17(t):
+    """Small-quantity-order revenue: correlated avg subquery -> per-part
+    aggregate joined back (the reference plans the same decorrelation)."""
+    p = t["part"].filter((col("p_brand") == lit("Brand#23")) &
+                         (col("p_container") == lit("MED BOX")))
+    l = t["lineitem"]
+    avg_qty = (l.groupBy("l_partkey")
+               .agg((lit(0.2) * F.avg("l_quantity")).alias("qty_limit"))
+               .withColumnRenamed("l_partkey", "al_partkey"))
+    return (l.join(p, on=(col("l_partkey") == col("p_partkey")))
+            .join(avg_qty, on=(col("l_partkey") == col("al_partkey")))
+            .filter(col("l_quantity") < col("qty_limit"))
+            .agg((F.sum("l_extendedprice") / lit(7.0)).alias("avg_yearly")))
+
+
+def q19(t):
+    """Discounted revenue: disjunctive join predicate over part attrs."""
+    l = t["lineitem"].filter(
+        col("l_shipmode").isin("AIR", "REG AIR"))
+    p = t["part"]
+    revenue = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    cond1 = ((col("p_brand") == lit("Brand#12")) &
+             col("p_container").isin("SM CASE", "SM BOX") &
+             (col("l_quantity") >= lit(1)) & (col("l_quantity") <= lit(11)) &
+             (col("p_size") <= lit(5)))
+    cond2 = ((col("p_brand") == lit("Brand#23")) &
+             col("p_container").isin("MED BAG", "MED BOX") &
+             (col("l_quantity") >= lit(10)) & (col("l_quantity") <= lit(20)) &
+             (col("p_size") <= lit(10)))
+    cond3 = ((col("p_brand") == lit("Brand#34")) &
+             col("p_container").isin("LG CASE", "LG BOX") &
+             (col("l_quantity") >= lit(20)) & (col("l_quantity") <= lit(30)) &
+             (col("p_size") <= lit(15)))
+    return (l.join(p, on=(col("l_partkey") == col("p_partkey")))
+            .filter(cond1 | cond2 | cond3)
+            .agg(F.sum(revenue).alias("revenue")))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
+           "q10": q10, "q12": q12, "q14": q14, "q17": q17, "q18": q18,
+           "q19": q19}

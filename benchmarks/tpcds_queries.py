@@ -1,0 +1,115 @@
+"""TPC-DS-like query definitions on the DataFrame API (BASELINE.md
+milestone 2: q5 + q97).
+
+Analog of the reference's TpcdsLikeSpark.scala query objects
+(integration_tests/.../tpcds/). Each query takes the dict of DataFrames
+from datagen.register_tpcds_tables and returns a DataFrame.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+
+from . import datagen
+
+_D0 = datagen._D_DATE_BASE
+
+
+def _channel_rollup(sales, returns, dim, dim_key, dim_id, pfx, rpfx):
+    """One q5 channel: sales UNION ALL returns -> 14-day date window ->
+    unit dim join -> per-unit-id totals."""
+    s = sales.select(
+        col(f"{pfx}_sold_date_sk").alias("date_sk"),
+        col(f"{pfx}_unit_sk").alias("unit_sk"),
+        col(f"{pfx}_ext_sales_price").alias("sales_price"),
+        col(f"{pfx}_net_profit").alias("profit"),
+        lit(0.0).alias("return_amt"),
+        lit(0.0).alias("net_loss"))
+    r = returns.select(
+        col(f"{rpfx}_returned_date_sk").alias("date_sk"),
+        col(f"{rpfx}_unit_sk").alias("unit_sk"),
+        lit(0.0).alias("sales_price"),
+        lit(0.0).alias("profit"),
+        col(f"{rpfx}_return_amt").alias("return_amt"),
+        col(f"{rpfx}_net_loss").alias("net_loss"))
+    d = dim.withColumnRenamed(dim_key, "unit_dim_sk")
+    window = (col("date_sk") >= lit(_D0 + 60)) & \
+        (col("date_sk") <= lit(_D0 + 74))
+    return (s.union(r).filter(window)
+            .join(d, on=(col("unit_sk") == col("unit_dim_sk")))
+            .groupBy(dim_id)
+            .agg(F.sum("sales_price").alias("sales"),
+                 F.sum("return_amt").alias("returns"),
+                 (F.sum("profit") - F.sum("net_loss")).alias("profit")))
+
+
+def tpcds_q5(t):
+    """Rollup of sales/returns/profit across the three channels
+    (TpcdsLikeSpark Query5: channel unions -> date window -> dim joins ->
+    ROLLUP(channel, id))."""
+    ssr = _channel_rollup(t["store_sales"], t["store_returns"], t["store"],
+                          "s_store_sk", "s_store_id", "ss", "sr") \
+        .select(lit("store channel").alias("channel"),
+                F.concat(lit("store"), col("s_store_id")).alias("id"),
+                col("sales"), col("returns"), col("profit"))
+    csr = _channel_rollup(t["catalog_sales"], t["catalog_returns"],
+                          t["catalog_page"], "cp_catalog_page_sk",
+                          "cp_catalog_page_id", "cs", "cr") \
+        .select(lit("catalog channel").alias("channel"),
+                F.concat(lit("catalog_page"),
+                         col("cp_catalog_page_id")).alias("id"),
+                col("sales"), col("returns"), col("profit"))
+    wsr = _channel_rollup(t["web_sales"], t["web_returns"], t["web_site"],
+                          "web_site_sk", "web_site_id", "ws", "wr") \
+        .select(lit("web channel").alias("channel"),
+                F.concat(lit("web_site"), col("web_site_id")).alias("id"),
+                col("sales"), col("returns"), col("profit"))
+    return (ssr.union(csr).union(wsr)
+            .rollup("channel", "id")
+            .agg(F.sum("sales").alias("sales"),
+                 F.sum("returns").alias("returns"),
+                 F.sum("profit").alias("profit"))
+            .orderBy(col("channel").asc_nulls_last(),
+                     col("id").asc_nulls_last())
+            .limit(100))
+
+
+def tpcds_q97(t):
+    """Store/catalog purchase overlap: per-channel distinct
+    (customer, item) pairs over a 12-month window, FULL OUTER joined,
+    counted by presence (TpcdsLikeSpark Query97)."""
+    d = t["date_dim"].filter((col("d_month_seq") >= lit(1190)) &
+                             (col("d_month_seq") <= lit(1201)))
+    ssci = (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .groupBy("ss_customer_sk", "ss_item_sk").agg(
+                F.count("*").alias("_ss_n"))
+            .select(col("ss_customer_sk").alias("s_customer_sk"),
+                    col("ss_item_sk").alias("s_item_sk")))
+    csci = (t["catalog_sales"]
+            .join(d, on=(col("cs_sold_date_sk") == col("d_date_sk")))
+            .groupBy("cs_customer_sk", "cs_item_sk").agg(
+                F.count("*").alias("_cs_n"))
+            .select(col("cs_customer_sk").alias("c_customer_sk"),
+                    col("cs_item_sk").alias("c_item_sk")))
+    both = ssci.join(
+        csci,
+        on=[col("s_customer_sk") == col("c_customer_sk"),
+            col("s_item_sk") == col("c_item_sk")],
+        how="full")
+    store_only = F.when(col("s_customer_sk").isNotNull() &
+                        col("c_customer_sk").isNull(),
+                        lit(1)).otherwise(lit(0))
+    catalog_only = F.when(col("s_customer_sk").isNull() &
+                          col("c_customer_sk").isNotNull(),
+                          lit(1)).otherwise(lit(0))
+    store_and_catalog = F.when(col("s_customer_sk").isNotNull() &
+                               col("c_customer_sk").isNotNull(),
+                               lit(1)).otherwise(lit(0))
+    return both.agg(F.sum(store_only).alias("store_only"),
+                    F.sum(catalog_only).alias("catalog_only"),
+                    F.sum(store_and_catalog).alias("store_and_catalog"))
+
+
+TPCDS_QUERIES = {"tpcds_q5": tpcds_q5, "tpcds_q97": tpcds_q97}

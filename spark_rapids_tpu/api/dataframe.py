@@ -9,7 +9,10 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+import copy
+
 from ..columnar import dtypes as dt
+from ..ops import conditionals as cd
 from ..ops import expressions as ex
 from ..ops import predicates as pr
 from ..plan import logical as lp
@@ -127,6 +130,16 @@ class DataFrame:
 
     groupby = groupBy
 
+    def rollup(self, *cols: ColumnOrName) -> "GroupedData":
+        """Hierarchical grouping sets {(a,b), (a), ()} via an Expand
+        under the aggregate (GpuExpandExec path; Spark df.rollup)."""
+        return GroupedData(self, [_to_expr(c) for c in cols],
+                           sets="rollup")
+
+    def cube(self, *cols: ColumnOrName) -> "GroupedData":
+        """All 2^n grouping-set combinations (Spark df.cube)."""
+        return GroupedData(self, [_to_expr(c) for c in cols], sets="cube")
+
     def agg(self, *aggs: Col) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
@@ -223,11 +236,14 @@ class DataFrame:
 
     # -- actions -------------------------------------------------------------
     def _execute(self):
+        import time
+        t0 = time.perf_counter()
         plan = self._analyzed()
         from ..exec.spill import BufferCatalog
         from ..plan.overrides import Overrides
         ov = Overrides(self.session.conf)
         exec_plan = ov.apply(plan)
+        self.session._last_plan_time_s = time.perf_counter() - t0
         self.session._last_exec_plan = exec_plan
         self.session._last_overrides = ov
         # spill counters are process-cumulative; snapshot them so
@@ -270,7 +286,12 @@ class DataFrame:
         return self
 
     def collect_batch(self):
-        return self._execute().execute_collect()
+        from ..exec.tracing import SyncCounter
+        exec_plan = self._execute()
+        with SyncCounter() as sc:
+            out = exec_plan.execute_collect()
+        self.session._last_sync_report = sc.report()
+        return out
 
     def collect(self) -> List[tuple]:
         return self.collect_batch().rows()
@@ -330,19 +351,65 @@ def _dedupe_using(plan: lp.Join, using: List[str], how: str,
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, grouping: List[ex.Expression]):
+    def __init__(self, df: DataFrame, grouping: List[ex.Expression],
+                 sets: Optional[str] = None):
         self.df = df
         self.grouping = grouping
+        self.sets = sets          # None | "rollup" | "cube"
 
     def agg(self, *aggs: Union[Col, Dict[str, str]]) -> DataFrame:
-        out: List[ex.Expression] = list(self.grouping)
         if len(aggs) == 1 and isinstance(aggs[0], dict):
             aggs = tuple(
                 getattr(F, op if op != "mean" else "avg")(F.col(c))
                 for c, op in aggs[0].items())
-        for a in aggs:
-            out.append(_unwrap(a))
+        agg_exprs = [_unwrap(a) for a in aggs]
+        if self.sets:
+            return self._agg_grouping_sets(agg_exprs)
+        out: List[ex.Expression] = list(self.grouping) + agg_exprs
         return self.df._df(lp.Aggregate(self.df._plan, self.grouping, out))
+
+    def _agg_grouping_sets(self, agg_exprs: List[ex.Expression]) -> DataFrame:
+        """rollup/cube: Expand replicates every input row once per grouping
+        set, nulling the grouped-out keys and tagging a grouping id; one
+        hash aggregate over (keys..., gid) then computes all sets at once
+        (the reference's GpuExpandExec + GpuHashAggregateExec pipeline,
+        GpuExpandExec.scala)."""
+        import itertools
+        nk = len(self.grouping)
+        if self.sets == "rollup":
+            masks = [tuple(i < keep for i in range(nk))
+                     for keep in range(nk, -1, -1)]
+        else:
+            masks = [tuple(bits) for bits in
+                     itertools.product((True, False), repeat=nk)]
+        child_cols = self.df.columns
+        key_names = [ex.output_name(g, i)
+                     for i, g in enumerate(self.grouping)]
+        out_names = list(child_cols) + \
+            [f"_g{i}" for i in range(nk)] + ["_gid"]
+        projections: List[List[ex.Expression]] = []
+        for mask in masks:
+            proj: List[ex.Expression] = [ex.ColumnRef(c)
+                                         for c in child_cols]
+            gid = 0
+            for i, keep in enumerate(mask):
+                if keep:
+                    proj.append(copy.deepcopy(self.grouping[i]))
+                else:
+                    # typed NULL of the key's dtype: a never-true branch
+                    # keeps the analyzer's coercion rules in charge
+                    proj.append(cd.CaseWhen(
+                        [(ex.lit(False), copy.deepcopy(self.grouping[i]))],
+                        None))
+                    gid |= 1 << (nk - 1 - i)
+            proj.append(ex.lit(gid))
+            projections.append(proj)
+        expand = lp.Expand(self.df._plan, projections, out_names)
+        grouping = [ex.ColumnRef(f"_g{i}") for i in range(nk)] + \
+            [ex.ColumnRef("_gid")]
+        outputs = [ex.Alias(ex.ColumnRef(f"_g{i}"), key_names[i])
+                   for i in range(nk)] + agg_exprs
+        return self.df._df(lp.Aggregate(expand, grouping, outputs))
 
     def count(self) -> DataFrame:
         return self.agg(Col(ex.Alias(

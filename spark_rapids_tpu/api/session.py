@@ -197,6 +197,13 @@ class TpuSession:
                 "spilledDeviceBytes": cat.spilled_device_bytes - base_dev,
                 "spilledHostBytes": cat.spilled_host_bytes - base_host,
             },
+            # attributed blocking device->host readbacks during the collect
+            # (the dominant end-to-end cost on high-latency links; see
+            # exec/tracing.SyncCounter)
+            "sync": getattr(self, "_last_sync_report",
+                            {"hostSyncs": 0, "syncSites": {}}),
+            # driver-side planning (analyze + overrides) wall time
+            "planTimeS": round(getattr(self, "_last_plan_time_s", 0.0), 4),
         }
 
     def explain_metrics(self) -> str:

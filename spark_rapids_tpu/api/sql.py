@@ -1,0 +1,662 @@
+"""Minimal SQL front end for TpuSession.sql().
+
+The reference accelerates SQL text through Spark Catalyst (its whole entry
+point: ``SQLExecPlugin`` injecting rules into the session,
+sql-plugin/.../Plugin.scala:40-59); standalone, we ship a small SQL
+dialect over registered temp views instead of a full Catalyst clone:
+
+    SELECT [DISTINCT] expr [AS alias], ...
+    FROM view [alias] [, view ...]
+         [ [INNER|LEFT|RIGHT|FULL [OUTER]|CROSS] JOIN ref
+           (ON cond | USING (cols)) ]...
+    [WHERE cond] [GROUP BY expr|position, ...] [HAVING cond]
+    [ORDER BY expr [ASC|DESC], ...] [LIMIT n]
+
+Expressions: arithmetic, comparisons, AND/OR/NOT, BETWEEN, IN (...),
+LIKE, IS [NOT] NULL, CASE WHEN, CAST(x AS type), DATE 'yyyy-mm-dd',
+INTERVAL 'n' DAY/MONTH/YEAR, aggregate and scalar function calls mapped
+onto ``api.functions``, ``*`` and qualified ``t.col`` references
+(resolved by name: the single-session catalog has no per-table scoping).
+Subqueries are supported in FROM only. Everything else raises
+``SqlParseError`` — the caller sees a clear message, never a silently
+wrong plan.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from . import functions as F
+from .column import Col, _unwrap
+from ..ops import expressions as ex
+from ..ops import predicates as pr
+from ..plan import logical as lp
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    \s+
+  | (?P<comment>--[^\n]*)
+  | (?P<number>\d+\.\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|\d+([eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
+  | (?P<op><>|!=|>=|<=|=|<|>|\|\||[+\-*/%(),.])
+""", re.VERBOSE)
+
+
+class _Tok:
+    def __init__(self, kind: str, text: str, pos: int):
+        self.kind = kind          # number | string | ident | op | end
+        self.text = text
+        self.upper = text.upper() if kind == "ident" else text
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.text!r}"
+
+
+def _lex(sql: str) -> List[_Tok]:
+    out: List[_Tok] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise SqlParseError(f"cannot tokenize SQL at: {sql[i:i+20]!r}")
+        i = m.end()
+        if m.lastgroup in (None, "comment"):
+            continue
+        text = m.group()
+        if m.lastgroup == "ident" and text.startswith("`"):
+            text = text[1:-1]
+        out.append(_Tok(m.lastgroup, text, m.start()))
+    out.append(_Tok("end", "", len(sql)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+_AGG_FNS = {"SUM", "COUNT", "AVG", "MEAN", "MIN", "MAX", "FIRST", "LAST"}
+
+# SQL name -> api.functions name, where they differ
+_FN_ALIASES = {
+    "SUBSTR": "substring", "CHAR_LENGTH": "length", "CHARACTER_LENGTH":
+    "length", "LCASE": "lower", "UCASE": "upper", "CEILING": "ceil",
+    "POWER": "pow", "MEAN": "avg", "DAY": "dayofmonth",
+    "NVL": "nvl", "IFNULL": "nvl",
+}
+
+_RESERVED_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "JOIN", "ON",
+    "USING", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "OUTER", "AND",
+    "OR", "NOT", "AS", "ASC", "DESC", "THEN", "ELSE", "END", "WHEN",
+    "BY", "UNION",
+}
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok], session):
+        self.toks = toks
+        self.i = 0
+        self.session = session
+        # single-namespace resolution safety: qualified refs seen while
+        # parsing + the FROM tables' column sets, checked per SELECT
+        self._qualified_refs: List[str] = []
+        self._from_columns: List[set] = []
+        self._has_cross = False
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self, ahead: int = 0) -> _Tok:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        if t.kind != "end":
+            self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in kws
+
+    def take_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.take_kw(kw):
+            raise SqlParseError(
+                f"expected {kw} near {self.peek().text!r}")
+
+    def take_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "op" and t.text == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.take_op(op):
+            raise SqlParseError(
+                f"expected {op!r} near {self.peek().text!r}")
+
+    # -- statement ----------------------------------------------------------
+    def parse_select(self):
+        """Returns a DataFrame."""
+        outer_refs = self._qualified_refs
+        outer_cols = self._from_columns
+        outer_cross = self._has_cross
+        self._qualified_refs, self._from_columns = [], []
+        self._has_cross = False
+        self.expect_kw("SELECT")
+        distinct = self.take_kw("DISTINCT")
+        items = self.parse_select_list()
+        self.expect_kw("FROM")
+        df = self.parse_from()
+        if self.take_kw("WHERE"):
+            df = df.filter(Col(self.parse_expr()))
+        self._check_qualified_refs()
+        self._qualified_refs, self._from_columns = outer_refs, outer_cols
+        self._has_cross = outer_cross
+        group_exprs = None
+        if self.take_kw("GROUP"):
+            self.expect_kw("BY")
+            group_exprs = self.parse_group_by(items)
+        having = self.parse_expr() if self.take_kw("HAVING") else None
+        df = self.build_projection(df, items, group_exprs, having)
+        if distinct:
+            df = df.distinct()
+        if self.take_kw("ORDER"):
+            self.expect_kw("BY")
+            df = df.orderBy(*self.parse_order_by(items))
+        if self.take_kw("LIMIT"):
+            t = self.next()
+            if t.kind != "number":
+                raise SqlParseError(f"LIMIT expects a number, got {t.text!r}")
+            df = df.limit(int(t.text))
+        return df
+
+    def parse_select_list(self):
+        items: List[tuple] = []   # (expr | "*", alias | None)
+        while True:
+            if self.take_op("*"):
+                items.append(("*", None))
+            elif (self.peek().kind == "ident"
+                  and self.peek(1).text == "."
+                  and self.peek(2).text == "*"):
+                self.next(); self.next(); self.next()
+                items.append(("*", None))   # t.*: single-namespace catalog
+            else:
+                e = self.parse_expr()
+                alias = None
+                if self.take_kw("AS"):
+                    alias = self.next().text
+                elif (self.peek().kind == "ident"
+                      and self.peek().upper not in _RESERVED_STOP):
+                    alias = self.next().text
+                items.append((e, alias))
+            if not self.take_op(","):
+                return items
+
+    # -- FROM / joins -------------------------------------------------------
+    def parse_table_ref(self):
+        if self.take_op("("):
+            df = self.parse_select()
+            self.expect_op(")")
+        else:
+            t = self.next()
+            if t.kind != "ident":
+                raise SqlParseError(f"expected table name, got {t.text!r}")
+            try:
+                df = self.session.table(t.text)
+            except KeyError:
+                raise SqlParseError(f"unknown table or view: {t.text!r}")
+        if self.take_kw("AS"):
+            self.next()                       # alias name (namespace-free)
+        elif (self.peek().kind == "ident"
+              and self.peek().upper not in _RESERVED_STOP):
+            self.next()
+        self._from_columns.append(set(df.columns))
+        return df
+
+    def parse_from(self):
+        df = self.parse_table_ref()
+        while True:
+            if self.take_op(","):             # comma = cross join + WHERE
+                self._has_cross = True
+                df = df.crossJoin(self.parse_table_ref())
+                continue
+            how = None
+            if self.at_kw("JOIN"):
+                how = "inner"
+            elif self.at_kw("INNER", "LEFT", "RIGHT", "FULL", "CROSS"):
+                kw = self.next().upper
+                self.take_kw("OUTER")
+                how = {"INNER": "inner", "LEFT": "left", "RIGHT": "right",
+                       "FULL": "full", "CROSS": "cross"}[kw]
+            if how is None:
+                return df
+            self.expect_kw("JOIN")
+            other = self.parse_table_ref()
+            if how == "cross":
+                self._has_cross = True
+                df = df.crossJoin(other)
+            elif self.take_kw("ON"):
+                # ON conditions resolve left/right by the planner's
+                # equi-key extraction — qualified refs there are sound,
+                # so drop them from the ambiguity check
+                mark = len(self._qualified_refs)
+                cond = self.parse_expr()
+                del self._qualified_refs[mark:]
+                df = df.join(other, on=Col(cond), how=how)
+            elif self.take_kw("USING"):
+                self.expect_op("(")
+                cols = [self.next().text]
+                while self.take_op(","):
+                    cols.append(self.next().text)
+                self.expect_op(")")
+                df = df.join(other, on=cols, how=how)
+            else:
+                raise SqlParseError("JOIN requires ON or USING")
+
+    # -- GROUP BY / projection ---------------------------------------------
+    def parse_group_by(self, items) -> List[ex.Expression]:
+        out: List[ex.Expression] = []
+        while True:
+            t = self.peek()
+            if t.kind == "number" and "." not in t.text:
+                self.next()                   # positional: GROUP BY 1
+                pos = int(t.text)
+                if not (1 <= pos <= len(items)) or items[pos - 1][0] == "*":
+                    raise SqlParseError(f"GROUP BY position {pos} invalid")
+                out.append(items[pos - 1][0])
+            else:
+                out.append(self.parse_expr())
+            if not self.take_op(","):
+                return out
+
+    def build_projection(self, df, items, group_exprs, having):
+        has_star = any(e == "*" for e, _ in items)
+        exprs: List[ex.Expression] = []
+        for e, alias in items:
+            if e == "*":
+                continue
+            exprs.append(ex.Alias(e, alias) if alias else e)
+        is_agg = group_exprs is not None or any(
+            _has_agg(e) for e in exprs)
+        if not is_agg:
+            if has_star and len(items) == 1:
+                return df
+            if has_star:
+                cols = [ex.ColumnRef(c) for c in df.columns]
+                return df._df(lp.Project(df._plan, cols + exprs))
+            return df.select(*[Col(e) for e in exprs])
+        if has_star:
+            raise SqlParseError("SELECT * cannot mix with aggregation")
+        grouping = group_exprs or []
+        out = df._df(lp.Aggregate(df._plan, grouping, list(exprs)))
+        if having is not None:
+            # HAVING may reference select aliases or re-state aggregates;
+            # re-stated aggregates must be computed IN the aggregation, so
+            # fold them in as hidden columns, filter, then drop
+            extra, cond = _extract_having(having, exprs)
+            if extra:
+                out = df._df(lp.Aggregate(
+                    df._plan, grouping, list(exprs) + extra))
+                keep = [ex.ColumnRef(ex.output_name(e, i))
+                        for i, e in enumerate(exprs)]
+                return out.filter(Col(cond)).select(*[Col(k) for k in keep])
+            return out.filter(Col(cond))
+        return out
+
+    def parse_order_by(self, items):
+        orders = []
+        while True:
+            t = self.peek()
+            if t.kind == "number" and "." not in t.text:
+                self.next()
+                pos = int(t.text)
+                if not (1 <= pos <= len(items)) or items[pos - 1][0] == "*":
+                    raise SqlParseError(f"ORDER BY position {pos} invalid")
+                e, alias = items[pos - 1]
+                e = ex.ColumnRef(alias) if alias \
+                    else ex.ColumnRef(ex.output_name(e, pos - 1))
+            else:
+                e = self.parse_expr()
+            asc = True
+            if self.take_kw("DESC"):
+                asc = False
+            else:
+                self.take_kw("ASC")
+            orders.append(lp.SortOrder(e, asc))
+            if not self.take_op(","):
+                return orders
+
+    # -- expressions (precedence climbing) ----------------------------------
+    def parse_expr(self) -> ex.Expression:
+        return self.parse_or()
+
+    def parse_or(self) -> ex.Expression:
+        e = self.parse_and()
+        while self.take_kw("OR"):
+            e = pr.Or(e, self.parse_and())
+        return e
+
+    def parse_and(self) -> ex.Expression:
+        e = self.parse_not()
+        while self.take_kw("AND"):
+            e = pr.And(e, self.parse_not())
+        return e
+
+    def parse_not(self) -> ex.Expression:
+        if self.take_kw("NOT"):
+            return pr.Not(self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> ex.Expression:
+        e = self.parse_additive()
+        neg = False
+        if self.at_kw("NOT") and self.peek(1).upper in (
+                "BETWEEN", "IN", "LIKE"):
+            self.next()
+            neg = True
+        if self.take_kw("BETWEEN"):
+            lo = self.parse_additive()
+            self.expect_kw("AND")
+            hi = self.parse_additive()
+            out = pr.And(pr.GreaterThanOrEqual(e, lo),
+                         pr.LessThanOrEqual(e, hi))
+            return pr.Not(out) if neg else out
+        if self.take_kw("IN"):
+            self.expect_op("(")
+            vals = [self.parse_expr()]
+            while self.take_op(","):
+                vals.append(self.parse_expr())
+            self.expect_op(")")
+            lits = []
+            for v in vals:
+                if not isinstance(v, ex.Literal):
+                    raise SqlParseError("IN list must be literals")
+                lits.append(v.value)
+            out = _unwrap(Col(e).isin(*lits))
+            return pr.Not(out) if neg else out
+        if self.take_kw("LIKE"):
+            p = self.parse_additive()
+            if not isinstance(p, ex.Literal):
+                raise SqlParseError("LIKE pattern must be a string literal")
+            out = _unwrap(Col(e).like(p.value))
+            return pr.Not(out) if neg else out
+        if self.take_kw("IS"):
+            isnot = self.take_kw("NOT")
+            self.expect_kw("NULL")
+            return pr.IsNotNull(e) if isnot else pr.IsNull(e)
+        t = self.peek()
+        if t.kind == "op" and t.text in ("=", "<>", "!=", "<", "<=", ">",
+                                         ">="):
+            self.next()
+            r = self.parse_additive()
+            cls = {"=": pr.EqualTo, "<>": pr.NotEqual, "!=": pr.NotEqual,
+                   "<": pr.LessThan, "<=": pr.LessThanOrEqual,
+                   ">": pr.GreaterThan, ">=": pr.GreaterThanOrEqual}[t.text]
+            return cls(e, r)
+        return e
+
+    def parse_additive(self) -> ex.Expression:
+        e = self.parse_multiplicative()
+        while True:
+            if self.take_op("+"):
+                r = self.parse_multiplicative()
+                e = _date_arith(e, r, +1) if isinstance(r, _Interval) \
+                    else _unwrap(Col(e) + Col(r))
+            elif self.take_op("-"):
+                r = self.parse_multiplicative()
+                e = _date_arith(e, r, -1) if isinstance(r, _Interval) \
+                    else _unwrap(Col(e) - Col(r))
+            elif self.take_op("||"):
+                e = _unwrap(F.concat(Col(e),
+                                     Col(self.parse_multiplicative())))
+            else:
+                return e
+
+    def parse_multiplicative(self) -> ex.Expression:
+        e = self.parse_unary()
+        while True:
+            if self.take_op("*"):
+                e = _unwrap(Col(e) * Col(self.parse_unary()))
+            elif self.take_op("/"):
+                e = _unwrap(Col(e) / Col(self.parse_unary()))
+            elif self.take_op("%"):
+                e = _unwrap(Col(e) % Col(self.parse_unary()))
+            else:
+                return e
+
+    def parse_unary(self) -> ex.Expression:
+        if self.take_op("-"):
+            e = self.parse_unary()
+            if isinstance(e, ex.Literal) and isinstance(
+                    e.value, (int, float)) and not isinstance(e.value, bool):
+                return ex.lit(-e.value)       # fold: IN lists need literals
+            return _unwrap(-Col(e))
+        if self.take_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> ex.Expression:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            if "." in t.text or "e" in t.text or "E" in t.text:
+                return ex.lit(float(t.text))
+            return ex.lit(int(t.text))
+        if t.kind == "string":
+            self.next()
+            return ex.lit(t.text[1:-1].replace("''", "'"))
+        if self.take_op("("):
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind != "ident":
+            raise SqlParseError(f"unexpected token {t.text!r}")
+        up = t.upper
+        if up == "NULL":
+            self.next()
+            return ex.lit(None)
+        if up in ("TRUE", "FALSE"):
+            self.next()
+            return ex.lit(up == "TRUE")
+        if up == "DATE" and self.peek(1).kind == "string":
+            self.next()
+            s = self.next().text[1:-1]
+            return _unwrap(F.lit(s).cast("date"))
+        if up == "INTERVAL":
+            return self.parse_interval()
+        if up == "CASE":
+            return self.parse_case()
+        if up == "CAST":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("AS")
+            ty = self.next().text.lower()
+            self.expect_op(")")
+            return _unwrap(Col(e).cast(ty))
+        if self.peek(1).text == "(" and up not in _RESERVED_STOP:
+            return self.parse_call()
+        # [qualifier.]column — single-namespace resolution: the qualifier
+        # is dropped, which is only sound when the bare name is unambiguous
+        # across the FROM tables (checked after FROM parses)
+        self.next()
+        name = t.text
+        if self.take_op("."):
+            name = self.next().text
+            self._qualified_refs.append(name)
+        return ex.ColumnRef(name)
+
+    def _check_qualified_refs(self):
+        """Comma/CROSS joins have no equi-key resolution to save a
+        same-named column: a dropped qualifier would silently compare a
+        column to itself (full cross product), so refuse instead."""
+        if not self._has_cross or not self._qualified_refs or \
+                len(self._from_columns) < 2:
+            return
+        for name in self._qualified_refs:
+            if sum(1 for cols in self._from_columns if name in cols) > 1:
+                raise SqlParseError(
+                    f"qualified reference to column {name!r} is ambiguous: "
+                    f"{name!r} exists in multiple FROM tables and this "
+                    "dialect resolves by bare name. Use JOIN ... ON / "
+                    "USING (...) or rename the columns.")
+
+    def parse_interval(self) -> ex.Expression:
+        """INTERVAL '3' MONTH / INTERVAL 1 DAY -> day count literal
+        (date arithmetic adds days; month/year go through add_months)."""
+        self.next()
+        t = self.next()
+        if t.kind == "string":
+            n = int(t.text[1:-1])
+        elif t.kind == "number":
+            n = int(t.text)
+        else:
+            raise SqlParseError(f"bad INTERVAL quantity {t.text!r}")
+        unit = self.next().upper.rstrip("S") if self.peek() else ""
+        if unit not in ("DAY", "MONTH", "YEAR"):
+            raise SqlParseError(f"unsupported INTERVAL unit {unit!r}")
+        return _Interval(n, unit)
+
+    def parse_case(self) -> ex.Expression:
+        self.next()                           # CASE
+        if not self.at_kw("WHEN"):            # CASE expr WHEN v THEN ...
+            base = self.parse_expr()
+            chain = None
+            while self.take_kw("WHEN"):
+                v = self.parse_expr()
+                self.expect_kw("THEN")
+                r = self.parse_expr()
+                cond = Col(pr.EqualTo(base, v))
+                chain = F.when(cond, Col(r)) if chain is None \
+                    else chain.when(cond, Col(r))
+        else:
+            chain = None
+            while self.take_kw("WHEN"):
+                c = self.parse_expr()
+                self.expect_kw("THEN")
+                r = self.parse_expr()
+                chain = F.when(Col(c), Col(r)) if chain is None \
+                    else chain.when(Col(c), Col(r))
+        if chain is None:
+            raise SqlParseError("CASE needs at least one WHEN")
+        if self.take_kw("ELSE"):
+            chain = chain.otherwise(Col(self.parse_expr()))
+        self.expect_kw("END")
+        return _unwrap(chain)
+
+    def parse_call(self) -> ex.Expression:
+        name = self.next().upper
+        self.expect_op("(")
+        if name == "COUNT":
+            if self.take_op("*"):
+                self.expect_op(")")
+                return _unwrap(F.count("*"))
+            if self.take_kw("DISTINCT"):
+                e = self.parse_expr()
+                self.expect_op(")")
+                return _unwrap(F.countDistinct(Col(e)))
+        if name == "SUM" and self.take_kw("DISTINCT"):
+            e = self.parse_expr()
+            self.expect_op(")")
+            return _unwrap(F.sumDistinct(Col(e)))
+        args: List[ex.Expression] = []
+        if not self.take_op(")"):
+            args.append(self.parse_expr())
+            while self.take_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+        fname = _FN_ALIASES.get(name, name.lower())
+        fn = getattr(F, fname, None)
+        if fn is None:
+            raise SqlParseError(f"unknown function {name}")
+        call_args = [a.value if isinstance(a, ex.Literal)
+                     and fname in ("substring", "lpad", "rpad", "round",
+                                   "locate", "instr", "regexp_extract",
+                                   "regexp_replace", "replace", "lead",
+                                   "lag")
+                     and i > 0 else Col(a)
+                     for i, a in enumerate(args)]
+        try:
+            return _unwrap(fn(*call_args))
+        except TypeError as e:
+            raise SqlParseError(f"bad arguments to {name}: {e}")
+
+
+class _Interval(ex.Literal):
+    """Day/month/year interval literal; only valid next to +/- against a
+    date expression, where it folds into date_add/add_months."""
+
+    def __init__(self, n: int, unit: str):
+        super().__init__(n if unit == "DAY" else 0)
+        self.n = n
+        self.unit = unit
+
+
+def _date_arith(e: ex.Expression, iv: "_Interval", sign: int):
+    n = sign * iv.n
+    if iv.unit == "DAY":
+        return _unwrap(F.date_add(Col(e), n))
+    months = n * (12 if iv.unit == "YEAR" else 1)
+    return _unwrap(F.add_months(Col(e), months))
+
+
+def _has_agg(e) -> bool:
+    if isinstance(e, lp.AggregateExpression):
+        return True
+    return any(_has_agg(c) for c in getattr(e, "children", []))
+
+
+def _extract_having(cond: ex.Expression, select_exprs):
+    """Replace aggregate subtrees in a HAVING condition with refs to
+    (possibly hidden) aggregation output columns."""
+    extra: List[ex.Expression] = []
+    named = {}
+    for i, e in enumerate(select_exprs):
+        inner = e.children[0] if isinstance(e, ex.Alias) else e
+        named[repr(inner)] = ex.ColumnRef(ex.output_name(e, i))
+
+    def walk(e):
+        if _has_agg(e) and not isinstance(e, lp.AggregateExpression):
+            # composite like sum(x)/count(y) — recurse into children
+            pass
+        if isinstance(e, lp.AggregateExpression):
+            key = repr(e)
+            if key in named:
+                return named[key]
+            name = f"_having_{len(extra)}"
+            extra.append(ex.Alias(e, name))
+            ref = ex.ColumnRef(name)
+            named[key] = ref
+            return ref
+        kids = getattr(e, "children", [])
+        for i, c in enumerate(kids):
+            kids[i] = walk(c)
+        return e
+
+    import copy
+    cond = copy.deepcopy(cond)
+    return extra, walk(cond)
+
+
+def parse_sql(query: str, session):
+    p = _Parser(_lex(query), session)
+    df = p.parse_select()
+    if p.peek().kind != "end":
+        raise SqlParseError(f"trailing input near {p.peek().text!r}")
+    return df

@@ -19,7 +19,11 @@ from .column import Column, Scalar, bucket
 
 
 class ColumnarBatch:
-    __slots__ = ("schema", "columns", "_num_rows")
+    # ``origin``: the open catalog registration (SpillableColumnarBatch)
+    # that already OWNS this batch's device arrays — set by the scan device
+    # cache so downstream spillable-drain layers borrow that registration
+    # instead of double-counting the same HBM under a second buffer id
+    __slots__ = ("schema", "columns", "_num_rows", "origin")
 
     def __init__(self, schema: dt.Schema, columns: List[Column], num_rows: int):
         assert len(schema) == len(columns), "schema/column arity mismatch"
@@ -27,6 +31,7 @@ class ColumnarBatch:
         assert len(caps) <= 1, f"mixed capacities in batch: {caps}"
         self.schema = schema
         self.columns = columns
+        self.origin = None
         if isinstance(num_rows, (int, np.integer)):
             self._num_rows = int(num_rows)
         else:
@@ -212,24 +217,56 @@ class ColumnarBatch:
         Returns a batch whose columns are numpy-backed, sliced to
         ``num_rows``."""
         import jax
-        n = self.num_rows                     # the one count sync
         if not self.columns:
+            self.num_rows                     # resolve the count
             return self
         if all(isinstance(c.data, np.ndarray) for c in self.columns):
+            self.num_rows
             return self
+        if not isinstance(self.num_rows_raw, int) and \
+                self.capacity <= (1 << 14):
+            # device-resident count + small batch: ONE transfer carries the
+            # count along with the data (a separate count sync would cost a
+            # full extra RTT on tunnel links)
+            flat = self.flat_arrays() + [self.num_rows_raw]
+            host = jax.device_get(flat)
+            n = int(host[-1])
+            self._num_rows = n
+            return ColumnarBatch.from_flat_arrays(self.schema, host[:-1], n)
+        n = self.num_rows                     # the one count sync
         # slice to a BUCKETED length before the transfer: padding beyond
         # bucket(n) stays on device, while the power-of-two slice shapes
         # keep the compile cache bounded (vs one slice program per n)
+        from .column import ObjectColumn
         cap = self.capacity
         m = cap if cap <= (1 << 14) else min(bucket(max(n, 1)), cap)
         sliced: List[Any] = []
-        for c in self.columns:
+        obj_cols = {}
+        for ci, c in enumerate(self.columns):
+            if isinstance(c, ObjectColumn):   # host python payload already
+                obj_cols[ci] = c
+                continue
             sliced.append(c.data if m == cap else c.data[:m])
             sliced.append(c.validity if m == cap else c.validity[:m])
             if c.dtype.var_width:
                 sliced.append(c.lengths if m == cap else c.lengths[:m])
         host = jax.device_get(sliced)         # one round trip for the batch
-        return ColumnarBatch.from_flat_arrays(self.schema, host, n)
+        if not obj_cols:
+            return ColumnarBatch.from_flat_arrays(self.schema, host, n)
+        cols: List[Column] = []
+        i = 0
+        for ci, f in enumerate(self.schema):
+            if ci in obj_cols:
+                cols.append(obj_cols[ci])
+                continue
+            if f.dtype.var_width:
+                cols.append(Column(f.dtype, host[i], host[i + 1],
+                                   host[i + 2]))
+                i += 3
+            else:
+                cols.append(Column(f.dtype, host[i], host[i + 1]))
+                i += 2
+        return ColumnarBatch(self.schema, cols, n)
 
     def to_pydict(self) -> Dict[str, List[Any]]:
         host = self.fetch_to_host()
@@ -341,15 +378,42 @@ def _infer_dtype(values: Sequence[Any]) -> dt.DType:
         if isinstance(v, (str, bytes)):
             return dt.STRING
         if isinstance(v, dict):
-            # prefer a non-empty dict for key/value inference; a column of
-            # only empty maps defaults to map<bigint,bigint>
-            src = next((d for d in values
-                        if isinstance(d, dict) and d), None)
-            if src is None:
+            # widen across EVERY dict in the column (a single-sample
+            # inference mistyped e.g. int-then-float value columns and the
+            # encoding silently truncated); empty-map-only columns default
+            # to map<bigint,bigint>
+            ks: list = []
+            vs: list = []
+            for d in values:
+                if isinstance(d, dict):
+                    ks.extend(d.keys())
+                    vs.extend(x for x in d.values() if x is not None)
+            if not ks:
                 return dt.MAP(dt.INT64, dt.INT64)
-            k0 = next(iter(src.keys()))
-            v0 = next((x for x in src.values() if x is not None), 0)
-            return dt.MAP(_infer_dtype([k0]), _infer_dtype([v0]))
+            return dt.MAP(_widen_across(ks), _widen_across(vs or [0]))
         if isinstance(v, (list, tuple)) and v:
-            return dt.ARRAY(_infer_dtype([v[0]]))
+            elems = [x for lst in values
+                     if isinstance(lst, (list, tuple))
+                     for x in lst if x is not None]
+            if any(isinstance(x, str) for x in elems):
+                return dt.ARRAY_STRING
+            return dt.ARRAY(_widen_across(elems or [0]))
     return dt.STRING
+
+
+def _widen_across(values: Sequence[Any]) -> dt.DType:
+    """Widest primitive dtype across observed python values: any float
+    promotes int to float64, any string wins outright (mixed map columns
+    must not truncate later-row values)."""
+    out: dt.DType = None
+    for v in values:
+        t = (dt.BOOL if isinstance(v, bool) else
+             dt.INT64 if isinstance(v, int) else
+             dt.FLOAT64 if isinstance(v, float) else dt.STRING)
+        if out is None or out == t:
+            out = t
+        elif {out, t} == {dt.INT64, dt.FLOAT64}:
+            out = dt.FLOAT64
+        else:
+            out = dt.STRING if dt.STRING in (out, t) else dt.FLOAT64
+    return out or dt.INT64

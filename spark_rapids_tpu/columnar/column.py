@@ -142,6 +142,14 @@ class Column:
                     capacity: Optional[int] = None,
                     width: Optional[int] = None) -> "Column":
         n = len(values)
+        if (dt.is_map(dtype) or dt.is_array(dtype)) and \
+                dtype.numpy_dtype is None:
+            # CPU-engine-only complex dtype (e.g. map<string,_>): these are
+            # planner-gated off the device, so the column only exists to
+            # carry CpuFallback results across the collect boundary — keep
+            # the python objects instead of the device bitpattern layout
+            # (which would misencode/crash on string keys)
+            return ObjectColumn(dtype, values, capacity)
         valid_np = np.array([v is not None for v in values], dtype=np.bool_)
         if dt.is_map(dtype):
             # MAP<K,V>: int64[cap, 3W] INTERLEAVED bitpattern matrix
@@ -397,3 +405,57 @@ class Column:
     def __repr__(self):
         extra = f", width={self.data.shape[1]}" if self.dtype.var_width else ""
         return f"Column({self.dtype}, cap={self.capacity}{extra})"
+
+
+class ObjectColumn(Column):
+    """Host-only python-object column for CPU-engine-only dtypes (maps with
+    string keys/values, array<string>). The planner's type gate keeps these
+    off the device (overrides type check, like the reference's unsupported
+    nested types in GpuColumnVector.java's matrix), so an ObjectColumn only
+    carries CpuFallback results across the host collect boundary — any
+    device op touching it is a planner bug and raises."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, dtype: dt.DType, values: Sequence[Any],
+                 capacity: Optional[int] = None):
+        n = len(values)
+        cap = capacity or bucket(n)
+        vals = list(values) + [None] * (cap - n)
+        if dt.is_map(dtype):
+            # normalize list-of-pairs (arrow's map rendering) to dicts
+            vals = [dict(v) if isinstance(v, (list, tuple)) else v
+                    for v in vals]
+        self.dtype = dtype
+        self.values = vals
+        self.data = np.empty((cap, 0), dtype=np.uint8)
+        self.validity = np.array([v is not None for v in vals], np.bool_)
+        self.lengths = np.zeros(cap, np.int32)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.values)
+
+    def device_size_bytes(self) -> int:
+        return 0
+
+    def arrays(self) -> List[jnp.ndarray]:
+        raise TypeError(
+            f"{self.dtype} columns are host-only (CPU-engine dtype); "
+            "no device arrays exist")
+
+    def with_arrays(self, data, validity, lengths=None) -> "Column":
+        raise TypeError(f"{self.dtype} columns are host-only")
+
+    def to_pylist(self, num_rows: int) -> List[Any]:
+        return self.values[:num_rows]
+
+    def to_arrow(self, num_rows: int):
+        import pyarrow as pa
+        vals = self.values[:num_rows]
+        if dt.is_map(self.dtype):
+            vals = [None if v is None else list(v.items()) for v in vals]
+        return pa.array(vals, type=dt.to_arrow(self.dtype))
+
+    def __repr__(self):
+        return f"ObjectColumn({self.dtype}, cap={self.capacity})"

@@ -74,7 +74,8 @@ class SpillableBuffer:
 
     def __init__(self, buffer_id: int, meta: BufferMeta, priority: float,
                  device_arrays: Optional[List[Any]] = None,
-                 col_dtypes: Optional[List[dt.DType]] = None):
+                 col_dtypes: Optional[List[dt.DType]] = None,
+                 obj_cols: Optional[Dict[int, Column]] = None):
         self.id = buffer_id
         self.meta = meta
         self.priority = priority
@@ -83,6 +84,10 @@ class SpillableBuffer:
         self._device_arrays = device_arrays        # list of jax arrays
         self._host_arrays: Optional[List[np.ndarray]] = None
         self._disk_path: Optional[str] = None
+        # CPU-engine-only columns (ObjectColumn: map<string,_> etc.) are
+        # python-object payloads that never touch the device; they ride the
+        # buffer untiered (already host-resident, nothing to spill)
+        self._obj_cols = obj_cols or {}
         self._lock = threading.RLock()
         self.size_bytes = sum(
             a.size * a.dtype.itemsize for a in (device_arrays or []))
@@ -132,8 +137,10 @@ class SpillableBuffer:
         arrays = self._load_arrays()
         cols: List[Column] = []
         i = 0
-        for f in self.meta.schema:
-            if f.dtype.var_width:
+        for ci, f in enumerate(self.meta.schema):
+            if ci in self._obj_cols:
+                cols.append(self._obj_cols[ci])
+            elif f.dtype.var_width:
                 cols.append(Column(f.dtype, arrays[i], arrays[i + 1], arrays[i + 2]))
                 i += 3
             else:
@@ -211,15 +218,20 @@ class BufferCatalog:
     # -- registration --------------------------------------------------------
     def register_batch(self, batch: ColumnarBatch,
                        priority: float = ACTIVE_ON_DECK_PRIORITY) -> int:
+        from ..columnar.column import ObjectColumn
         arrays: List[Any] = []
         col_dtypes: List[dt.DType] = []
-        for c in batch.columns:
+        obj_cols: Dict[int, Column] = {}
+        for ci, c in enumerate(batch.columns):
+            if isinstance(c, ObjectColumn):
+                obj_cols[ci] = c
+                continue
             arrays.extend(c.arrays())
             col_dtypes.append(c.dtype)
         buf = SpillableBuffer(
             next_buffer_id(),
             BufferMeta(batch.schema, batch.num_rows_raw, batch.capacity),
-            priority, arrays, col_dtypes)
+            priority, arrays, col_dtypes, obj_cols)
         with self._mu:
             self.buffers[buf.id] = buf
             self.device_bytes += buf.size_bytes
@@ -339,9 +351,44 @@ class SpillableColumnarBatch:
             self.catalog.remove(self._id)
             self._closed = True
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+class BorrowedSpillableView:
+    """Non-owning stand-in for an already-registered batch (a scan
+    device-cache entry served straight downstream): re-registering the
+    same device arrays would double-count HBM in the catalog, so drain
+    layers borrow the owner's registration. ``get_batch`` returns the
+    borrowed batch directly (our reference pins the arrays regardless of
+    the owner's spill state) and ``close`` is a no-op — lifetime belongs
+    to the cache entry."""
+
+    def __init__(self, owner: "SpillableColumnarBatch",
+                 batch: ColumnarBatch):
+        self._batch = batch
+        self.schema = batch.schema
+        self.size_bytes = owner.size_bytes
+        self._num_rows = batch.num_rows_raw
+
+    @property
+    def num_rows(self):
+        nr = self._num_rows
+        if not isinstance(nr, int):
+            nr = int(nr)
+            self._num_rows = nr
+        return nr
+
+    def get_batch(self) -> ColumnarBatch:
+        return self._batch
+
+    def close(self) -> None:
+        pass

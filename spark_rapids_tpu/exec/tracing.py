@@ -14,7 +14,7 @@ Disabled (no-op, zero overhead beyond one attr check) unless
 from __future__ import annotations
 
 from contextlib import contextmanager
-from typing import Optional
+from typing import List, Optional
 
 _enabled: Optional[bool] = None
 
@@ -56,3 +56,115 @@ def start_profiler_server(port: int = 9012) -> None:
     """Expose the live jax profiler (xprof capture target)."""
     import jax
     jax.profiler.start_server(port)
+
+
+# ---------------------------------------------------------------------------
+# Attributed host-sync counting
+# ---------------------------------------------------------------------------
+#
+# On tunnel/high-latency links every blocking device->host readback costs a
+# full round trip (~0.1-0.35 s measured), so END-TO-END query time is
+# dominated by HOW MANY syncs the engine performs, not by kernel time.
+# Wall-clock swings 2-5x between runs on the same code; attributed sync
+# counts are deterministic, so they are the perf-regression metric of
+# record (the reference's analog is NVTX ranges + nsys counting kernel
+# launches and D2H copies).
+
+class SyncCounter:
+    """Counts blocking device->host materializations while active, each
+    attributed to the innermost spark_rapids_tpu frame that triggered it.
+    Works by wrapping ``ArrayImpl._value`` — the single funnel every
+    np.asarray / device_get / float() / int() readback goes through.
+
+    The wrapper installs once and STAYS installed (one None check per
+    readback when no counter is active — cheaper than racing property
+    swaps on the live class). The entering thread's counter also becomes
+    the process default so task-pool worker threads (which do the actual
+    partition drains) record into it; a thread entering its own counter
+    overrides the default for itself. ``_uninstall`` exists for tests
+    that must restore the pristine property."""
+
+    _tls = None                    # lazy threading.local
+    _default_stack: List["SyncCounter"] = []
+    _orig_value = None
+
+    @classmethod
+    def _get_active(cls) -> Optional["SyncCounter"]:
+        tls = cls._tls
+        local = getattr(tls, "active", None) if tls is not None else None
+        if local is not None:
+            return local
+        stack = cls._default_stack
+        return stack[-1] if stack else None
+
+    def __init__(self):
+        self.total = 0
+        self.sites: dict = {}
+
+    # -- patch management ---------------------------------------------------
+    @classmethod
+    def _install(cls):
+        if cls._orig_value is not None:
+            return
+        from jax._src import array as jarray
+        orig = jarray.ArrayImpl._value
+
+        def counting_value(self_arr):
+            c = cls._get_active()
+            # only count REAL syncs: a cached host value is free
+            if c is not None and \
+                    getattr(self_arr, "_npy_value", None) is None:
+                c._record()
+            return orig.fget(self_arr)
+
+        cls._orig_value = orig
+        jarray.ArrayImpl._value = property(counting_value)
+
+    @classmethod
+    def _uninstall(cls):
+        if cls._orig_value is None:
+            return
+        from jax._src import array as jarray
+        jarray.ArrayImpl._value = cls._orig_value
+        cls._orig_value = None
+
+    def _record(self):
+        import traceback
+        self.total += 1
+        site = "<unknown>"
+        for frame in reversed(traceback.extract_stack(limit=24)):
+            fn = frame.filename
+            if "spark_rapids_tpu" in fn and "tracing.py" not in fn:
+                short = fn[fn.rindex("spark_rapids_tpu"):]
+                site = f"{short}:{frame.lineno}"
+                break
+        self.sites[site] = self.sites.get(site, 0) + 1
+
+    # -- context ------------------------------------------------------------
+    def __enter__(self):
+        import threading
+        cls = SyncCounter
+        cls._install()
+        if cls._tls is None:
+            cls._tls = threading.local()
+        self._prev = getattr(cls._tls, "active", None)
+        cls._tls.active = self
+        # the entering thread's counter is also the process default so
+        # pool worker threads record into it; removal is by identity (not
+        # LIFO) so interleaved exits across threads cannot resurrect a
+        # finished counter as the lingering default
+        cls._default_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        SyncCounter._tls.active = self._prev
+        try:
+            SyncCounter._default_stack.remove(self)
+        except ValueError:
+            pass
+        return False
+
+    def report(self, top: int = 10) -> dict:
+        ordered = sorted(self.sites.items(), key=lambda kv: -kv[1])
+        return {"hostSyncs": self.total,
+                "syncSites": dict(ordered[:top])}

@@ -172,13 +172,21 @@ def pack_key_bits(items: List[Tuple[jnp.ndarray, Optional[int]]]
     return out
 
 
-def sort_indices(keys: Sequence[SortKey], num_rows, capacity: int) -> jnp.ndarray:
+def sort_indices(keys: Sequence[SortKey], num_rows, capacity: int,
+                 live_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Stable permutation ordering live rows by the keys; padding rows go last.
 
     cuDF analog: ``Table.orderBy`` (used by GpuSortExec, GpuSortExec.scala:33-105).
     ``num_rows`` may be a python int or a traced device scalar.
+    ``live_mask`` marks live rows explicitly (already padding-masked):
+    folded-filter consumers rank filtered-out rows last INSTEAD of
+    physically compacting first — compaction's scatter is the slowest
+    primitive on TPU, the sort is nearly free.
     """
-    pad_rank = (jnp.arange(capacity) >= num_rows).astype(jnp.uint8)
+    if live_mask is not None:
+        pad_rank = (~live_mask).astype(jnp.uint8)
+    else:
+        pad_rank = (jnp.arange(capacity) >= num_rows).astype(jnp.uint8)
     msf: List[Tuple[jnp.ndarray, Optional[int]]] = [(pad_rank, 1)]
     for key in keys:
         msf.extend(_key_arrays_bits(key))

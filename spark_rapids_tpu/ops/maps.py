@@ -149,20 +149,20 @@ class GetMapValue(Expression):
         key = self.children[1].eval(batch)
         keys, vals, vvalid, w = _halves(m)
         cap = m.capacity
-        # compare in float64 when exactly one side is floating: casting the
-        # lookup key INTO an integral key dtype would truncate (1.5 -> 1)
-        # and match the wrong entry
-        cmp_f = kt.is_floating != key_expr_t.is_floating or kt.is_floating
-        ck = keys.astype(jnp.float64) if cmp_f else keys
+        # compare in float64 when either side is floating (casting the
+        # lookup key INTO an integral key dtype would truncate 1.5 -> 1 and
+        # match the wrong entry); integral/integral compares in int64 so a
+        # bigint lookup against map<int,_> cannot wrap modulo 2^32
+        cmp_f = kt.is_floating or key_expr_t.is_floating
+        cmp_t = jnp.float64 if cmp_f else jnp.int64
+        ck = keys.astype(cmp_t)
         if isinstance(key, Scalar):
             if key.is_null:
                 return Column.full_null(self.dtype, cap)
-            k = jnp.full((cap, 1), key.value,
-                         jnp.float64 if cmp_f else keys.dtype)
+            k = jnp.full((cap, 1), key.value, cmp_t)
             kvalid = jnp.ones(cap, jnp.bool_)
         else:
-            k = key.data.astype(jnp.float64 if cmp_f
-                                else keys.dtype)[:, None]
+            k = key.data.astype(cmp_t)[:, None]
             kvalid = key.validity
         lane_ok = jnp.arange(w)[None, :] < m.lengths[:, None]
         match = (ck == k) & lane_ok

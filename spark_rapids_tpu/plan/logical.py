@@ -114,12 +114,28 @@ class LogicalPlan:
 
 
 class LocalScan(LogicalPlan):
-    """In-memory data scan (createDataFrame analog)."""
+    """In-memory data scan (createDataFrame analog).
 
-    def __init__(self, data: "pyarrow.Table", name: str = "local"):
+    ``base_data`` is the ORIGINAL registered table when this scan is a
+    column-pruned view of it (the planner's pruning rule builds a new
+    ``pa.Table`` per query via select(); the base object is the stable
+    identity the scan-level device cache keys and lifetime-tracks by).
+    The arrow table itself is immutable and SHARED on deepcopy: plan
+    analysis copies trees per query, and copying a multi-GB table per
+    query dominated end-to-end time (5s of the q6 SF0.5 wall clock was
+    table deepcopy)."""
+
+    def __init__(self, data: "pyarrow.Table", name: str = "local",
+                 base_data=None):
         super().__init__()
         self.data = data
         self.scan_name = name
+        self.base_data = base_data if base_data is not None else data
+
+    def __deepcopy__(self, memo):
+        c = LocalScan(self.data, self.scan_name, self.base_data)
+        memo[id(self)] = c
+        return c
 
     def _compute_schema(self) -> dt.Schema:
         return dt.Schema([
@@ -636,8 +652,20 @@ def analyze(plan: LogicalPlan) -> LogicalPlan:
             raise AnalysisError(
                 f"filter condition must be boolean, got {plan.condition.dtype}")
     elif isinstance(plan, Aggregate):
+        # Resolution must not sever the grouping<->output identity link:
+        # computed grouping keys (CASE/arithmetic) are matched BY IDENTITY
+        # in the result projection (physical._rewrite_result), so any output
+        # subtree that IS a grouping member pre-resolution must resolve to
+        # the SAME object the grouping list resolves to.
+        old_grouping = list(plan.grouping)
         plan.grouping = [ra(e) for e in plan.grouping]
-        plan.aggregate_exprs = [ra(e) for e in plan.aggregate_exprs]
+        ident = {id(o): n for o, n in zip(old_grouping, plan.grouping)}
+
+        def share_grouping(e):
+            return e.transform_down(lambda n: ident.get(id(n)))
+
+        plan.aggregate_exprs = [ra(share_grouping(e))
+                                for e in plan.aggregate_exprs]
     elif isinstance(plan, Join):
         if plan.condition is not None:
             left, right = plan.children[0].schema, plan.children[1].schema

@@ -464,7 +464,8 @@ class Overrides:
         if isinstance(p, lp.LocalScan):
             return ph.TpuLocalScanExec(
                 p.data, p.schema,
-                batch_rows=int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)))
+                batch_rows=int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)),
+                base_data=p.base_data)
         if isinstance(p, lp.FileScan):
             from ..io.scan import TpuFileScanExec
             return TpuFileScanExec(p, self.conf)
@@ -821,7 +822,11 @@ def _prune_scan_columns(root: lp.LogicalPlan) -> lp.LogicalPlan:
             names = p.schema.names()
             keep = [n for n in names if n in referenced] or names[:1]
             if len(keep) < len(names):
-                return lp.LocalScan(p.data.select(keep), p.scan_name)
+                # stable cache lineage: the pruned view is a NEW pa.Table
+                # every query, so the scan device cache keys by the base
+                # table identity + kept columns instead
+                return lp.LocalScan(p.data.select(keep), p.scan_name,
+                                    base_data=p.base_data)
             return p
         if isinstance(p, lp.FileScan):
             names = p.schema.names()

@@ -357,14 +357,28 @@ def drain_spillable(part, acquire: bool = False
     takes the task semaphore once the first batch exists (the reference's
     acquire-after-host-IO ordering, GpuSemaphore.scala:74-78)."""
     from ..columnar.batch import resolve_counts
-    from ..exec.spill import SpillableColumnarBatch
+    from ..exec.spill import BorrowedSpillableView, SpillableColumnarBatch
     out: List[SpillableColumnarBatch] = []
     chunk: List[ColumnarBatch] = []
 
-    def flush():
+    def spillable(b: ColumnarBatch):
+        # batches served from the scan device cache are ALREADY registered;
+        # borrow that registration instead of double-counting the HBM
+        if b.origin is not None and not b.origin.closed:
+            return BorrowedSpillableView(b.origin, b)
+        return SpillableColumnarBatch(b)
+
+    def flush(last: bool = False):
+        if last and not out and len(chunk) == 1:
+            # the whole partition is ONE batch (tight-aggregate queries):
+            # registration keeps its count lazy, so skipping the resolve
+            # here lets the final fetch read count + data in a single
+            # round trip (each blocking readback costs a full RTT)
+            out.append(spillable(chunk[0]))
+            chunk.clear()
+            return
         resolve_counts(chunk)          # one round-trip per chunk
-        out.extend(SpillableColumnarBatch(b) for b in chunk
-                   if b.num_rows > 0)
+        out.extend(spillable(b) for b in chunk if b.num_rows > 0)
         chunk.clear()
 
     first = True
@@ -377,7 +391,7 @@ def drain_spillable(part, acquire: bool = False
         chunk.append(b)
         if len(chunk) >= 8:
             flush()
-    flush()
+    flush(last=True)
     return out
 
 
@@ -554,6 +568,34 @@ def _fusion_enabled(node) -> bool:
 # per-exec closures would force a recompile every query.
 _FUSED_CACHE: Dict[tuple, Any] = {}
 
+# Cached fused programs must NOT close over an exec instance: the cache is
+# process-global, so a captured exec would pin its whole plan tree (and any
+# CachedScan owner) for the process lifetime. Trace-time helpers resolve the
+# exec through this call-scoped THREAD-LOCAL stack instead (partition tasks
+# run on pool threads, so concurrent drains of two aggregate execs must not
+# see each other's exec); the cache key guarantees any exec seen here is
+# structurally identical to the one the trace was built for, so a retrace
+# under a different exec produces the same program.
+_TRACE_TLS = __import__("threading").local()
+
+
+def _trace_exec_stack() -> List[Any]:
+    stack = getattr(_TRACE_TLS, "stack", None)
+    if stack is None:
+        stack = _TRACE_TLS.stack = []
+    return stack
+
+
+class _trace_exec:
+    def __init__(self, node):
+        self.node = node
+
+    def __enter__(self):
+        _trace_exec_stack().append(self.node)
+
+    def __exit__(self, *exc):
+        _trace_exec_stack().pop()
+
 
 def _fused_fn(key: tuple, builder):
     fn = _FUSED_CACHE.get(key)
@@ -716,12 +758,15 @@ class TpuLocalScanExec(TpuExec):
     """In-memory arrow table scan -> device batches (HostColumnarToGpu analog)."""
 
     def __init__(self, table, schema: dt.Schema, batch_rows: int = 1 << 20,
-                 num_partitions: int = 1):
+                 num_partitions: int = 1, base_data=None):
         super().__init__()
         self.table = table
         self._schema = schema
         self.batch_rows = batch_rows
         self.num_partitions = max(1, num_partitions)
+        # stable identity for the device cache: the ORIGINAL registered
+        # table when this scan is a pruned per-query view of it
+        self.base_data = base_data if base_data is not None else table
 
     @property
     def schema(self):
@@ -741,88 +786,119 @@ class TpuLocalScanExec(TpuExec):
             parts.append(self._part_iter(lo, hi))
         return parts
 
-    # host-prep cache for in-memory tables: arrow tables are immutable, so
-    # the padded/PACKED numpy form of each scan batch is reusable across
+    # DEVICE cache for in-memory tables: arrow tables are immutable, so
+    # each scan batch caches as a SPILLABLE device batch reusable across
     # query runs (the reference's InMemoryTableScan / cached-table path,
-    # GpuInMemoryTableScanExec) — the DEVICE upload still happens per run.
-    # pa.Table is unhashable, so entries key by id(table) and a weakref
-    # finalizer drops them (and returns their budget) when the table is
-    # collected. Only "packed" preps cache (fallback preps hold the table
-    # and redo the conversion anyway); admission charges the PREPPED bytes
-    # (padding can exceed the arrow size by a large factor) against a
-    # process-wide budget.
-    _PREP_CACHE: Dict[int, dict] = {}
-    _PREP_CACHE_MAX_BYTES = 2 << 30
-    _prep_cache_bytes = 0
-    _prep_cache_lock = __import__("threading").Lock()
+    # GpuInMemoryTableScanExec). Round 3 cached only the host-prepped
+    # numpy form and re-uploaded per run — on tunnel links the upload IS
+    # the hot-path cost (0.2-4.4s for 96 MB depending on link mood), so
+    # hits must serve device-resident columns. Entries key by the BASE
+    # table identity + kept columns (pruning builds a fresh pa.Table per
+    # query) and a weakref finalizer closes the handles when the base
+    # table is collected; memory pressure spills entries through the
+    # normal device->host->disk tiers, and a later hit re-promotes.
+    _DEVICE_CACHE: Dict[tuple, dict] = {}
+    _DEVICE_CACHE_MAX_BYTES = 6 << 30   # admission bound (spill tiers
+    _device_cache_bytes = 0             # otherwise grow host/disk forever)
+    _device_cache_lock = __import__("threading").Lock()
 
     @classmethod
-    def _evict_table(cls, table_id: int) -> None:
-        with cls._prep_cache_lock:
-            ent = cls._PREP_CACHE.pop(table_id, None)
+    def _evict_table(cls, cache_key: tuple) -> None:
+        with cls._device_cache_lock:
+            ent = cls._DEVICE_CACHE.pop(cache_key, None)
             if ent:
-                cls._prep_cache_bytes -= sum(
-                    p[5] for p in ent.values() if p[0] == "packed")
+                cls._device_cache_bytes -= sum(
+                    h.size_bytes for h in ent.values())
+        for handle in (ent or {}).values():
+            try:
+                handle.close()
+            except Exception:
+                pass
 
     def _table_cache(self):
         import weakref
         cls = TpuLocalScanExec
-        tid = id(self.table)
-        with cls._prep_cache_lock:
-            ent = cls._PREP_CACHE.get(tid)
+        key = (id(self.base_data), tuple(self._schema.names()),
+               self.batch_rows)
+        with cls._device_cache_lock:
+            ent = cls._DEVICE_CACHE.get(key)
             if ent is not None:
                 return ent
             try:
-                weakref.finalize(self.table, cls._evict_table, tid)
+                weakref.finalize(self.base_data, cls._evict_table, key)
             except TypeError:
                 return None
-            ent = cls._PREP_CACHE[tid] = {}
+            ent = cls._DEVICE_CACHE[key] = {}
             return ent
 
     def _part_iter(self, lo: int, hi: int) -> Partition:
+        from ..exec.spill import (BufferLostError, CACHE_PRIORITY,
+                                  SpillableColumnarBatch)
         from ..exec.tasks import prefetch_map
 
         def chunks():
             pos = lo
             while pos < hi:
                 end = min(pos + self.batch_rows, hi)
-                yield (pos, self.table.slice(pos, end - pos))
+                yield (pos, end - pos)
                 pos = end
 
         cache = self._table_cache()
 
         def prep(item):
-            pos, chunk = item
-            key = (pos, chunk.num_rows, self.batch_rows)
+            pos, rows = item
+            key = (pos, rows)
             if cache is not None:
-                hit = cache.get(key)
-                if hit is not None:
-                    return hit
-            p = ColumnarBatch.prep_from_arrow(chunk)
-            if cache is not None and p[0] == "packed":
-                cls = TpuLocalScanExec
-                with cls._prep_cache_lock:
-                    # re-check under the lock: a concurrent prep of the
-                    # same key must not double-charge the budget
-                    if key not in cache and \
-                            cls._prep_cache_bytes + p[5] <= \
-                            cls._PREP_CACHE_MAX_BYTES:
-                        cache[key] = p
-                        cls._prep_cache_bytes += p[5]
-            return p
+                handle = cache.get(key)
+                if handle is not None:
+                    return ("cached", key, handle)
+            return ("prep", key,
+                    ColumnarBatch.prep_from_arrow(self.table.slice(pos,
+                                                                   rows)))
 
         # HOST-side arrow->numpy conversion runs one batch ahead on a
         # background thread; the device upload stays on the task thread
         # BEHIND semaphore acquisition and memory admission, preserving the
         # ordering contract (GpuSemaphore.scala:74: acquire after host IO,
         # before device work)
+        from ..exec.tracing import trace_span
         first = True
-        for prepped in prefetch_map(chunks(), prep):
+        for kind, key, payload in prefetch_map(chunks(), prep):
             if first:
                 _task_begin()
                 first = False
-            _reserve(ColumnarBatch.prepped_size_bytes(prepped))
-            batch = ColumnarBatch.upload_prepped(prepped)
+            with trace_span("scan_upload", self.metrics, "scanTime"):
+                if kind == "cached":
+                    try:
+                        batch = payload.get_batch()
+                        batch.origin = payload
+                        self.metrics.inc("cacheHitBatches")
+                    except BufferLostError:
+                        # catalog was reset under us (tests do): rebuild
+                        with TpuLocalScanExec._device_cache_lock:
+                            if cache.get(key) is payload:
+                                del cache[key]
+                                TpuLocalScanExec._device_cache_bytes -= \
+                                    payload.size_bytes
+                        kind = "prep"
+                        payload = ColumnarBatch.prep_from_arrow(
+                            self.table.slice(*key))
+                if kind != "cached":
+                    prepped = payload
+                    nbytes = ColumnarBatch.prepped_size_bytes(prepped)
+                    _reserve(nbytes)
+                    batch = ColumnarBatch.upload_prepped(prepped)
+                    cls = TpuLocalScanExec
+                    if cache is not None and prepped[0] == "packed" and \
+                            cls._device_cache_bytes + nbytes <= \
+                            cls._DEVICE_CACHE_MAX_BYTES:
+                        with cls._device_cache_lock:
+                            if key not in cache:
+                                handle = SpillableColumnarBatch(
+                                    batch, CACHE_PRIORITY)
+                                cache[key] = handle
+                                batch.origin = handle
+                                cls._device_cache_bytes += handle.size_bytes
             self.metrics.inc("numOutputRows", batch.num_rows_raw)
             self.metrics.inc("numOutputBatches")
             yield batch
@@ -1351,16 +1427,23 @@ class TpuHashAggregateExec(TpuExec):
         return ColumnarBatch(batch.schema, cols, int(count))
 
     def _traced_pre_filter(self, b: ColumnarBatch) -> ColumnarBatch:
-        """In-trace compaction by the folded Filter (cumsum+scatter, cheap)."""
+        """In-trace compaction by the folded Filter (eager fallback path —
+        the fused paths use ``_traced_filter_mask`` instead, which avoids
+        the compaction scatter entirely)."""
         if self.pre_filter is None:
             return b
+        keep = self._traced_filter_mask(b)
+        cols, count = K.compact_columns(b.columns, keep)
+        return ColumnarBatch(b.schema, cols, count)
+
+    def _traced_filter_mask(self, b: ColumnarBatch):
+        """Folded-Filter live-row mask (None when no filter is folded)."""
+        if self.pre_filter is None:
+            return None
         pred = self.pre_filter.eval(b)
         if isinstance(pred, Scalar):
             raise _ScalarPredicate()
-        import jax.numpy as jnp
-        keep = pred.data & pred.validity & b.row_mask()
-        cols, count = K.compact_columns(b.columns, keep)
-        return ColumnarBatch(b.schema, cols, count)
+        return pred.data & pred.validity & b.row_mask()
 
     # -- whole-stage fused group-by (expression eval + kernel in <=2
     # device programs per batch; see the fusion section above) --------------
@@ -1411,19 +1494,31 @@ class TpuHashAggregateExec(TpuExec):
         return self._fused_finish(tok)
 
     def _build_eval_fn(self, phase: str):
+        # resolves the exec via the thread-local stack, NOT a captured
+        # self: these closures end up inside globally-cached jitted
+        # programs, and a strong self would leak the exec (+ its
+        # CachedScan owners) forever
         def build_eval(b):
-            # the folded Filter compacts INSIDE the traced program (update
-            # phase only: merge/final consume already-filtered partials);
-            # returns (keys, specs, effective_row_count) — kernels must see
-            # the POST-filter count or dead rows would join the NULL group
+            # the folded Filter becomes a LIVE-ROW MASK inside the traced
+            # program (update phase only: merge/final consume already-
+            # filtered partials); physical compaction would cost a scatter
+            # — the slowest TPU primitive — per batch, while the sort and
+            # dense kernels rank/mask dead rows for free. Returns
+            # (keys, specs, effective_row_count, live_mask); kernels must
+            # see the POST-filter count or dead rows would join the NULL
+            # group, and live_mask is None when no filter was folded.
+            node = _trace_exec_stack()[-1]
             n_eff = b.num_rows
+            mask = None
             if phase == "update":
-                b = self._traced_pre_filter(b)
-                n_eff = b.num_rows
-                keys, specs = self._build_update_specs(b)
+                mask = node._traced_filter_mask(b)
+                if mask is not None:
+                    import jax.numpy as jnp
+                    n_eff = jnp.sum(mask).astype(jnp.int32)
+                keys, specs = node._build_update_specs(b)
             else:
-                keys, specs = self._merge_specs(b)
-            return keys, specs, n_eff
+                keys, specs = node._merge_specs(b)
+            return keys, specs, n_eff, mask
         return build_eval
 
     def _fused_dispatch(self, batch: ColumnarBatch, phase: str):
@@ -1462,13 +1557,15 @@ class TpuHashAggregateExec(TpuExec):
                     def fn(num_rows, *arrays):
                         b = ColumnarBatch.from_flat_arrays(
                             in_schema, arrays, num_rows)
-                        _keys, specs, n_eff = build_eval(b)
+                        _keys, specs, n_eff, mask = build_eval(b)
                         aggs = agg_k.reduce_aggregate(specs, n_eff,
-                                                      b.capacity)
+                                                      b.capacity,
+                                                      live_mask=mask)
                         return tuple(a for c in aggs for a in c.arrays())
                     return jax.jit(fn)
                 fn = _fused_fn(sig + ("reduce", cap), build_reduce)
-                outs = fn(_dev_count(batch), *batch.flat_arrays())
+                with _trace_exec(self):
+                    outs = fn(_dev_count(batch), *batch.flat_arrays())
                 return ("done", ColumnarBatch.from_flat_arrays(
                     pschema, list(outs), 1))
 
@@ -1495,17 +1592,19 @@ class TpuHashAggregateExec(TpuExec):
                     def fn(num_rows, *arrays):
                         b = ColumnarBatch.from_flat_arrays(
                             in_schema, arrays, num_rows)
-                        keys, specs, n_eff = build_eval(b)
+                        keys, specs, n_eff, mask = build_eval(b)
                         float_cols = [
                             s.column for s in specs
                             if s.op in ("sum", "avg") and s.column is not None
                             and s.column.dtype.is_floating]
-                        return agg_k.dense_key_stats(keys[0], n_eff,
-                                                     float_cols=float_cols)
+                        return agg_k.dense_key_stats(
+                            keys[0], num_rows if mask is not None else n_eff,
+                            extra_mask=mask, float_cols=float_cols)
                     return jax.jit(fn)
                 probe = _fused_fn(sig + ("probe", cap), build_probe)
-                rmin, dec = probe(_dev_count(batch),
-                                  *batch.flat_arrays())
+                with _trace_exec(self):
+                    rmin, dec = probe(_dev_count(batch),
+                                      *batch.flat_arrays())
                 return ("dense", batch, phase, sig, in_schema, cap,
                         rmin, dec)
 
@@ -1539,10 +1638,11 @@ class TpuHashAggregateExec(TpuExec):
             def fn(num_rows, *arrays):
                 b = ColumnarBatch.from_flat_arrays(
                     in_schema, arrays, num_rows)
-                keys, specs, n_eff = build_eval(b)
+                keys, specs, n_eff, mask = build_eval(b)
                 capb = b.capacity
                 order = K.sort_indices(
-                    [K.SortKey(c) for c in keys], n_eff, capb)
+                    [K.SortKey(c) for c in keys], n_eff, capb,
+                    live_mask=mask)
                 skeys = [K.gather_column(c, order) for c in keys]
                 starts = K.segment_starts_from_sorted_keys(
                     skeys, n_eff, capb)
@@ -1559,8 +1659,9 @@ class TpuHashAggregateExec(TpuExec):
                 return order, starts, n_eff, jnp.stack(parts)
             return jax.jit(fn)
         probe = _fused_fn(sig + ("sort-probe", cap), build_sort_probe)
-        order, starts, n_eff_dev, dec = probe(
-            _dev_count(batch), *batch.flat_arrays())
+        with _trace_exec(self):
+            order, starts, n_eff_dev, dec = probe(
+                _dev_count(batch), *batch.flat_arrays())
         return ("sortmm", batch, phase, sig, in_schema, cap,
                 order, starts, n_eff_dev, dec)
 
@@ -1575,14 +1676,15 @@ class TpuHashAggregateExec(TpuExec):
             def fn(num_rows, *arrays):
                 b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
                                                    num_rows)
-                keys, specs, n_eff = build_eval(b)
+                keys, specs, n_eff, mask = build_eval(b)
                 ok, oa, ng = agg_k.groupby_aggregate(
-                    keys, specs, n_eff, b.capacity)
+                    keys, specs, n_eff, b.capacity, live_mask=mask)
                 flat = [a for c in ok + oa for a in c.arrays()]
                 return tuple(flat) + (ng,)
             return jax.jit(fn)
         fn = _fused_fn(sig + ("sort", cap), build_sort)
-        outs = fn(_dev_count(batch), *batch.flat_arrays())
+        with _trace_exec(self):
+            outs = fn(_dev_count(batch), *batch.flat_arrays())
         pb = ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                             outs[-1])
         return ("done", pb)
@@ -1640,14 +1742,17 @@ class TpuHashAggregateExec(TpuExec):
             def fn(num_rows, rmin_d, *arrays):
                 b = ColumnarBatch.from_flat_arrays(
                     in_schema, arrays, num_rows)
-                keys, specs, n_eff = build_eval(b)
+                keys, specs, n_eff, mask = build_eval(b)
                 ok, oa, ng = agg_k.groupby_dense(
-                    keys[0], specs, n_eff, Kb, rmin_d)
+                    keys[0], specs,
+                    num_rows if mask is not None else n_eff, Kb, rmin_d,
+                    extra_mask=mask)
                 flat = [a for c in ok + oa for a in c.arrays()]
                 return tuple(flat) + (ng,)
             return jax.jit(fn)
         fn = _fused_fn(sig + ("dense", cap, Kb), build_dense)
-        outs = fn(_dev_count(batch), rmin, *batch.flat_arrays())
+        with _trace_exec(self):
+            outs = fn(_dev_count(batch), rmin, *batch.flat_arrays())
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                               outs[-1])
 
@@ -1673,7 +1778,7 @@ class TpuHashAggregateExec(TpuExec):
             def fn(num_rows, order, starts, n_eff, *arrays):
                 b = ColumnarBatch.from_flat_arrays(
                     in_schema, arrays, num_rows)
-                keys, specs, _n = build_eval(b)
+                keys, specs, _n, _mask = build_eval(b)
                 capb = b.capacity
                 live = jnp.arange(capb) < n_eff
                 seg_ids = K.segment_ids(starts)
@@ -1703,8 +1808,9 @@ class TpuHashAggregateExec(TpuExec):
             return jax.jit(fn)
         fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm),
                        build_sort_kernel)
-        outs = fn(_dev_count(batch), order, starts,
-                  n_eff_dev, *batch.flat_arrays())
+        with _trace_exec(self):
+            outs = fn(_dev_count(batch), order, starts,
+                      n_eff_dev, *batch.flat_arrays())
         # group count came back with the probe stats — no second readback
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                               n_groups)
@@ -1793,24 +1899,26 @@ class TpuHashAggregateExec(TpuExec):
 
         def build():
             def fn(num_rows, *arrays):
+                node = _trace_exec_stack()[-1]   # no self capture: see _FUSED_CACHE
                 b = ColumnarBatch.from_flat_arrays(in_schema, arrays,
                                                    num_rows)
-                keys, specs = self._merge_specs(b)
+                keys, specs = node._merge_specs(b)
                 if not keys:
                     aggs = agg_k.reduce_aggregate(specs, num_rows,
                                                   b.capacity)
-                    out = self._project_results([], aggs, 1)
+                    out = node._project_results([], aggs, 1)
                     ng = jnp.int32(1)
                 else:
                     ok, aggs, ng = agg_k.groupby_aggregate(
                         keys, specs, num_rows, b.capacity)
-                    out = self._project_results(ok, aggs, ng)
+                    out = node._project_results(ok, aggs, ng)
                 return tuple(out.flat_arrays()) + (ng,)
             return jax.jit(fn)
 
         try:
             fn = _fused_fn(sig + ("final", tuple(rkeys), cap), build)
-            outs = fn(_dev_count(batch), *batch.flat_arrays())
+            with _trace_exec(self):
+                outs = fn(_dev_count(batch), *batch.flat_arrays())
             return ColumnarBatch.from_flat_arrays(
                 self._out_schema, list(outs[:-1]), outs[-1])
         except Exception as e:
@@ -1869,6 +1977,12 @@ class TpuHashAggregateExec(TpuExec):
         return ColumnarBatch(self._out_schema, out_cols, n_groups)
 
     def _rewrite_result(self, e: ex.Expression, nk: int) -> ex.Expression:
+        # computed grouping keys restated in the output (SQL `GROUP BY
+        # expr` re-parses the expression) match STRUCTURALLY via
+        # _expr_cache_key; unkeyable exprs still need identity
+        gkeys = [None if isinstance(g, ex.ColumnRef) else _expr_cache_key(g)
+                 for g in self.grouping_src]
+
         def fn(node):
             for i, leaf in enumerate(self.leaves):
                 if node is leaf:
@@ -1878,6 +1992,9 @@ class TpuHashAggregateExec(TpuExec):
                         isinstance(node, ex.ColumnRef) and
                         isinstance(g, ex.ColumnRef) and
                         node.col_name == g.col_name):
+                    return ex.BoundReference(gi, g.dtype, True)
+                if gkeys[gi] is not None and type(node) is type(g) \
+                        and _expr_cache_key(node) == gkeys[gi]:
                     return ex.BoundReference(gi, g.dtype, True)
             return None
         # top-down: leaf matching is by identity (see overrides rewrite note)

@@ -166,3 +166,38 @@ def test_string_key_map_falls_back():
     df = s.createDataFrame({"m": [{"a": 1}, {"b": 2}, None]})
     out = df.select(F.get_item(col("m"), lit("a")).alias("x")).collect()
     assert out == [(1,), (None,), (None,)]
+
+
+def test_string_key_map_collect_roundtrip():
+    """map<string,_> columns (CPU-engine-only dtype) must survive the
+    host collect boundary as python objects instead of crashing in the
+    device bitpattern encoding (ObjectColumn path)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.getOrCreate()
+    out = s.createDataFrame({"m": [{"a": 1}, {"b": 2}, None]}).collect()
+    assert out == [({"a": 1},), ({"b": 2},), (None,)]
+    # mixed with a device column, and arrow round-trip
+    df = s.createDataFrame({"m": [{"a": 1}, {"b": 2}], "k": [1, 2]})
+    assert df.collect() == [({"a": 1}, 1), ({"b": 2}, 2)]
+    at = df.to_arrow()
+    assert at.column("m").to_pylist() == [[("a", 1)], [("b", 2)]]
+
+
+def test_map_infer_widens_across_rows():
+    """Value-type inference scans every dict: int-then-float columns must
+    widen to double instead of silently truncating later rows."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.getOrCreate()
+    out = s.createDataFrame({"m": [{1: 1}, {2: 2.5}]}).collect()
+    assert out == [({1: 1.0},), ({2: 2.5},)]
+
+
+def test_bigint_lookup_on_narrow_key_map_no_wrap():
+    """A bigint lookup key larger than 2^32 must not wrap modulo 2^32 and
+    falsely match a narrow map key (integral compares happen in int64)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.getOrCreate()
+    big = (1 << 32) + 1
+    df = s.createDataFrame({"m": [{1: 10}, {big: 20}]})
+    out = df.select(F.get_item(col("m"), lit(big)).alias("x")).collect()
+    assert out == [(None,), (20,)]

@@ -1,0 +1,42 @@
+"""TPC-DS-like benchmark queries, golden-compared at tiny scale (the
+tpcds_test.py analog of the reference's integration suite; BASELINE.md
+milestone 2)."""
+
+import pytest
+
+from benchmarks import datagen, tpcds_queries as DS
+
+from golden import assert_tpu_and_cpu_equal
+
+_SF = 0.002
+
+
+@pytest.mark.parametrize("qname", sorted(DS.TPCDS_QUERIES))
+def test_tpcds_query_golden(qname):
+    assert_tpu_and_cpu_equal(
+        lambda s: DS.TPCDS_QUERIES[qname](
+            datagen.register_tpcds_tables(s, _SF)),
+        approx=1e-5, ignore_order=False)
+
+
+def test_rollup_golden():
+    """df.rollup grouping sets vs the CPU oracle (GpuExpandExec path)."""
+    from spark_rapids_tpu.api import functions as F
+
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(
+            {"a": ["x", "x", "y", "y", "z"], "b": [1, 2, 1, 1, 3],
+             "v": [10.0, 20.0, 30.0, 5.0, 7.5]})
+        .rollup("a", "b").agg(F.sum("v").alias("sv"),
+                              F.count("*").alias("c")),
+        approx=1e-9, ignore_order=True)
+
+
+def test_cube_golden():
+    from spark_rapids_tpu.api import functions as F
+
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(
+            {"a": ["x", "x", "y"], "b": [1, 2, 1], "v": [1.0, 2.0, 4.0]})
+        .cube("a", "b").agg(F.sum("v").alias("sv")),
+        approx=1e-9, ignore_order=True)

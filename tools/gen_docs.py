@@ -52,6 +52,28 @@ def main() -> None:
             notes.append(f"disabled: {rule.disabled_reason}")
         lines.append(f"| {klass.__name__} | {'; '.join(notes) or '—'} | "
                      f"{rule.conf_key} |")
+    lines += [
+        "",
+        "## Known semantic deviations",
+        "",
+        "User-facing behavior differences from Spark (device and the CPU "
+        "oracle agree with each other, not with Spark, on these inputs):",
+        "",
+        "- `CreateMap` with a NULL key yields a NULL map; Spark raises "
+        "`RuntimeException` (null as map key).",
+        "- `element_at(map, k)` with `k` absent yields NULL (matches "
+        "Spark); `element_at(array, 0)` yields NULL where Spark raises "
+        "an invalid-index error.",
+        "- `MapValues` renders NULL map values as NULL entries in the "
+        "result array only when the element type is nullable on host; "
+        "device arrays cannot hold NULL elements, so NULL values read "
+        "back as 0 on the device path.",
+        "- `persist(storageLevel)` accepts and ignores the storage level "
+        "(the spill tiers decide residency; `cache()` semantics).",
+        "- Maps with string keys or values, `array<string>`, and nested "
+        "complex types run on the CPU engine only (planner-tagged off "
+        "the device).",
+    ]
     with open(os.path.join(ROOT, "docs", "supported_ops.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     print("regenerated docs/configs.md and docs/supported_ops.md")
