@@ -21,19 +21,27 @@ from . import datagen, queries as Q
 
 def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                   iterations: int = 2, verify: bool = False,
-                  output: Optional[str] = None) -> Dict:
+                  output: Optional[str] = None, suite: str = "tpch") -> Dict:
     from spark_rapids_tpu.api.session import TpuSession
     session = TpuSession.builder.config(
         "spark.rapids.tpu.sql.explain", "NONE").getOrCreate()
 
+    if suite == "tpcds":
+        from . import tpcds_queries
+        queries = tpcds_queries.TPCDS_QUERIES
+        register = datagen.register_tpcds_tables
+    else:
+        queries = Q.QUERIES
+        register = datagen.register_tables
     t_gen0 = time.perf_counter()
-    tables = datagen.register_tables(session, sf)
+    tables = register(session, sf)
     gen_s = time.perf_counter() - t_gen0
 
-    report: Dict = {"sf": sf, "datagen_s": round(gen_s, 3), "queries": {}}
-    names = query_names or list(Q.QUERIES)
+    report: Dict = {"suite": suite, "sf": sf, "datagen_s": round(gen_s, 3),
+                    "queries": {}}
+    names = query_names or list(queries)
     for name in names:
-        qfn = Q.QUERIES[name]
+        qfn = queries[name]
         timings = []
         rows = 0
         for it in range(iterations):
@@ -87,13 +95,17 @@ def _verify(session, df, epsilon: float = 1e-4) -> bool:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=0.01)
-    ap.add_argument("--queries", type=str, default=",".join(Q.QUERIES))
+    ap.add_argument("--suite", type=str, default="tpch",
+                    choices=("tpch", "tpcds"))
+    ap.add_argument("--queries", type=str, default=None)
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--output", type=str, default=None)
     args = ap.parse_args()
-    report = run_benchmark(args.sf, args.queries.split(","), args.iterations,
-                           args.verify, args.output)
+    report = run_benchmark(args.sf,
+                           args.queries.split(",") if args.queries else None,
+                           args.iterations, args.verify, args.output,
+                           suite=args.suite)
     print(json.dumps(report, indent=2))
 
 

@@ -84,6 +84,12 @@ class Col:
         return Col(st.Substring(self.expr, _unwrap(start), _unwrap(length)))
 
     # misc
+    def getField(self, name: str) -> "Col":
+        """struct.field access (GetStructField; shredded to a flat scan
+        column by the planner when possible)."""
+        from ..ops.structs import GetField
+        return Col(GetField(self.expr, name))
+
     def alias(self, name: str) -> "Col":
         return Col(ex.Alias(self.expr, name))
 

@@ -358,15 +358,46 @@ class GroupedData:
         self.sets = sets          # None | "rollup" | "cube"
 
     def agg(self, *aggs: Union[Col, Dict[str, str]]) -> DataFrame:
+        from ..ops.python_udf import PandasAggUDF
         if len(aggs) == 1 and isinstance(aggs[0], dict):
             aggs = tuple(
                 getattr(F, op if op != "mean" else "avg")(F.col(c))
                 for c, op in aggs[0].items())
         agg_exprs = [_unwrap(a) for a in aggs]
+
+        def is_pandas_agg(e):
+            inner = e.children[0] if isinstance(e, ex.Alias) else e
+            return isinstance(inner, PandasAggUDF)
+        if any(is_pandas_agg(e) for e in agg_exprs):
+            if self.sets:
+                raise ValueError(
+                    "grouped-agg pandas UDFs do not support rollup/cube")
+            if not all(is_pandas_agg(e) for e in agg_exprs):
+                raise ValueError(
+                    "cannot mix grouped-agg pandas UDFs with built-in "
+                    "aggregates in one agg() (pyspark restriction)")
+            names = [ex.output_name(g, i)
+                     for i, g in enumerate(self.grouping)]
+            names += [e.alias if isinstance(e, ex.Alias)
+                      else ex.output_name(e, len(names) + i)
+                      for i, e in enumerate(agg_exprs)]
+            inner = [e.children[0] if isinstance(e, ex.Alias) else e
+                     for e in agg_exprs]
+            return self.df._df(lp.AggregateInPandas(
+                self.df._plan, self.grouping, inner, names))
         if self.sets:
             return self._agg_grouping_sets(agg_exprs)
         out: List[ex.Expression] = list(self.grouping) + agg_exprs
         return self.df._df(lp.Aggregate(self.df._plan, self.grouping, out))
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        """fn(pandas.DataFrame) -> DataFrame — or fn(key_tuple, pdf) —
+        applied once per group (GpuFlatMapGroupsInPandasExec analog)."""
+        from ..columnar import dtypes as dtm
+        if not isinstance(schema, dtm.Schema):
+            schema = dtm.Schema(schema)
+        return self.df._df(lp.FlatMapGroupsInPandas(
+            self.df._plan, list(self.grouping), fn, schema))
 
     def _agg_grouping_sets(self, agg_exprs: List[ex.Expression]) -> DataFrame:
         """rollup/cube: Expand replicates every input row once per grouping

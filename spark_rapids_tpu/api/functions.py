@@ -464,18 +464,28 @@ def udf(fn=None, returnType="double"):
     return wrap(fn) if fn is not None else wrap
 
 
-def pandas_udf(fn=None, returnType="double"):
-    """Vectorized pandas UDF: fn(pandas.Series...) -> Series (no bytecode
-    translation attempt; always the Arrow round-trip path)."""
+def pandas_udf(fn=None, returnType="double", functionType: str = "scalar"):
+    """Vectorized pandas UDF (no bytecode translation attempt; always the
+    Arrow round-trip path).
+
+    ``functionType="scalar"``: fn(pandas.Series...) -> Series, row-wise.
+    ``functionType="grouped_agg"``: fn(pandas.Series...) -> scalar, one
+    call per group inside groupBy(...).agg(...)
+    (GpuAggregateInPandasExec path)."""
     rt = dt.of(returnType) if not isinstance(returnType, dt.DType) else returnType
+    if functionType not in ("scalar", "grouped_agg"):
+        raise ValueError(f"unsupported pandas_udf functionType "
+                         f"{functionType!r}")
 
     def wrap(f):
         def call(*cols):
-            from ..ops.python_udf import PandasUDF
+            from ..ops.python_udf import PandasAggUDF, PandasUDF
             args = [_unwrap(c) if isinstance(c, Col) else ex.ColumnRef(c)
                     for c in cols]
-            return Col(PandasUDF(f, rt, *args,
-                                 name=getattr(f, "__name__", "pandas_udf")))
+            klass = PandasAggUDF if functionType == "grouped_agg" \
+                else PandasUDF
+            return Col(klass(f, rt, *args,
+                             name=getattr(f, "__name__", "pandas_udf")))
         call.__name__ = getattr(f, "__name__", "pandas_udf")
         return call
     return wrap(fn) if fn is not None else wrap

@@ -165,9 +165,6 @@ class _Parser:
         df = self.parse_from()
         if self.take_kw("WHERE"):
             df = df.filter(Col(self.parse_expr()))
-        self._check_qualified_refs()
-        self._qualified_refs, self._from_columns = outer_refs, outer_cols
-        self._has_cross = outer_cross
         group_exprs = None
         if self.take_kw("GROUP"):
             self.expect_kw("BY")
@@ -184,6 +181,11 @@ class _Parser:
             if t.kind != "number":
                 raise SqlParseError(f"LIMIT expects a number, got {t.text!r}")
             df = df.limit(int(t.text))
+        # after EVERY clause parsed (GROUP BY / HAVING / ORDER BY refs
+        # included), then restore the enclosing query's scope
+        self._check_qualified_refs()
+        self._qualified_refs, self._from_columns = outer_refs, outer_cols
+        self._has_cross = outer_cross
         return df
 
     def parse_select_list(self):
@@ -416,10 +418,14 @@ class _Parser:
         while True:
             if self.take_op("+"):
                 r = self.parse_multiplicative()
+                if isinstance(e, _Interval):       # INTERVAL + date
+                    e, r = r, e
                 e = _date_arith(e, r, +1) if isinstance(r, _Interval) \
                     else _unwrap(Col(e) + Col(r))
             elif self.take_op("-"):
                 r = self.parse_multiplicative()
+                if isinstance(e, _Interval):
+                    raise SqlParseError("INTERVAL - <expr> is not valid")
                 e = _date_arith(e, r, -1) if isinstance(r, _Interval) \
                     else _unwrap(Col(e) - Col(r))
             elif self.take_op("||"):
@@ -600,12 +606,23 @@ class _Parser:
 
 class _Interval(ex.Literal):
     """Day/month/year interval literal; only valid next to +/- against a
-    date expression, where it folds into date_add/add_months."""
+    date expression, where it folds into date_add/add_months. Escaping
+    that fold raises (never a silently wrong plan): any attempt to type
+    or evaluate an unfolded interval fails parse/analysis."""
 
     def __init__(self, n: int, unit: str):
         super().__init__(n if unit == "DAY" else 0)
         self.n = n
         self.unit = unit
+
+    @property
+    def dtype(self):
+        raise SqlParseError(
+            f"INTERVAL '{self.n}' {self.unit} is only supported as the "
+            "right operand of date +/- arithmetic")
+
+    def eval(self, batch):
+        self.dtype    # raises
 
 
 def _date_arith(e: ex.Expression, iv: "_Interval", sign: int):
@@ -624,25 +641,29 @@ def _has_agg(e) -> bool:
 
 def _extract_having(cond: ex.Expression, select_exprs):
     """Replace aggregate subtrees in a HAVING condition with refs to
-    (possibly hidden) aggregation output columns."""
+    (possibly hidden) aggregation output columns. Matching against the
+    select list uses the faithful structural key (physical's
+    _expr_cache_key — reprs omit load-bearing attributes like LIKE
+    patterns); unkeyable aggregates always get their own hidden column."""
+    from ..plan.physical import _expr_cache_key
     extra: List[ex.Expression] = []
     named = {}
     for i, e in enumerate(select_exprs):
         inner = e.children[0] if isinstance(e, ex.Alias) else e
-        named[repr(inner)] = ex.ColumnRef(ex.output_name(e, i))
+        k = _expr_cache_key(inner)
+        if k is not None:
+            named[k] = ex.ColumnRef(ex.output_name(e, i))
 
     def walk(e):
-        if _has_agg(e) and not isinstance(e, lp.AggregateExpression):
-            # composite like sum(x)/count(y) — recurse into children
-            pass
         if isinstance(e, lp.AggregateExpression):
-            key = repr(e)
-            if key in named:
+            key = _expr_cache_key(e)
+            if key is not None and key in named:
                 return named[key]
             name = f"_having_{len(extra)}"
             extra.append(ex.Alias(e, name))
             ref = ex.ColumnRef(name)
-            named[key] = ref
+            if key is not None:
+                named[key] = ref
             return ref
         kids = getattr(e, "children", [])
         for i, c in enumerate(kids):

@@ -149,7 +149,8 @@ class ColumnarBatch:
         schema = dt.Schema(fields)
         # ARRAY<...> columns need the python-list path (device-building):
         # decide from the schema BEFORE converting anything twice
-        if n == 0 or any(dt.is_array(f.dtype) or dt.is_map(f.dtype)
+        if n == 0 or any(dt.is_array(f.dtype) or dt.is_map(f.dtype) or
+                         dt.is_struct(f.dtype)
                          for f in fields):
             return ("fallback", schema, table, cap, n)
         hosts = [Column.host_from_arrow(table.column(i), capacity=cap)

@@ -142,6 +142,10 @@ class Column:
                     capacity: Optional[int] = None,
                     width: Optional[int] = None) -> "Column":
         n = len(values)
+        if dt.is_struct(dtype):
+            # whole-struct values only exist host-side (the device sees
+            # SHREDDED child columns; see dtypes.STRUCT)
+            return ObjectColumn(dtype, values, capacity)
         if (dt.is_map(dtype) or dt.is_array(dtype)) and \
                 dtype.numpy_dtype is None:
             # CPU-engine-only complex dtype (e.g. map<string,_>): these are
@@ -286,7 +290,7 @@ class Column:
             valid_full = np.zeros(cap, np.bool_)
             valid_full[:n] = valid
             return (dt.STRING, [mat, valid_full, lens_full])
-        if dt.is_array(dtype) or dt.is_map(dtype):
+        if dt.is_array(dtype) or dt.is_map(dtype) or dt.is_struct(dtype):
             return None
         np_valid = np.ones(len(arr), dtype=np.bool_) if arr.null_count == 0 else \
             np.asarray(arr.is_valid())

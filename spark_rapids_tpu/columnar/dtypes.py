@@ -28,6 +28,7 @@ class DType:
     var_width: bool = False           # 2-D padded data + lengths (string/array)
     element: Optional["DType"] = None  # ARRAY element type / MAP value type
     key: Optional["DType"] = None      # MAP key type
+    fields: Optional[tuple] = None     # STRUCT (name, DType) pairs
 
     def __repr__(self) -> str:
         return self.name
@@ -112,12 +113,36 @@ def MAP(key: DType, value: DType) -> DType:
     return t
 
 
+_STRUCT_CACHE: dict = {}
+
+
+def STRUCT(fields) -> DType:
+    """STRUCT<name:type,...>. No device layout of its own: the planner
+    SHREDS referenced fields into flat child columns at the scan (the
+    columnar-storage move — parquet stores structs shredded anyway), and a
+    whole-struct value only materializes host-side through the
+    python-object column path (like map<string,_>). The reference's analog
+    is GpuColumnVector's nested-type matrix + complexTypeExtractors."""
+    fields = tuple((n, t) for n, t in fields)
+    name = "struct<" + ",".join(f"{n}:{t.name}" for n, t in fields) + ">"
+    t = _STRUCT_CACHE.get(name)
+    if t is None:
+        t = DType(name, None, var_width=True, fields=fields)
+        _STRUCT_CACHE[name] = t
+        _BY_NAME[name] = t
+    return t
+
+
 def is_array(t: DType) -> bool:
     return t.element is not None and t.key is None
 
 
 def is_map(t: DType) -> bool:
     return t.key is not None
+
+
+def is_struct(t: DType) -> bool:
+    return t.fields is not None
 
 
 def of(name_or_dtype: Any) -> DType:
@@ -176,6 +201,10 @@ def from_arrow(arrow_type) -> DType:
     if pa.types.is_map(arrow_type):
         return MAP(from_arrow(arrow_type.key_type),
                    from_arrow(arrow_type.item_type))
+    if pa.types.is_struct(arrow_type):
+        return STRUCT([(arrow_type.field(i).name,
+                        from_arrow(arrow_type.field(i).type))
+                       for i in range(arrow_type.num_fields)])
     raise ValueError(f"unsupported arrow type {arrow_type}")
 
 
@@ -190,6 +219,8 @@ def to_arrow(t: DType):
         return pa.map_(to_arrow(t.key), to_arrow(t.element))
     if is_array(t):
         return pa.list_(to_arrow(t.element))
+    if is_struct(t):
+        return pa.struct([(n, to_arrow(ft)) for n, ft in t.fields])
     return mapping[t]
 
 

@@ -344,11 +344,21 @@ MESH_ENABLED = _conf("spark.rapids.tpu.sql.mesh.enabled").doc(
 ).create_with_default("auto")
 
 MESH_MAX_STAGE_BYTES = _conf("spark.rapids.tpu.sql.mesh.maxStageBytes").doc(
-    "Upper bound on the estimated input size of a mesh-routed stage: the "
-    "SPMD pipeline stages the whole input as one host batch and sizes "
-    "receive windows at workers*cap, so inputs above this keep the "
-    "spillable host exchange path with bounded residency"
+    "Upper bound on the estimated input size of a SINGLE-SHOT mesh stage "
+    "(whole input staged at once, receive windows workers*cap). "
+    "Fixed-width group-bys above this stream in bounded multi-round "
+    "windows instead (mesh.streamWindowRows); var-width stages keep the "
+    "spillable host exchange path"
 ).bytes_conf.create_with_default(2 * 1024 * 1024 * 1024)
+
+MESH_STREAM_WINDOW_ROWS = _conf(
+    "spark.rapids.tpu.sql.mesh.streamWindowRows").doc(
+    "Rows per worker per round for the STREAMING mesh group-by (stages "
+    "above mesh.maxStageBytes): per-round residency is "
+    "O(workers x window) input plus the group accumulator, the analog of "
+    "the reference's windowed shuffle transfers "
+    "(WindowedBlockIterator.scala)"
+).integer_conf.check(lambda v: int(v) >= 1024).create_with_default(1 << 17)
 
 MATMUL_AGG = _conf("spark.rapids.tpu.sql.agg.matmul.enabled").doc(
     "MXU one-hot-matmul segment reductions for group-by sum/count/avg: "

@@ -71,6 +71,13 @@ class CpuEvaluator:
             return [e.value] * self.n
         if isinstance(e, st.RegExpReplaceHost):
             return e.apply_list(self._eval(e.children[0]))
+        from ..ops.structs import GetField
+        if isinstance(e, GetField):
+            vals = self._eval(e.children[0])
+            return [None if v is None else
+                    (v.get(e.field) if isinstance(v, dict)
+                     else getattr(v, e.field, None))
+                    for v in vals]
         from ..ops.python_udf import PandasUDF
         if isinstance(e, PandasUDF):
             import pandas as pd
@@ -926,6 +933,48 @@ def _exec(plan: lp.LogicalPlan) -> pd.DataFrame:
         # coerce to the declared schema: order + presence (the TPU path
         # rebuilds through _df_to_batch(out_schema) the same way)
         return out[[n for n in names]]
+    if isinstance(plan, lp.FlatMapGroupsInPandas):
+        import inspect
+        child = _exec(plan.children[0])
+        ev = CpuEvaluator(child)
+        kf = pd.DataFrame({f"_gk{i}": ev.eval(g)
+                           for i, g in enumerate(plan.grouping)})
+        try:
+            two_arg = len(inspect.signature(plan.fn).parameters) == 2
+        except (TypeError, ValueError):
+            two_arg = False
+        frames = []
+        for key, idx in kf.groupby(list(kf.columns), sort=True,
+                                   dropna=False).groups.items():
+            if not isinstance(key, tuple):
+                key = (key,)
+            pdf = child.loc[idx].reset_index(drop=True)
+            out = plan.fn(key, pdf) if two_arg else plan.fn(pdf)
+            if out is not None and len(out):
+                frames.append(out)
+        names = plan.out_schema.names()
+        if not frames:
+            return _obj_df({n: [] for n in names})
+        return pd.concat(frames, ignore_index=True)[[n for n in names]]
+    if isinstance(plan, lp.AggregateInPandas):
+        child = _exec(plan.children[0])
+        ev = CpuEvaluator(child)
+        kf = pd.DataFrame({f"_gk{i}": ev.eval(g)
+                           for i, g in enumerate(plan.grouping)})
+        inputs = [[pd.Series(ev.eval(c)) for c in a.children]
+                  for a in plan.aggs]
+        rows = []
+        for key, idx in kf.groupby(list(kf.columns), sort=True,
+                                   dropna=False).groups.items():
+            if not isinstance(key, tuple):
+                key = (key,)
+            vals = [a.fn(*[s.loc[idx].reset_index(drop=True)
+                           for s in ins])
+                    for a, ins in zip(plan.aggs, inputs)]
+            rows.append(tuple(key) + tuple(vals))
+        names = plan.out_names
+        return _obj_df({n: [r[i] for r in rows]
+                        for i, n in enumerate(names)})
     if isinstance(plan, lp.Generate):
         child = _exec(plan.children[0])
         ev = CpuEvaluator(child)
@@ -1041,17 +1090,19 @@ def _agg_py(op: str, vals: List[Any], ignore_nulls: bool):
 def _eval_result_expr(e, k, plan, gcols, groups, agg_leaves, leaf_results):
     """Evaluate an output expression for group k: aggregate leaves are looked
     up; grouping expressions take the group's key value; literals fold."""
+    # grouping match FIRST (an aliased computed grouping key is the same
+    # object in both lists — stripping the alias before comparing would
+    # miss it and recurse into unresolvable column refs)
+    for gi, g in enumerate(plan.grouping):
+        if _same_expr(e, g):
+            return k[gi] if not isinstance(k[gi], tuple) else (
+                float("nan") if k[gi] == ("nan",) else k[gi])
     if isinstance(e, ex.Alias):
         return _eval_result_expr(e.children[0], k, plan, gcols, groups,
                                  agg_leaves, leaf_results)
     for i, leaf in enumerate(agg_leaves):
         if e is leaf:
             return leaf_results[i][k]
-    # grouping expression matching by structure
-    for gi, g in enumerate(plan.grouping):
-        if _same_expr(e, g):
-            return k[gi] if not isinstance(k[gi], tuple) else (
-                float("nan") if k[gi] == ("nan",) else k[gi])
     if isinstance(e, ex.Literal):
         return e.value
     # arithmetic over aggregate results (e.g. sum/count)
@@ -1067,6 +1118,10 @@ def _eval_result_expr(e, k, plan, gcols, groups, agg_leaves, leaf_results):
 def _same_expr(a: ex.Expression, b: ex.Expression) -> bool:
     if a is b:
         return True
+    if isinstance(a, ex.Alias):
+        return _same_expr(a.children[0], b)
+    if isinstance(b, ex.Alias):
+        return _same_expr(a, b.children[0])
     if isinstance(a, ex.ColumnRef) and isinstance(b, ex.ColumnRef):
         return a.col_name == b.col_name
     return False

@@ -68,6 +68,40 @@ class PandasUDF(Expression):
         return f"{self.udf_name}({args})"
 
 
+class PandasAggUDF(Expression):
+    """Grouped-aggregate pandas UDF marker: fn(pandas.Series...) -> scalar
+    per group (pyspark GROUPED_AGG; GpuAggregateInPandasExec's udf). Never
+    evaluated row-wise — the planner routes the Aggregate through
+    TpuAggregateInPandasExec, which slices per-group frames and calls
+    ``fn`` once per group."""
+
+    fusable = False
+
+    def __init__(self, fn: Callable, return_type: dt.DType,
+                 *children: Expression, name: Optional[str] = None):
+        super().__init__(*children)
+        self.fn = fn
+        self.return_type = return_type
+        self.udf_name = name or getattr(fn, "__name__", "agg_udf")
+
+    @property
+    def dtype(self) -> dt.DType:
+        return self.return_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        raise RuntimeError(
+            f"grouped-agg pandas UDF {self.udf_name!r} is planned by "
+            "AggregateInPandas, not evaluated directly")
+
+    def __repr__(self):
+        args = ", ".join(repr(c) for c in self.children)
+        return f"{self.udf_name}({args})"
+
+
 def rebatch_iterator(batches, target_rows: int):
     """Align batch sizes to ~target_rows (RebatchingRoundoffIterator,
     GpuArrowEvalPythonExec.scala): concat small batches, slice large ones,

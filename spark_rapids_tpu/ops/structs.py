@@ -1,0 +1,53 @@
+"""STRUCT field access.
+
+Reference: ``complexTypeExtractors.scala`` (GetStructField). TPU-first
+design: struct columns have NO device layout — the planner SHREDS every
+referenced field into a flat child column at the scan
+(overrides._shred_struct_columns), so a GetField that survives to
+execution only ever sees the host-side ObjectColumn rendering (CPU
+fallback plans and whole-struct materializations)."""
+
+from __future__ import annotations
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from ..columnar.column import Column, ObjectColumn
+from .expressions import Expression, materialize
+
+
+class GetField(Expression):
+    """struct.field (GetStructField analog)."""
+
+    fusable = False          # only evaluated on host object columns
+
+    def __init__(self, child: Expression, field: str):
+        super().__init__(child)
+        self.field = field
+
+    @property
+    def dtype(self) -> dt.DType:
+        child_t = self.children[0].dtype
+        if not dt.is_struct(child_t):
+            raise TypeError(f"getField on non-struct {child_t}")
+        for n, t in child_t.fields:
+            if n == self.field:
+                return t
+        raise TypeError(f"no field {self.field!r} in {child_t}")
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def eval(self, batch: ColumnarBatch):
+        col = materialize(self.children[0].eval(batch), batch)
+        if not isinstance(col, ObjectColumn):
+            raise RuntimeError(
+                "GetField reached a device struct column — the planner "
+                "should have shredded it (overrides._shred_struct_columns)")
+        vals = [None if v is None else v.get(self.field)
+                for v in col.values]
+        return Column.from_pylist(vals, self.dtype,
+                                  capacity=col.capacity)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.field}"

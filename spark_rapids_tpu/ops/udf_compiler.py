@@ -8,17 +8,21 @@ falling back to the original UDF when translation fails.
 
 TPU-standalone analog: ``dis`` disassembles the python function; a symbolic
 stack machine maps the instruction stream onto this framework's expression
-algebra. Scope: straight-line scalar lambdas — arithmetic, comparisons,
-boolean logic, ``abs``/``min``/``max``, constants, closure cells. Branching
-control flow (the reference handles it via CFG reconvergence) falls back to
-the pandas-UDF host path — identical contract to the reference's fallback
-(Plugin.scala:28-94).
+algebra. Scope: scalar lambdas/functions with arithmetic, comparisons,
+boolean logic, ``abs``/``min``/``max``, constants, closure cells, and
+BRANCHING control flow — if/else, ternaries, early returns, and/or
+short-circuits translate by exploring both arms of every conditional jump
+with an accumulated path condition and reconverging the per-path returns
+into a CASE WHEN chain (the reference's CFG reconvergence,
+``CFG.scala:329``). Loops (backward jumps) and anything else unsupported
+fall back to the pandas-UDF host path — identical contract to the
+reference's fallback (Plugin.scala:28-94).
 """
 
 from __future__ import annotations
 
 import dis
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..columnar import dtypes as dt
 from . import arithmetic as ar
@@ -47,6 +51,8 @@ _CALLS = {
     "max": lambda args: co.Greatest(*args),
 }
 
+_MAX_PATHS = 64          # branch-path explosion guard
+
 
 def try_compile_udf(fn: Callable, arg_exprs: List[Expression]
                     ) -> Optional[Expression]:
@@ -66,78 +72,190 @@ def _compile(fn: Callable, arg_exprs: List[Expression]) -> Expression:
     if code.co_argcount != len(arg_exprs):
         raise UdfTranslationError("arity mismatch")
     local_names = code.co_varnames
-    env = {local_names[i]: e for i, e in enumerate(arg_exprs)}
+    env: Dict[str, Any] = {local_names[i]: e
+                           for i, e in enumerate(arg_exprs)}
     closure = {}
     if fn.__closure__:
         for name, cell in zip(code.co_freevars, fn.__closure__):
             closure[name] = cell.cell_contents
-    globals_ = fn.__globals__
+    tr = _Translator(fn, env, closure)
+    paths = tr.run()
+    if not paths:
+        raise UdfTranslationError("no return path")
+    if len(paths) == 1:
+        return _as_expr(paths[0][1])
+    # reconvergence: exclusive path conditions in exploration order -> one
+    # CASE WHEN chain; the final path is the residual ELSE
+    branches = [(cond, _as_expr(val)) for cond, val in paths[:-1]]
+    return co.CaseWhen(branches, _as_expr(paths[-1][1]))
 
-    stack: List[Any] = []
 
-    def as_expr(v) -> Expression:
-        if isinstance(v, Expression):
-            return v
-        if isinstance(v, (int, float, bool, str)) or v is None:
-            return Literal(v)
-        raise UdfTranslationError(f"unliftable constant {v!r}")
+def _as_expr(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    if isinstance(v, (int, float, bool, str)) or v is None:
+        return Literal(v)
+    raise UdfTranslationError(f"unliftable constant {v!r}")
 
-    for ins in dis.get_instructions(fn):
-        op = ins.opname
-        if op in ("RESUME", "PRECALL", "CACHE", "NOP", "COPY_FREE_VARS",
-                  "MAKE_CELL", "PUSH_NULL"):
-            continue
-        elif op == "LOAD_FAST":
-            if ins.argval not in env:
-                raise UdfTranslationError(f"unbound local {ins.argval}")
-            stack.append(env[ins.argval])
-        elif op == "LOAD_CONST":
-            stack.append(ins.argval)
-        elif op == "LOAD_DEREF":
-            if ins.argval not in closure:
-                raise UdfTranslationError(f"unknown cell {ins.argval}")
-            stack.append(closure[ins.argval])
-        elif op == "LOAD_GLOBAL":
-            name = ins.argval
-            if name in _CALLS:
-                stack.append(("call", name))
-            elif name in globals_ and isinstance(
-                    globals_[name], (int, float, bool, str)):
-                stack.append(globals_[name])
+
+class _Translator:
+    """Symbolic executor over the instruction stream: conditional jumps
+    fork the machine state down BOTH arms with accumulated path
+    conditions; returns collect (condition, value) pairs in path order
+    (the reference's State + Instruction semantics, State.scala:140)."""
+
+    def __init__(self, fn: Callable, env: Dict[str, Any],
+                 closure: Dict[str, Any]):
+        self.instructions = list(dis.get_instructions(fn))
+        self.by_offset = {ins.offset: i
+                          for i, ins in enumerate(self.instructions)}
+        self.globals_ = fn.__globals__
+        self.closure = closure
+        self.base_env = env
+        self.paths: List[Tuple[Optional[Expression], Any]] = []
+
+    def run(self):
+        self._walk(0, [], dict(self.base_env), None, 0)
+        return self.paths
+
+    # -- path management -----------------------------------------------------
+    def _emit(self, cond: Optional[Expression], value) -> None:
+        if len(self.paths) >= _MAX_PATHS:
+            raise UdfTranslationError("too many branch paths")
+        self.paths.append((cond, value))
+
+    def _fork(self, idx: int, stack, env, cond, base_cond, depth):
+        if depth > 64:
+            raise UdfTranslationError("branch depth limit")
+        full = cond if base_cond is None else pr.And(base_cond, cond)
+        self._walk(idx, list(stack), dict(env), full, depth + 1)
+
+    def _jump_index(self, ins) -> int:
+        target = ins.argval      # byte offset of the jump target
+        if target not in self.by_offset:
+            raise UdfTranslationError(f"jump target {target} not found")
+        return self.by_offset[target]
+
+    # -- the machine ---------------------------------------------------------
+    def _walk(self, i: int, stack: List[Any], env: Dict[str, Any],
+              cond: Optional[Expression], depth: int) -> None:
+        while i < len(self.instructions):
+            ins = self.instructions[i]
+            op = ins.opname
+            if op in ("RESUME", "PRECALL", "CACHE", "NOP",
+                      "COPY_FREE_VARS", "MAKE_CELL", "PUSH_NULL",
+                      "TO_BOOL", "NOT_TAKEN"):
+                i += 1
+                continue
+            if op == "LOAD_FAST":
+                if ins.argval not in env:
+                    raise UdfTranslationError(
+                        f"unbound local {ins.argval}")
+                stack.append(env[ins.argval])
+            elif op == "STORE_FAST":
+                env[ins.argval] = stack.pop()
+            elif op == "LOAD_CONST":
+                stack.append(ins.argval)
+            elif op == "LOAD_DEREF":
+                if ins.argval not in self.closure:
+                    raise UdfTranslationError(
+                        f"unknown cell {ins.argval}")
+                stack.append(self.closure[ins.argval])
+            elif op == "LOAD_GLOBAL":
+                name = ins.argval
+                if name in _CALLS:
+                    stack.append(("call", name))
+                elif name in self.globals_ and isinstance(
+                        self.globals_[name], (int, float, bool, str)):
+                    stack.append(self.globals_[name])
+                else:
+                    raise UdfTranslationError(
+                        f"unsupported global {name}")
+            elif op == "BINARY_OP":
+                sym = ins.argrepr.rstrip("=")
+                if sym not in _BINOPS:
+                    raise UdfTranslationError(
+                        f"binary op {ins.argrepr}")
+                r, l = stack.pop(), stack.pop()
+                stack.append(_BINOPS[sym](_as_expr(l), _as_expr(r)))
+            elif op == "COMPARE_OP":
+                sym = ins.argrepr.strip()
+                sym = sym.replace("bool(", "").replace(")", "")
+                if sym not in _CMPOPS:
+                    raise UdfTranslationError(
+                        f"compare op {ins.argrepr}")
+                r, l = stack.pop(), stack.pop()
+                stack.append(_CMPOPS[sym](_as_expr(l), _as_expr(r)))
+            elif op == "UNARY_NEGATIVE":
+                stack.append(ar.UnaryMinus(_as_expr(stack.pop())))
+            elif op == "UNARY_NOT":
+                stack.append(pr.Not(_as_expr(stack.pop())))
+            elif op == "CALL":
+                argc = ins.arg
+                args = [_as_expr(stack.pop())
+                        for _ in range(argc)][::-1]
+                target = stack.pop()
+                if not (isinstance(target, tuple)
+                        and target[0] == "call"):
+                    raise UdfTranslationError("indirect call")
+                stack.append(_CALLS[target[1]](args))
+
+            # -- control flow -----------------------------------------------
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_FORWARD_IF_FALSE"):
+                test = _as_expr(stack.pop())
+                self._fork(i + 1, stack, env, test, cond, depth)
+                self._fork(self._jump_index(ins), stack, env,
+                           pr.Not(test), cond, depth)
+                return
+            elif op in ("POP_JUMP_IF_TRUE", "POP_JUMP_FORWARD_IF_TRUE"):
+                test = _as_expr(stack.pop())
+                self._fork(i + 1, stack, env, pr.Not(test), cond, depth)
+                self._fork(self._jump_index(ins), stack, env, test,
+                           cond, depth)
+                return
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_FORWARD_IF_NONE"):
+                test = _as_expr(stack.pop())
+                self._fork(i + 1, stack, env, pr.IsNotNull(test), cond,
+                           depth)
+                self._fork(self._jump_index(ins), stack, env,
+                           pr.IsNull(test), cond, depth)
+                return
+            elif op in ("POP_JUMP_IF_NOT_NONE",
+                        "POP_JUMP_FORWARD_IF_NOT_NONE"):
+                test = _as_expr(stack.pop())
+                self._fork(i + 1, stack, env, pr.IsNull(test), cond,
+                           depth)
+                self._fork(self._jump_index(ins), stack, env,
+                           pr.IsNotNull(test), cond, depth)
+                return
+            elif op in ("JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP"):
+                want_true = op == "JUMP_IF_TRUE_OR_POP"
+                test = _as_expr(stack[-1])
+                # taken arm keeps the value; fallthrough pops it
+                taken_cond = test if want_true else pr.Not(test)
+                self._fork(self._jump_index(ins), stack, env,
+                           taken_cond, cond, depth)
+                stack = list(stack)
+                stack.pop()
+                self._fork(i + 1, stack, env, pr.Not(taken_cond), cond,
+                           depth)
+                return
+            elif op == "JUMP_FORWARD":
+                i = self._jump_index(ins)
+                continue
+            elif op == "JUMP_BACKWARD":
+                raise UdfTranslationError("loop (backward jump)")
+            elif op == "RETURN_VALUE":
+                if len(stack) != 1:
+                    raise UdfTranslationError(
+                        "stack imbalance at return")
+                self._emit(cond, stack.pop())
+                return
+            elif op == "RETURN_CONST":
+                self._emit(cond, ins.argval)
+                return
             else:
-                raise UdfTranslationError(f"unsupported global {name}")
-        elif op == "BINARY_OP":
-            sym = ins.argrepr.rstrip("=")
-            if sym not in _BINOPS:
-                raise UdfTranslationError(f"binary op {ins.argrepr}")
-            r, l = stack.pop(), stack.pop()
-            stack.append(_BINOPS[sym](as_expr(l), as_expr(r)))
-        elif op == "COMPARE_OP":
-            sym = ins.argrepr.strip()
-            # 3.12 spells it "bool(<)" in argrepr sometimes; normalize
-            sym = sym.replace("bool(", "").replace(")", "")
-            if sym not in _CMPOPS:
-                raise UdfTranslationError(f"compare op {ins.argrepr}")
-            r, l = stack.pop(), stack.pop()
-            stack.append(_CMPOPS[sym](as_expr(l), as_expr(r)))
-        elif op == "UNARY_NEGATIVE":
-            stack.append(ar.UnaryMinus(as_expr(stack.pop())))
-        elif op == "UNARY_NOT":
-            stack.append(pr.Not(as_expr(stack.pop())))
-        elif op == "CALL":
-            argc = ins.arg
-            args = [as_expr(stack.pop()) for _ in range(argc)][::-1]
-            target = stack.pop()
-            if not (isinstance(target, tuple) and target[0] == "call"):
-                raise UdfTranslationError("indirect call")
-            stack.append(_CALLS[target[1]](args))
-        elif op == "RETURN_VALUE":
-            if len(stack) != 1:
-                raise UdfTranslationError("stack imbalance at return")
-            return as_expr(stack.pop())
-        elif op == "RETURN_CONST":
-            return as_expr(ins.argval)
-        else:
-            # branches (if/else), loops, attribute access, etc. -> fallback
-            raise UdfTranslationError(f"unsupported instruction {op}")
-    raise UdfTranslationError("no return")
+                raise UdfTranslationError(
+                    f"unsupported instruction {op}")
+            i += 1
+        raise UdfTranslationError("fell off the end of the bytecode")

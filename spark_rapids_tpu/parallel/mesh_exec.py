@@ -73,9 +73,11 @@ class TpuMeshGroupByExec(TpuExec):
     disjoint key ownership."""
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
-                 outputs: List[ex.Expression], mesh):
+                 outputs: List[ex.Expression], mesh,
+                 window_rows: "Optional[int]" = None):
         super().__init__(child)
         self.mesh = mesh
+        self.window_rows = window_rows
         self.grouping_src = grouping
         self.grouping = [bind_refs(e, child.schema) for e in grouping]
         self.outputs = outputs
@@ -129,7 +131,8 @@ class TpuMeshGroupByExec(TpuExec):
                 self.mesh, proj_shards,
                 key_idx=list(range(nk)),
                 val_idx=list(range(nk, nk + len(self.agg_leaves))),
-                agg_ops=[l.op for l in self.agg_leaves])
+                agg_ops=[l.op for l in self.agg_leaves],
+                window_rows=self.window_rows)
         out = []
         for r in results:
             # r columns: [k0..k{nk-1}, a0..]; order per output spec

@@ -476,6 +476,58 @@ class MapInPandas(LogicalPlan):
         return f"MapInPandas [{getattr(self.fn, '__name__', 'fn')}]"
 
 
+class FlatMapGroupsInPandas(LogicalPlan):
+    """groupBy(keys).applyInPandas(fn, schema)
+    (GpuFlatMapGroupsInPandasExec analog, sql-plugin python/*.scala +
+    GpuOverrides.scala:1825-1953): every group's rows become one pandas
+    DataFrame; ``fn(pdf)`` or ``fn(key_tuple, pdf)`` maps it to an output
+    frame of ``schema``."""
+
+    def __init__(self, child: LogicalPlan, grouping: List[ex.Expression],
+                 fn, schema: dt.Schema):
+        super().__init__(child)
+        self.grouping = grouping
+        self.fn = fn
+        self.out_schema = schema
+
+    def expressions(self):
+        return list(self.grouping)
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.out_schema
+
+    def _node_string(self):
+        return ("FlatMapGroupsInPandas "
+                f"[{getattr(self.fn, '__name__', 'fn')}]")
+
+
+class AggregateInPandas(LogicalPlan):
+    """groupBy(keys).agg(grouped-agg pandas UDFs)
+    (GpuAggregateInPandasExec analog): one fn(Series...) -> scalar call
+    per (group, udf). Output schema = key columns + one column per udf."""
+
+    def __init__(self, child: LogicalPlan, grouping: List[ex.Expression],
+                 aggs: List[ex.Expression], names: List[str]):
+        super().__init__(child)
+        self.grouping = grouping
+        self.aggs = aggs                 # PandasAggUDF expressions
+        self.out_names = names           # key names + agg output names
+
+    def expressions(self):
+        return list(self.grouping) + list(self.aggs)
+
+    def _compute_schema(self) -> dt.Schema:
+        fields = [dt.Field(self.out_names[i], g.dtype, True)
+                  for i, g in enumerate(self.grouping)]
+        nk = len(self.grouping)
+        fields += [dt.Field(self.out_names[nk + i], a.dtype, True)
+                   for i, a in enumerate(self.aggs)]
+        return dt.Schema(fields)
+
+    def _node_string(self):
+        return f"AggregateInPandas [{', '.join(map(repr, self.aggs))}]"
+
+
 class Window(LogicalPlan):
     """Window operator: adds window function columns to the child's output
     (GpuWindowExec). window_exprs: list of (name, WindowExpression)."""
@@ -683,5 +735,10 @@ def analyze(plan: LogicalPlan) -> LogicalPlan:
                              for n, w in plan.window_exprs]
     elif isinstance(plan, Generate):
         plan.generator = ra(plan.generator)
+    elif isinstance(plan, FlatMapGroupsInPandas):
+        plan.grouping = [ra(e) for e in plan.grouping]
+    elif isinstance(plan, AggregateInPandas):
+        plan.grouping = [ra(e) for e in plan.grouping]
+        plan.aggs = [ra(e) for e in plan.aggs]
     plan._schema = None  # recompute after coercion
     return plan

@@ -889,16 +889,20 @@ class TpuLocalScanExec(TpuExec):
                     _reserve(nbytes)
                     batch = ColumnarBatch.upload_prepped(prepped)
                     cls = TpuLocalScanExec
-                    if cache is not None and prepped[0] == "packed" and \
-                            cls._device_cache_bytes + nbytes <= \
-                            cls._DEVICE_CACHE_MAX_BYTES:
+                    if cache is not None and prepped[0] == "packed":
+                        # budget check under the lock: concurrent tasks
+                        # must not both pass a stale-byte admission test
+                        handle = None
                         with cls._device_cache_lock:
-                            if key not in cache:
+                            if key not in cache and \
+                                    cls._device_cache_bytes + nbytes <= \
+                                    cls._DEVICE_CACHE_MAX_BYTES:
                                 handle = SpillableColumnarBatch(
                                     batch, CACHE_PRIORITY)
                                 cache[key] = handle
-                                batch.origin = handle
                                 cls._device_cache_bytes += handle.size_bytes
+                        if handle is not None:
+                            batch.origin = handle
             self.metrics.inc("numOutputRows", batch.num_rows_raw)
             self.metrics.inc("numOutputBatches")
             yield batch
@@ -2178,6 +2182,137 @@ class TpuMapInPandasExec(TpuExec):
             out = _df_to_batch(out_df, self.plan.out_schema)
             self.metrics.inc("numOutputRows", n)
             yield out
+
+
+class TpuFlatMapGroupsInPandasExec(TpuExec):
+    """groupBy().applyInPandas (GpuFlatMapGroupsInPandasExec): each
+    partition's rows cross to pandas once, group frames slice out per key,
+    the user fn maps each to an output frame. The planner hash-exchanges
+    on the keys first when the child is multi-partition, so every group's
+    rows are co-located (requiredChildDistribution = clustered(keys))."""
+
+    def __init__(self, child: TpuExec, plan: "lp.FlatMapGroupsInPandas"):
+        super().__init__(child)
+        self.plan = plan
+        self.grouping = [bind_refs(g, child.schema)
+                         for g in plan.grouping]
+        self._key_names = [ex.output_name(g, i)
+                           for i, g in enumerate(plan.grouping)]
+
+    @property
+    def schema(self):
+        return self.plan.out_schema
+
+    def execute(self) -> List[Partition]:
+        return [self._apply(p) for p in self.children[0].execute()]
+
+    def _group_frames(self, part: Partition):
+        """(key_tuple, pandas frame) per group in this partition."""
+        batches = [b for b in part
+                   if not (isinstance(b.num_rows_raw, int)
+                           and b.num_rows_raw == 0)]
+        if not batches:
+            return
+        merged = concat_batches(batches[0].schema, batches)
+        pdf = merged.to_pandas()
+        keys = []
+        for i, g in enumerate(self.grouping):
+            col = ex.materialize(g.eval(merged), merged)
+            keys.append(col.to_pylist(merged.num_rows))
+        import pandas as pd
+        kf = pd.DataFrame({f"_gk{i}": k for i, k in enumerate(keys)})
+        for key, idx in kf.groupby(list(kf.columns), sort=True,
+                                   dropna=False).groups.items():
+            if not isinstance(key, tuple):
+                key = (key,)
+            yield key, pdf.loc[idx].reset_index(drop=True)
+
+    def _apply(self, part: Partition) -> Partition:
+        import inspect
+        import pandas as pd
+        fn = self.plan.fn
+        try:
+            two_arg = len(inspect.signature(fn).parameters) == 2
+        except (TypeError, ValueError):
+            two_arg = False
+        frames = []
+        with self.metrics.timer("udfTime"):
+            for key, pdf in self._group_frames(part):
+                out = fn(key, pdf) if two_arg else fn(pdf)
+                if out is not None and len(out):
+                    frames.append(out)
+        if frames:
+            combined = pd.concat(frames, ignore_index=True)
+            out = _df_to_batch(combined, self.plan.out_schema)
+            self.metrics.inc("numOutputRows", out.num_rows_raw)
+            yield out
+
+    def _node_string(self):
+        return ("TpuFlatMapGroupsInPandasExec "
+                f"[{getattr(self.plan.fn, '__name__', 'fn')}]")
+
+
+class TpuAggregateInPandasExec(TpuExec):
+    """groupBy().agg(grouped-agg pandas UDFs) (GpuAggregateInPandasExec,
+    198 LoC in the reference): fn(Series...) -> scalar once per
+    (group, udf); output = key columns + one column per udf."""
+
+    def __init__(self, child: TpuExec, plan: "lp.AggregateInPandas"):
+        super().__init__(child)
+        self.plan = plan
+        self.grouping = [bind_refs(g, child.schema) for g in plan.grouping]
+        self.aggs = [type(a)(a.fn, a.return_type,
+                             *[bind_refs(c, child.schema)
+                               for c in a.children],
+                             name=a.udf_name)
+                     for a in plan.aggs]
+
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    def execute(self) -> List[Partition]:
+        return [self._apply(p) for p in self.children[0].execute()]
+
+    def _apply(self, part: Partition) -> Partition:
+        import pandas as pd
+        batches = [b for b in part
+                   if not (isinstance(b.num_rows_raw, int)
+                           and b.num_rows_raw == 0)]
+        if not batches:
+            return
+        merged = concat_batches(batches[0].schema, batches)
+        n = merged.num_rows
+        key_lists = [ex.materialize(g.eval(merged), merged).to_pylist(n)
+                     for g in self.grouping]
+        # per udf: its input series, sliced per group
+        agg_inputs = [[ex.materialize(c.eval(merged), merged)
+                       .to_arrow(n).to_pandas()
+                       for c in a.children] for a in self.aggs]
+        kf = pd.DataFrame({f"_gk{i}": k for i, k in enumerate(key_lists)})
+        rows = []
+        with self.metrics.timer("udfTime"):
+            for key, idx in kf.groupby(list(kf.columns), sort=True,
+                                       dropna=False).groups.items():
+                if not isinstance(key, tuple):
+                    key = (key,)
+                vals = []
+                for a, inputs in zip(self.aggs, agg_inputs):
+                    sliced = [s.loc[idx].reset_index(drop=True)
+                              for s in inputs]
+                    vals.append(a.fn(*sliced))
+                rows.append(tuple(key) + tuple(vals))
+        if rows:
+            out_schema = self.plan.schema
+            data = {f.name: [r[i] for r in rows]
+                    for i, f in enumerate(out_schema)}
+            out = _df_to_batch(pd.DataFrame(data), out_schema)
+            self.metrics.inc("numOutputRows", out.num_rows_raw)
+            yield out
+
+    def _node_string(self):
+        return (f"TpuAggregateInPandasExec "
+                f"[{', '.join(a.udf_name for a in self.aggs)}]")
 
 
 class TpuGenerateExec(TpuExec):

@@ -99,6 +99,10 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def execute(self) -> List[Partition]:
         from ..exec.tasks import run_partition_tasks
+        from .manager import WorkerContext
+        ctx = WorkerContext.current
+        if ctx is not None:
+            return self._execute_distributed(ctx)
         shuffle = self._shuffle = LocalShuffle(self.num_partitions)
         partitioner = self._make_partitioner()
 
@@ -112,6 +116,39 @@ class TpuShuffleExchangeExec(TpuExec):
             run_partition_tasks(self.children[0].execute(), map_task)
         groups = self._reduce_groups(shuffle)
         return [self._read_group(shuffle, g) for g in groups]
+
+    def _execute_distributed(self, ctx) -> List[Partition]:
+        """Multi-process mode: map slices register in the worker's
+        ShuffleStore (RapidsCachingWriter), reduce partitions this worker
+        OWNS read local + peer slices (RapidsCachingReader split); the
+        other partitions are empty here — their owners produce them.
+        Adaptive coalescing stays off: partition->worker ownership must be
+        identical on every worker."""
+        from ..exec.tasks import run_partition_tasks
+        from .manager import DistributedShuffle
+        shuffle = self._shuffle = DistributedShuffle(self.num_partitions,
+                                                     ctx)
+        partitioner = self._make_partitioner()
+
+        def map_task(pid, part):
+            for batch in part:
+                shuffle.write(partitioner, batch)
+                self.metrics.inc("dataSize", batch.device_size_bytes())
+
+        with self.metrics.timer("shuffleWriteTime"):
+            run_partition_tasks(self.children[0].execute(), map_task)
+        shuffle.finish_writes()
+
+        def owned(p):
+            with self.metrics.timer("shuffleFetchTime"):
+                yield from shuffle.read(p, self.schema)
+
+        def empty():
+            return
+            yield
+
+        return [owned(p) if ctx.owns_reduce(p) else empty()
+                for p in range(self.num_partitions)]
 
     def _reduce_groups(self, shuffle: LocalShuffle) -> List[List[int]]:
         """Adaptive partition coalescing: group adjacent reduce partitions

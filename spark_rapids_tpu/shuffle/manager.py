@@ -1,0 +1,160 @@
+"""Multi-process shuffle manager: the local/remote split that lets one
+planner-driven query run across worker processes.
+
+Reference mapping (SURVEY.md §2.8, VERDICT round-3 missing #1):
+- ``RapidsShuffleInternalManager.scala:200-374`` -> :class:`WorkerContext`
+  — per-worker singleton wiring the shuffle store, transfer server, and
+  peer addresses (the BlockManagerId topology the reference advertises in
+  MapStatus).
+- ``RapidsCachingWriter`` (":73-192") -> :meth:`DistributedShuffle.write`
+  — map output slices register in the LOCAL store keyed by
+  (shuffle_id, reduce partition); nothing is written to disk.
+- ``RapidsCachingReader.scala:49-148`` -> :meth:`DistributedShuffle.read`
+  — reduce tasks short-circuit local slices straight out of the local
+  store and ``ShuffleClient``-fetch remote peers' slices over TCP.
+
+Worker model: every worker runs the SAME logical query over its own local
+data shard. Exchange ids are allocated from a per-context counter, so
+identical query sequences allocate identical shuffle ids on every worker
+(Spark's driver hands out shuffle ids; standalone, the lockstep-query
+contract replaces the driver). Reduce-partition ownership is
+``p % n_workers == worker_id``; each worker's collect returns the rows of
+its owned partitions, and the caller (or a front tier) concatenates.
+
+Map-completion barrier: a reduce-side fetch must not observe a peer's
+half-written map output. The writer marks (shuffle_id) complete in its
+store after its map phase; the fetch protocol's metadata response carries
+the flag and :meth:`ShuffleClient.fetch_when_complete` polls with backoff
+until the peer's map is done (the reference gets this ordering for free
+from Spark's stage scheduler; the flag replaces it standalone).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.batch import ColumnarBatch
+from .transport import (ShuffleClient, ShuffleFetchError, ShuffleServer,
+                        ShuffleStore, _rebuild_batch)
+
+
+class WorkerContext:
+    """Per-process shuffle worker state (GpuShuffleEnv + shuffle-manager
+    singleton analog). ``current`` activates multi-process shuffle in every
+    exchange exec planned afterwards."""
+
+    current: Optional["WorkerContext"] = None
+
+    def __init__(self, worker_id: int, n_workers: int,
+                 port: int = 0, codec: str = "none"):
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.store = ShuffleStore()
+        self.server = ShuffleServer(self.store, port=port,
+                                    codec=codec).start()
+        self.port = self.server.port
+        self.peers: Dict[int, Tuple[str, int]] = {}
+        self._next_shuffle = 1
+        self._peer_complete: set = set()    # (worker_id, shuffle_id)
+        self._mu = threading.Lock()
+
+    def set_peers(self, peers: Dict[int, Tuple[str, int]]) -> None:
+        """worker_id -> (host, port) for every OTHER worker."""
+        self.peers = {int(w): (h, int(p)) for w, (h, p) in peers.items()
+                      if int(w) != self.worker_id}
+
+    def next_shuffle_id(self) -> int:
+        """Deterministic across workers running the same query sequence
+        (the standalone replacement for driver-issued shuffle ids)."""
+        with self._mu:
+            sid = self._next_shuffle
+            self._next_shuffle += 1
+            return sid
+
+    def owns_reduce(self, p: int) -> bool:
+        return p % self.n_workers == self.worker_id
+
+    def client_for(self, worker_id: int) -> ShuffleClient:
+        host, port = self.peers[worker_id]
+        return ShuffleClient.for_address(host, port)
+
+    def fetch_from_peer(self, worker_id: int, shuffle_id: int,
+                        reduce_ids: List[int]):
+        """Fetch with per-(peer, shuffle) completion caching: map
+        completion is monotonic, so only the FIRST fetch per peer+shuffle
+        pays the completion-poll round trips."""
+        client = self.client_for(worker_id)
+        key = (worker_id, shuffle_id)
+        with self._mu:
+            complete = key in self._peer_complete
+        if complete:
+            return client.fetch(shuffle_id, reduce_ids)
+        out = client.fetch_when_complete(shuffle_id, reduce_ids)
+        with self._mu:
+            self._peer_complete.add(key)
+        return out
+
+    def shutdown(self) -> None:
+        self.server.stop()
+        if WorkerContext.current is self:
+            WorkerContext.current = None
+
+
+def init_worker(worker_id: int, n_workers: int, port: int = 0,
+                codec: str = "none") -> WorkerContext:
+    """Bootstrap this process as shuffle worker ``worker_id`` (the
+    RapidsExecutorPlugin.init analog). Returns the context; call
+    ``set_peers`` once every worker's port is known."""
+    ctx = WorkerContext(worker_id, n_workers, port, codec)
+    WorkerContext.current = ctx
+    return ctx
+
+
+class DistributedShuffle:
+    """LocalShuffle-compatible exchange state backed by the worker's
+    ShuffleStore + peer fetches (the caching writer/reader pair)."""
+
+    def __init__(self, num_partitions: int, ctx: WorkerContext):
+        self.num_partitions = num_partitions
+        self.ctx = ctx
+        self.shuffle_id = ctx.next_shuffle_id()
+        self._wrote = False
+
+    # -- map side ------------------------------------------------------------
+    def write(self, partitioner, batch: ColumnarBatch) -> None:
+        for p, piece in enumerate(partitioner.split(batch)):
+            if piece.num_rows > 0:
+                # ONE batched device->host transfer per slice; the store
+                # serves host bytes (the reference's device-store residency
+                # trades off against the tunnel's per-array sync cost here)
+                self.ctx.store.register_batch(self.shuffle_id, p,
+                                              piece.fetch_to_host())
+        self._wrote = True
+
+    def finish_writes(self) -> None:
+        self.ctx.store.mark_complete(self.shuffle_id)
+
+    # -- reduce side ---------------------------------------------------------
+    def read(self, p: int, schema: dt.Schema):
+        """All slices of reduce partition ``p``: local short-circuit +
+        remote fetches (RapidsCachingReader's local/remote block split)."""
+        from ..plan.physical import concat_batches
+        batches = list(self.ctx.store.local_batches(self.shuffle_id, p))
+        for wid in sorted(self.ctx.peers):
+            batches.extend(self.ctx.fetch_from_peer(wid, self.shuffle_id,
+                                                    [p]))
+        if batches:
+            yield concat_batches(schema, batches)
+
+    def close_pending(self) -> None:
+        # NOT removed at local collect end: a faster worker's cleanup would
+        # strand slower peers still fetching its map outputs (the reference
+        # keeps shuffle data until the driver ends the stage cluster-wide;
+        # standalone, outputs live until WorkerContext.shutdown or an
+        # explicit release once every peer is known to be done)
+        pass

@@ -55,6 +55,7 @@ class ShuffleStore:
         self._next_id = 1
         self._buffers: Dict[int, Tuple[BufferDesc, List[np.ndarray]]] = {}
         self._by_partition: Dict[Tuple[int, int], List[int]] = {}
+        self._complete: set = set()
 
     def register_batch(self, shuffle_id: int, reduce_id: int,
                        batch: ColumnarBatch) -> int:
@@ -86,12 +87,38 @@ class ShuffleStore:
             desc, arrays = self._buffers[buffer_id]
         return desc, b"".join(a.tobytes() for a in arrays)
 
+    def mark_complete(self, shuffle_id: int) -> None:
+        """Map phase for this shuffle is finished: every slice is
+        registered, remote fetches may proceed (the stage-scheduling
+        ordering Spark provides; a flag replaces it standalone)."""
+        with self._mu:
+            self._complete.add(shuffle_id)
+
+    def is_complete(self, shuffle_id: int) -> bool:
+        with self._mu:
+            return shuffle_id in self._complete
+
+    def local_batches(self, shuffle_id: int, reduce_id: int
+                      ) -> List[ColumnarBatch]:
+        """Short-circuit read of locally-registered slices (the
+        RapidsCachingReader local-block path — no socket, no copy of the
+        payload bytes)."""
+        with self._mu:
+            pairs = [self._buffers[bid]
+                     for bid in self._by_partition.get(
+                         (shuffle_id, reduce_id), [])]
+        out = []
+        for desc, arrays in pairs:
+            out.append(_rebuild_from_arrays(desc, arrays))
+        return out
+
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._mu:
             gone = [k for k in self._by_partition if k[0] == shuffle_id]
             for k in gone:
                 for bid in self._by_partition.pop(k):
                     self._buffers.pop(bid, None)
+            self._complete.discard(shuffle_id)
 
 
 # ---------------------------------------------------------------------------
@@ -187,10 +214,11 @@ class ShuffleServer:
             while True:
                 msg_type, header, _payload = reader.next_frame()
                 if msg_type == META_REQ:
-                    metas = self.store.metas(header["shuffle_id"],
-                                             header["reduce_ids"])
+                    sid = header["shuffle_id"]
+                    metas = self.store.metas(sid, header["reduce_ids"])
                     conn.send(encode_frame(META_RESP, {
-                        "buffers": [m.to_json() for m in metas]}))
+                        "buffers": [m.to_json() for m in metas],
+                        "complete": self.store.is_complete(sid)}))
                 elif msg_type == XFER_REQ:
                     self._send_buffers(conn, header["buffer_ids"])
                 else:
@@ -270,6 +298,40 @@ class ShuffleClient:
         return ShuffleClient(connect, **kw)
 
     # -- public API ----------------------------------------------------------
+    def fetch_when_complete(self, shuffle_id: int, reduce_ids: List[int],
+                            timeout_s: float = 60.0,
+                            poll_s: float = 0.05) -> List[ColumnarBatch]:
+        """Fetch once the peer's map phase for ``shuffle_id`` is complete,
+        polling its metadata endpoint with backoff (the standalone stand-in
+        for Spark's stage-scheduling guarantee that map outputs exist
+        before the reduce stage fetches them)."""
+        deadline = time.monotonic() + timeout_s
+        delay = poll_s
+        while True:
+            conn = None
+            try:
+                # the connect itself is the most likely transient failure
+                # (backlog full / peer restarting): poll it too
+                conn = self._connect()
+                conn.send(encode_frame(META_REQ, {"shuffle_id": shuffle_id,
+                                                  "reduce_ids": []}))
+                reader = FrameReader(conn.read_exact)
+                msg_type, header, _ = reader.next_frame()
+                complete = msg_type == META_RESP and header.get("complete")
+            except (ConnectionError, OSError):
+                complete = False
+            finally:
+                if conn is not None:
+                    conn.close()
+            if complete:
+                return self.fetch(shuffle_id, reduce_ids)
+            if time.monotonic() > deadline:
+                raise ShuffleFetchError(
+                    f"peer map phase for shuffle {shuffle_id} not complete "
+                    f"after {timeout_s}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
     def fetch(self, shuffle_id: int, reduce_ids: List[int]
               ) -> List[ColumnarBatch]:
         """Fetch all batches of the given reduce partitions (doFetch,
@@ -377,6 +439,13 @@ def _rebuild_batch(meta: BufferDesc, payload: bytes) -> ColumnarBatch:
                           offset=off).reshape(d.shape)
         arrays.append(a)
         off += d.nbytes
+    return _rebuild_from_arrays(meta, arrays)
+
+
+def _rebuild_from_arrays(meta: BufferDesc,
+                         arrays: List[np.ndarray]) -> ColumnarBatch:
+    """Host arrays + metadata -> device batch (shared by the wire path and
+    the local short-circuit read)."""
     fields = [dt.Field(n, dt.of(t))
               for n, t in zip(meta.field_names, meta.field_dtypes)]
     schema = dt.Schema(fields)

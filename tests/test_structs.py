@@ -1,0 +1,88 @@
+"""STRUCT type: arrow<->device round trip, getField shredding, clean
+fallback for whole-struct plans (ref complexTypeExtractors.scala
+GetStructField; round-3 VERDICT item 10)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dt
+
+from golden import assert_tpu_and_cpu_equal
+
+
+def _struct_table():
+    return pa.table({
+        "id": [1, 2, 3, 4],
+        "s": pa.array([{"x": 1, "y": 2.5}, {"x": 3, "y": 4.5}, None,
+                       {"x": 7, "y": None}],
+                      type=pa.struct([("x", pa.int64()),
+                                      ("y", pa.float64())])),
+    })
+
+
+def test_struct_dtype_roundtrip():
+    t = dt.from_arrow(_struct_table().schema.field("s").type)
+    assert dt.is_struct(t)
+    assert t.fields == (("x", dt.INT64), ("y", dt.FLOAT64))
+    assert dt.to_arrow(t) == pa.struct([("x", pa.int64()),
+                                        ("y", pa.float64())])
+
+
+def test_struct_collect_roundtrip():
+    """Whole-struct materialization crosses the host boundary as python
+    dicts (ObjectColumn path, like map<string,_>)."""
+    s = TpuSession.builder.getOrCreate()
+    out = s.createDataFrame(_struct_table()).collect()
+    assert out == [(1, {"x": 1, "y": 2.5}), (2, {"x": 3, "y": 4.5}),
+                   (3, None), (4, {"x": 7, "y": None})]
+    at = s.createDataFrame(_struct_table()).to_arrow()
+    assert at.column("s").to_pylist() == \
+        _struct_table().column("s").to_pylist()
+
+
+def test_struct_getfield_shreds_to_device():
+    """getField-only queries shred struct fields into flat scan columns
+    and run fully on the device (no CPU fallback)."""
+    s = TpuSession.builder.getOrCreate()
+    df = s.createDataFrame(_struct_table())
+    out = (df.select(col("id"), col("s").getField("x").alias("x"),
+                     col("s").getField("y").alias("y"))
+           .filter(col("x") > 0).collect())
+    assert out == [(1, 1, 2.5), (2, 3, 4.5), (4, 7, None)]
+    s.assert_on_tpu()
+    plan = str(s.last_plan())
+    assert "CpuFallback" not in plan, plan
+
+
+def test_struct_getfield_aggregate_golden():
+    assert_tpu_and_cpu_equal(
+        lambda s: s.createDataFrame(_struct_table())
+        .groupBy(col("s").getField("x").alias("x"))
+        .agg(F.count("*").alias("c")),
+        approx=1e-9, ignore_order=True)
+
+
+def test_struct_whole_use_falls_back_cleanly():
+    """Selecting the struct itself cannot shred: the planner tags the
+    plan off the device and the CPU engine produces correct rows."""
+    s = TpuSession.builder.getOrCreate()
+    df = s.createDataFrame(_struct_table())
+    out = df.filter(col("id") <= 2).select(col("s")).collect()
+    assert out == [({"x": 1, "y": 2.5},), ({"x": 3, "y": 4.5},)]
+
+
+def test_nested_struct_tags_off_cleanly():
+    """struct<..., struct<...>> has no shredding yet for the nested
+    member: whole-plan CPU fallback with correct results."""
+    inner = pa.struct([("a", pa.int64())])
+    t = pa.table({
+        "id": [1, 2],
+        "s": pa.array([{"p": {"a": 5}}, {"p": {"a": 6}}],
+                      type=pa.struct([("p", inner)])),
+    })
+    s = TpuSession.builder.getOrCreate()
+    out = s.createDataFrame(t).collect()
+    assert out == [(1, {"p": {"a": 5}}), (2, {"p": {"a": 6}})]

@@ -262,6 +262,10 @@ def test_two_process_shuffle_over_tcp(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # PYTHONPATH may carry a sitecustomize that pins a remote accelerator
+    # platform; the child inserts the repo path itself, so scrub it — a
+    # dead tunnel must not hang a CPU-only test
+    env.pop("PYTHONPATH", None)
     proc = subprocess.Popen(
         [sys.executable, "-c", _CHILD_SERVER.format(repo=repo)],
         stdout=subprocess.PIPE, env=env, text=True)
