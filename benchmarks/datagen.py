@@ -29,19 +29,32 @@ SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
 PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
 
 
+def _ps_suppkey(partkey: np.ndarray, i: np.ndarray, n_supp: int
+                ) -> np.ndarray:
+    """TPC-H's deterministic partsupp supplier derivation
+    ((partkey + i*(S/4 + (partkey-1)/S)) % S + 1): lineitem draws i in
+    0..3 with the SAME formula, so every (l_partkey, l_suppkey) pair
+    exists in partsupp — the q9 join actually joins."""
+    s = max(n_supp, 1)
+    return ((partkey + i * (s // 4 + (partkey - 1) // s)) % s + 1
+            ).astype(np.int64)
+
+
 def gen_lineitem(sf: float, seed: int = 42) -> Dict[str, np.ndarray]:
     n = int(LINEITEM_PER_SF * sf)
     rng = np.random.default_rng(seed)
     n_orders = max(int(ORDERS_PER_SF * sf), 1)
+    n_supp = max(int(SUPPLIER_PER_SF * sf), 1)
     quantity = rng.integers(1, 51, n).astype(np.int64)
     extendedprice = np.round(rng.uniform(900, 105_000, n), 2)
     discount = np.round(rng.uniform(0.0, 0.1, n), 2)
     tax = np.round(rng.uniform(0.0, 0.08, n), 2)
     shipdate = (_EPOCH_1992 + rng.integers(0, _DATE_RANGE, n)).astype(np.int32)
+    partkey = rng.integers(1, int(PART_PER_SF * sf) + 2, n).astype(np.int64)
     return {
         "l_orderkey": rng.integers(1, n_orders + 1, n).astype(np.int64),
-        "l_partkey": rng.integers(1, int(PART_PER_SF * sf) + 2, n).astype(np.int64),
-        "l_suppkey": rng.integers(1, int(SUPPLIER_PER_SF * sf) + 2, n).astype(np.int64),
+        "l_partkey": partkey,
+        "l_suppkey": _ps_suppkey(partkey, rng.integers(0, 4, n), n_supp),
         "l_quantity": quantity,
         "l_extendedprice": extendedprice,
         "l_discount": discount,
@@ -55,30 +68,50 @@ def gen_lineitem(sf: float, seed: int = 42) -> Dict[str, np.ndarray]:
     }
 
 
+_COMMENT_WORDS = ["carefully", "quickly", "special", "requests", "pending",
+                  "deposits", "accounts", "ironic", "express", "final"]
+
+
 def gen_orders(sf: float, seed: int = 43) -> Dict[str, np.ndarray]:
     n = int(ORDERS_PER_SF * sf)
     rng = np.random.default_rng(seed)
+    w = np.array(_COMMENT_WORDS)
+    comments = np.char.add(np.char.add(
+        w[rng.integers(0, len(w), n)], " "), w[rng.integers(0, len(w), n)])
+    # TPC-H leaves a third of customers with no orders (custkey skips
+    # multiples of 3) so NOT-EXISTS queries like q22 have survivors
+    ck = rng.integers(1, int(CUSTOMER_PER_SF * sf) + 2, n).astype(np.int64)
+    ck = np.where(ck % 3 == 0, ck + 1, ck)
     return {
         "o_orderkey": np.arange(1, n + 1, dtype=np.int64),
-        "o_custkey": rng.integers(1, int(CUSTOMER_PER_SF * sf) + 2, n).astype(np.int64),
+        "o_custkey": ck,
         "o_orderstatus": np.array(["F", "O", "P"])[rng.integers(0, 3, n)],
         "o_totalprice": np.round(rng.uniform(850, 560_000, n), 2),
         "o_orderdate": (_EPOCH_1992 + rng.integers(0, _DATE_RANGE - 151, n)
                         ).astype(np.int32),
         "o_orderpriority": np.array(PRIORITIES)[rng.integers(0, 5, n)],
         "o_shippriority": np.zeros(n, dtype=np.int64),
+        "o_comment": comments,
     }
 
 
 def gen_customer(sf: float, seed: int = 44) -> Dict[str, np.ndarray]:
     n = int(CUSTOMER_PER_SF * sf)
     rng = np.random.default_rng(seed)
+    cc = rng.integers(10, 35, n)          # phone country code, TPC-H style
+    p1 = rng.integers(100, 999, n)
+    p2 = rng.integers(100, 999, n)
+    p3 = rng.integers(1000, 9999, n)
+    phone = np.char.add(np.char.add(np.char.add(np.char.add(
+        np.char.add(np.char.add(cc.astype(str), "-"), p1.astype(str)),
+        "-"), p2.astype(str)), "-"), p3.astype(str))
     return {
         "c_custkey": np.arange(1, n + 1, dtype=np.int64),
         "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n + 1)]),
         "c_nationkey": rng.integers(0, 25, n).astype(np.int64),
         "c_acctbal": np.round(rng.uniform(-999, 9999, n), 2),
         "c_mktsegment": np.array(SEGMENTS)[rng.integers(0, 5, n)],
+        "c_phone": phone,
     }
 
 
@@ -130,6 +163,23 @@ def gen_supplier(sf: float, seed: int = 46) -> Dict[str, np.ndarray]:
     }
 
 
+def gen_partsupp(sf: float, seed: int = 47) -> Dict[str, np.ndarray]:
+    """4 suppliers per part via TPC-H's deterministic derivation — the
+    same formula gen_lineitem uses, so (l_partkey, l_suppkey) always has
+    a partsupp row and the PK (ps_partkey, ps_suppkey) is unique."""
+    n_part = max(int(PART_PER_SF * sf), 1)
+    n_supp = max(int(SUPPLIER_PER_SF * sf), 1)
+    rng = np.random.default_rng(seed)
+    pk = np.repeat(np.arange(1, n_part + 2, dtype=np.int64), 4)
+    i = np.tile(np.arange(4, dtype=np.int64), n_part + 1)
+    return {
+        "ps_partkey": pk,
+        "ps_suppkey": _ps_suppkey(pk, i, n_supp),
+        "ps_availqty": rng.integers(1, 10_000, len(pk)).astype(np.int64),
+        "ps_supplycost": np.round(rng.uniform(1, 1000, len(pk)), 2),
+    }
+
+
 def gen_nation() -> Dict[str, np.ndarray]:
     return {
         "n_nationkey": np.arange(25, dtype=np.int64),
@@ -164,6 +214,7 @@ def register_tables(session, sf: float):
         "customer": to_arrow(gen_customer(sf)),
         "part": to_arrow(gen_part(sf)),
         "supplier": to_arrow(gen_supplier(sf)),
+        "partsupp": to_arrow(gen_partsupp(sf)),
         "nation": to_arrow(gen_nation()),
         "region": to_arrow(gen_region()),
     }

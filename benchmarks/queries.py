@@ -231,6 +231,116 @@ def q19(t):
             .agg(F.sum(revenue).alias("revenue")))
 
 
+def q8(t):
+    """National market share: 8-way join + conditional ratio per year."""
+    r = t["region"].filter(col("r_name") == lit("AMERICA"))
+    n1 = (t["nation"].join(r, on=(col("n_regionkey") == col("r_regionkey")))
+          .withColumnRenamed("n_nationkey", "cust_nationkey"))
+    n2 = (t["nation"]
+          .withColumnRenamed("n_nationkey", "supp_nationkey")
+          .withColumnRenamed("n_name", "supp_nation"))
+    p = t["part"].filter(col("p_type") == lit("ECONOMY ANODIZED STEEL"))
+    o = t["orders"].filter((col("o_orderdate") >= lit(_D_1995_01_01)) &
+                           (col("o_orderdate") <= lit(_D_1995_01_01 + 730)))
+    volume = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    brazil = F.when(col("supp_nation") == lit("BRAZIL"),
+                    volume).otherwise(lit(0.0))
+    joined = (t["lineitem"]
+              .join(p, on=(col("l_partkey") == col("p_partkey")))
+              .join(t["supplier"],
+                    on=(col("l_suppkey") == col("s_suppkey")))
+              .join(o, on=(col("l_orderkey") == col("o_orderkey")))
+              .join(t["customer"],
+                    on=(col("o_custkey") == col("c_custkey")))
+              .join(n1, on=(col("c_nationkey") == col("cust_nationkey")))
+              .join(n2, on=(col("s_nationkey") == col("supp_nationkey"))))
+    return (joined.withColumn("o_year", F.year(col("o_orderdate")))
+            .groupBy("o_year")
+            .agg((F.sum(brazil) / F.sum(volume)).alias("mkt_share"))
+            .orderBy("o_year"))
+
+
+def q9(t):
+    """Product type profit: partsupp two-key join + per-nation/year sums.
+    (p_name LIKE adapted to p_type contains — the generator has no
+    p_name.)"""
+    p = t["part"].filter(col("p_type").contains("BRUSHED"))
+    amount = (col("l_extendedprice") * (lit(1.0) - col("l_discount")) -
+              col("ps_supplycost") * col("l_quantity"))
+    joined = (t["lineitem"]
+              .join(p, on=(col("l_partkey") == col("p_partkey")))
+              .join(t["supplier"],
+                    on=(col("l_suppkey") == col("s_suppkey")))
+              .join(t["partsupp"],
+                    on=[col("l_partkey") == col("ps_partkey"),
+                        col("l_suppkey") == col("ps_suppkey")])
+              .join(t["orders"],
+                    on=(col("l_orderkey") == col("o_orderkey")))
+              .join(t["nation"],
+                    on=(col("s_nationkey") == col("n_nationkey"))))
+    return (joined.withColumn("o_year", F.year(col("o_orderdate")))
+            .groupBy("n_name", "o_year")
+            .agg(F.sum(amount).alias("sum_profit"))
+            .orderBy(col("n_name").asc(), col("o_year").desc()))
+
+
+def q13(t):
+    """Customer distribution: left outer join (right side pre-filtered on
+    the comment predicate — equivalent since it only references orders)
+    + two-level aggregation."""
+    o = t["orders"].filter(
+        ~(col("o_comment").contains("special") &
+          col("o_comment").contains("requests")))
+    per_cust = (t["customer"]
+                .join(o, on=(col("c_custkey") == col("o_custkey")),
+                      how="left")
+                .groupBy("c_custkey")
+                .agg(F.count("o_orderkey").alias("c_count")))
+    return (per_cust.groupBy("c_count")
+            .agg(F.count("*").alias("custdist"))
+            .orderBy(col("custdist").desc(), col("c_count").desc()))
+
+
+def q16(t):
+    """Parts/supplier relationship: anti join (NOT IN subquery) + count
+    DISTINCT over a multi-key string group. (s_comment LIKE adapted to
+    negative account balances — the generator has no s_comment.)"""
+    bad_supp = t["supplier"].filter(col("s_acctbal") < lit(0))
+    ps = (t["partsupp"]
+          .join(bad_supp, on=(col("ps_suppkey") == col("s_suppkey")),
+                how="left_anti"))
+    p = t["part"].filter(
+        (col("p_brand") != lit("Brand#45")) &
+        ~col("p_type").startswith("MEDIUM POLISHED") &
+        col("p_size").isin(49, 14, 23, 45, 19, 3, 36, 9))
+    return (ps.join(p, on=(col("ps_partkey") == col("p_partkey")))
+            .groupBy("p_brand", "p_type", "p_size")
+            .agg(F.countDistinct(col("ps_suppkey")).alias("supplier_cnt"))
+            .orderBy(col("supplier_cnt").desc(), col("p_brand").asc(),
+                     col("p_type").asc(), col("p_size").asc()))
+
+
+def q22(t):
+    """Global sales opportunity: scalar avg subquery (cross join) + NOT
+    EXISTS (left anti) + substring country codes."""
+    cntry = F.substring(col("c_phone"), 1, 2)
+    codes = ("13", "31", "23", "29", "30", "18", "17")
+    cust = t["customer"].filter(cntry.isin(*codes))
+    avg_bal = (cust.filter(col("c_acctbal") > lit(0.0))
+               .agg(F.avg("c_acctbal").alias("avg_bal")))
+    return (cust.crossJoin(avg_bal)
+            .filter(col("c_acctbal") > col("avg_bal"))
+            .join(t["orders"],
+                  on=(col("c_custkey") == col("o_custkey")),
+                  how="left_anti")
+            .withColumn("cntrycode", cntry)
+            .groupBy("cntrycode")
+            .agg(F.count("*").alias("numcust"),
+                 F.sum("c_acctbal").alias("totacctbal"))
+            .orderBy("cntrycode"))
+
+
 QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
-           "q10": q10, "q12": q12, "q14": q14, "q17": q17, "q18": q18,
-           "q19": q19}
+           "q8": q8, "q9": q9, "q10": q10, "q12": q12, "q13": q13,
+           "q14": q14, "q16": q16, "q17": q17, "q18": q18, "q19": q19,
+           "q22": q22}

@@ -286,10 +286,13 @@ class DataFrame:
         return self
 
     def collect_batch(self):
+        import time
         from ..exec.tracing import SyncCounter
         exec_plan = self._execute()
+        t0 = time.perf_counter()
         with SyncCounter() as sc:
             out = exec_plan.execute_collect()
+        self.session._last_execute_time_s = time.perf_counter() - t0
         self.session._last_sync_report = sc.report()
         return out
 
@@ -399,6 +402,11 @@ class GroupedData:
         return self.df._df(lp.FlatMapGroupsInPandas(
             self.df._plan, list(self.grouping), fn, schema))
 
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair this grouping with another frame's grouping for
+        cogroup(...).applyInPandas (GpuFlatMapCoGroupsInPandasExec)."""
+        return CoGroupedData(self, other)
+
     def _agg_grouping_sets(self, agg_exprs: List[ex.Expression]) -> DataFrame:
         """rollup/cube: Expand replicates every input row once per grouping
         set, nulling the grouped-out keys and tagging a grouping id; one
@@ -459,6 +467,28 @@ class GroupedData:
 
     def max(self, *cols: str) -> DataFrame:
         return self.agg(*[F.max(c).alias(f"max({c})") for c in cols])
+
+
+class CoGroupedData:
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        self.left = left
+        self.right = right
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        """fn(left_pdf, right_pdf) -> DataFrame — or fn(key, l, r) —
+        applied once per key present on EITHER side (missing side =
+        empty frame), matching pyspark cogroup semantics."""
+        from ..columnar import dtypes as dtm
+        if len(self.left.grouping) != len(self.right.grouping):
+            raise ValueError(
+                f"cogroup key counts differ: {len(self.left.grouping)} "
+                f"vs {len(self.right.grouping)} (pyspark raises too)")
+        if not isinstance(schema, dtm.Schema):
+            schema = dtm.Schema(schema)
+        return self.left.df._df(lp.FlatMapCoGroupsInPandas(
+            self.left.df._plan, self.right.df._plan,
+            list(self.left.grouping), list(self.right.grouping),
+            fn, schema))
 
 
 class DataFrameWriter:

@@ -202,8 +202,13 @@ class TpuSession:
             # exec/tracing.SyncCounter)
             "sync": getattr(self, "_last_sync_report",
                             {"hostSyncs": 0, "syncSites": {}}),
-            # driver-side planning (analyze + overrides) wall time
+            # driver-side planning (analyze + overrides) wall time and the
+            # execute_collect wall (device work + transfers + syncs): with
+            # the per-operator timers these account for the query's wall
+            # clock end to end
             "planTimeS": round(getattr(self, "_last_plan_time_s", 0.0), 4),
+            "executeTimeS": round(
+                getattr(self, "_last_execute_time_s", 0.0), 4),
         }
 
     def explain_metrics(self) -> str:

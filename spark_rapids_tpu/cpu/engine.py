@@ -956,6 +956,40 @@ def _exec(plan: lp.LogicalPlan) -> pd.DataFrame:
         if not frames:
             return _obj_df({n: [] for n in names})
         return pd.concat(frames, ignore_index=True)[[n for n in names]]
+    if isinstance(plan, lp.FlatMapCoGroupsInPandas):
+        import inspect
+        left = _exec(plan.children[0])
+        right = _exec(plan.children[1])
+
+        def side_groups(child, grouping):
+            ev = CpuEvaluator(child)
+            kf = pd.DataFrame({f"_gk{i}": ev.eval(g)
+                               for i, g in enumerate(grouping)})
+            out = {}
+            if len(child):
+                for key, idx in kf.groupby(list(kf.columns), sort=True,
+                                           dropna=False).groups.items():
+                    if not isinstance(key, tuple):
+                        key = (key,)
+                    out[key] = child.loc[idx].reset_index(drop=True)
+            return out
+        lgroups = side_groups(left, plan.left_grouping)
+        rgroups = side_groups(right, plan.right_grouping)
+        try:
+            three_arg = len(inspect.signature(plan.fn).parameters) == 3
+        except (TypeError, ValueError):
+            three_arg = False
+        frames = []
+        for key in sorted(set(lgroups) | set(rgroups), key=repr):
+            l = lgroups.get(key, left.iloc[0:0])
+            r = rgroups.get(key, right.iloc[0:0])
+            out = plan.fn(key, l, r) if three_arg else plan.fn(l, r)
+            if out is not None and len(out):
+                frames.append(out)
+        names = plan.out_schema.names()
+        if not frames:
+            return _obj_df({n: [] for n in names})
+        return pd.concat(frames, ignore_index=True)[[n for n in names]]
     if isinstance(plan, lp.AggregateInPandas):
         child = _exec(plan.children[0])
         ev = CpuEvaluator(child)

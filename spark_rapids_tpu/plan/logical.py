@@ -528,6 +528,33 @@ class AggregateInPandas(LogicalPlan):
         return f"AggregateInPandas [{', '.join(map(repr, self.aggs))}]"
 
 
+class FlatMapCoGroupsInPandas(LogicalPlan):
+    """cogroup(...).applyInPandas (GpuFlatMapCoGroupsInPandasExec analog):
+    both sides group on their keys; ``fn(left_pdf, right_pdf)`` (or
+    ``fn(key, l, r)``) maps each key's pair of frames to an output
+    frame."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_grouping: List[ex.Expression],
+                 right_grouping: List[ex.Expression], fn,
+                 schema: dt.Schema):
+        super().__init__(left, right)
+        self.left_grouping = left_grouping
+        self.right_grouping = right_grouping
+        self.fn = fn
+        self.out_schema = schema
+
+    def expressions(self):
+        return list(self.left_grouping) + list(self.right_grouping)
+
+    def _compute_schema(self) -> dt.Schema:
+        return self.out_schema
+
+    def _node_string(self):
+        return ("FlatMapCoGroupsInPandas "
+                f"[{getattr(self.fn, '__name__', 'fn')}]")
+
+
 class Window(LogicalPlan):
     """Window operator: adds window function columns to the child's output
     (GpuWindowExec). window_exprs: list of (name, WindowExpression)."""
@@ -737,6 +764,13 @@ def analyze(plan: LogicalPlan) -> LogicalPlan:
         plan.generator = ra(plan.generator)
     elif isinstance(plan, FlatMapGroupsInPandas):
         plan.grouping = [ra(e) for e in plan.grouping]
+    elif isinstance(plan, FlatMapCoGroupsInPandas):
+        lsch = plan.children[0].schema
+        rsch = plan.children[1].schema
+        plan.left_grouping = [
+            _coerce(_resolve_expr(e, lsch)) for e in plan.left_grouping]
+        plan.right_grouping = [
+            _coerce(_resolve_expr(e, rsch)) for e in plan.right_grouping]
     elif isinstance(plan, AggregateInPandas):
         plan.grouping = [ra(e) for e in plan.grouping]
         plan.aggs = [ra(e) for e in plan.aggs]

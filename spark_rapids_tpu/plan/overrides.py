@@ -220,6 +220,7 @@ class PlanMeta(BaseMeta):
         lp.Generate: "GenerateExec",
         lp.MapInPandas: "MapInPandasExec",
         lp.FlatMapGroupsInPandas: "FlatMapGroupsInPandasExec",
+        lp.FlatMapCoGroupsInPandas: "FlatMapCoGroupsInPandasExec",
         lp.AggregateInPandas: "AggregateInPandasExec",
         lp.WriteFile: "DataWritingCommandExec",
     }
@@ -531,6 +532,23 @@ class Overrides:
         if isinstance(p, lp.FlatMapGroupsInPandas):
             return ph.TpuFlatMapGroupsInPandasExec(
                 self._cluster_by_keys(kids[0], p.grouping), p)
+        if isinstance(p, lp.FlatMapCoGroupsInPandas):
+            # positional partition pairing requires BOTH sides
+            # co-partitioned: exchange both whenever either side is
+            # multi-partition (one-sided clustering would pair keys with
+            # the wrong/empty opposite partition)
+            from ..shuffle.exchange import TpuHashExchangeExec
+            from ..shuffle.manager import WorkerContext
+            need = (kids[0].output_partitions > 1 or
+                    kids[1].output_partitions > 1 or
+                    WorkerContext.current is not None)
+            left, right = kids
+            if need and p.left_grouping and p.right_grouping:
+                n = self.conf.shuffle_partitions
+                left = TpuHashExchangeExec(left, n, list(p.left_grouping))
+                right = TpuHashExchangeExec(right, n,
+                                            list(p.right_grouping))
+            return ph.TpuFlatMapCoGroupsInPandasExec(left, right, p)
         if isinstance(p, lp.AggregateInPandas):
             return ph.TpuAggregateInPandasExec(
                 self._cluster_by_keys(kids[0], p.grouping), p)
@@ -891,10 +909,12 @@ def _shred_struct_columns(root: lp.LogicalPlan) -> lp.LogicalPlan:
                     if ref.col_name in struct_cols:
                         whole_uses.add(ref.col_name)
         if isinstance(p, (lp.MapInPandas, lp.FlatMapGroupsInPandas,
-                          lp.WriteFile, lp.Union, lp.Distinct)):
+                          lp.FlatMapCoGroupsInPandas, lp.WriteFile,
+                          lp.Union, lp.Distinct)):
             # black-box / positional consumers see the whole child frame
-            whole_uses.update(n for n in p.children[0].schema.names()
-                              if n in struct_cols)
+            for c in p.children:
+                whole_uses.update(n for n in c.schema.names()
+                                  if n in struct_cols)
     # the query's own output keeping the struct is a whole use
     whole_uses.update(n for n in root.schema.names() if n in struct_cols)
 
@@ -996,9 +1016,11 @@ def _prune_scan_columns(root: lp.LogicalPlan) -> lp.LogicalPlan:
         if isinstance(p, lp.WriteFile):
             # a write materializes every child column
             referenced.update(p.children[0].schema.names())
-        if isinstance(p, (lp.MapInPandas, lp.FlatMapGroupsInPandas)):
-            # the pandas fn is a black box over the whole child frame
-            referenced.update(p.children[0].schema.names())
+        if isinstance(p, (lp.MapInPandas, lp.FlatMapGroupsInPandas,
+                          lp.FlatMapCoGroupsInPandas)):
+            # the pandas fn is a black box over the whole child frame(s)
+            for c in p.children:
+                referenced.update(c.schema.names())
         for e in p.expressions():
             for n in e.collect(lambda x: isinstance(x, ex.ColumnRef)):
                 referenced.add(n.col_name)

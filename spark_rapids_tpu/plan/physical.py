@@ -2184,6 +2184,30 @@ class TpuMapInPandasExec(TpuExec):
             yield out
 
 
+def _group_pandas_frames(part: Partition, grouping):
+    """Drain one partition to pandas and slice a frame per group key:
+    yields ``(key_tuple, frame)`` in sorted key order; returns early on an
+    empty partition. Shared by the grouped/cogrouped pandas execs."""
+    import pandas as pd
+    batches = [b for b in part
+               if not (isinstance(b.num_rows_raw, int)
+                       and b.num_rows_raw == 0)]
+    if not batches:
+        return None, {}
+    merged = concat_batches(batches[0].schema, batches)
+    pdf = merged.to_pandas()
+    keys = [ex.materialize(g.eval(merged), merged)
+            .to_pylist(merged.num_rows) for g in grouping]
+    kf = pd.DataFrame({f"_gk{i}": k for i, k in enumerate(keys)})
+    groups = {}
+    for key, idx in kf.groupby(list(kf.columns), sort=True,
+                               dropna=False).groups.items():
+        if not isinstance(key, tuple):
+            key = (key,)
+        groups[key] = pdf.loc[idx].reset_index(drop=True)
+    return pdf, groups
+
+
 class TpuFlatMapGroupsInPandasExec(TpuExec):
     """groupBy().applyInPandas (GpuFlatMapGroupsInPandasExec): each
     partition's rows cross to pandas once, group frames slice out per key,
@@ -2208,24 +2232,8 @@ class TpuFlatMapGroupsInPandasExec(TpuExec):
 
     def _group_frames(self, part: Partition):
         """(key_tuple, pandas frame) per group in this partition."""
-        batches = [b for b in part
-                   if not (isinstance(b.num_rows_raw, int)
-                           and b.num_rows_raw == 0)]
-        if not batches:
-            return
-        merged = concat_batches(batches[0].schema, batches)
-        pdf = merged.to_pandas()
-        keys = []
-        for i, g in enumerate(self.grouping):
-            col = ex.materialize(g.eval(merged), merged)
-            keys.append(col.to_pylist(merged.num_rows))
-        import pandas as pd
-        kf = pd.DataFrame({f"_gk{i}": k for i, k in enumerate(keys)})
-        for key, idx in kf.groupby(list(kf.columns), sort=True,
-                                   dropna=False).groups.items():
-            if not isinstance(key, tuple):
-                key = (key,)
-            yield key, pdf.loc[idx].reset_index(drop=True)
+        _pdf, groups = _group_pandas_frames(part, self.grouping)
+        yield from groups.items()
 
     def _apply(self, part: Partition) -> Partition:
         import inspect
@@ -2249,6 +2257,74 @@ class TpuFlatMapGroupsInPandasExec(TpuExec):
 
     def _node_string(self):
         return ("TpuFlatMapGroupsInPandasExec "
+                f"[{getattr(self.plan.fn, '__name__', 'fn')}]")
+
+
+class TpuFlatMapCoGroupsInPandasExec(TpuExec):
+    """cogroup().applyInPandas (GpuFlatMapCoGroupsInPandasExec): both
+    sides drain to pandas, group frames pair up per key (union of key
+    sets; a missing side passes an empty frame), fn maps each pair."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 plan: "lp.FlatMapCoGroupsInPandas"):
+        super().__init__(left, right)
+        self.plan = plan
+        self.left_grouping = [bind_refs(g, left.schema)
+                              for g in plan.left_grouping]
+        self.right_grouping = [bind_refs(g, right.schema)
+                               for g in plan.right_grouping]
+
+    @property
+    def schema(self):
+        return self.plan.out_schema
+
+    def execute(self) -> List[Partition]:
+        lparts = self.children[0].execute()
+        rparts = self.children[1].execute()
+        n = max(len(lparts), len(rparts))
+
+        def empty():
+            return
+            yield
+        lparts += [empty() for _ in range(n - len(lparts))]
+        rparts += [empty() for _ in range(n - len(rparts))]
+        return [self._apply(lp_, rp_)
+                for lp_, rp_ in zip(lparts, rparts)]
+
+    @staticmethod
+    def _collect_side(part: Partition, grouping):
+        return _group_pandas_frames(part, grouping)
+
+    def _apply(self, lpart: Partition, rpart: Partition) -> Partition:
+        import inspect
+        import pandas as pd
+        fn = self.plan.fn
+        try:
+            three_arg = len(inspect.signature(fn).parameters) == 3
+        except (TypeError, ValueError):
+            three_arg = False
+        lp_df, lgroups = self._collect_side(lpart, self.left_grouping)
+        rp_df, rgroups = self._collect_side(rpart, self.right_grouping)
+        lempty = (lp_df.iloc[0:0] if lp_df is not None else
+                  pd.DataFrame(columns=self.children[0].schema.names()))
+        rempty = (rp_df.iloc[0:0] if rp_df is not None else
+                  pd.DataFrame(columns=self.children[1].schema.names()))
+        frames = []
+        with self.metrics.timer("udfTime"):
+            for key in sorted(set(lgroups) | set(rgroups), key=repr):
+                l = lgroups.get(key, lempty)
+                r = rgroups.get(key, rempty)
+                out = fn(key, l, r) if three_arg else fn(l, r)
+                if out is not None and len(out):
+                    frames.append(out)
+        if frames:
+            combined = pd.concat(frames, ignore_index=True)
+            out = _df_to_batch(combined, self.plan.out_schema)
+            self.metrics.inc("numOutputRows", out.num_rows_raw)
+            yield out
+
+    def _node_string(self):
+        return ("TpuFlatMapCoGroupsInPandasExec "
                 f"[{getattr(self.plan.fn, '__name__', 'fn')}]")
 
 

@@ -267,3 +267,77 @@ def test_udf_compiler_loop_still_falls_back():
     out = df.select(F.udf(looped, returnType="double")(col("x"))
                     .alias("t")).collect()
     assert out == [(3.0,), (6.0,)]
+
+
+def test_cogroup_apply_in_pandas_golden():
+    """cogroup().applyInPandas: per-key frame pairs, union of key sets
+    (GpuFlatMapCoGroupsInPandasExec analog)."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    def merge(l, r):
+        k = l.k.iloc[0] if len(l) else r.k.iloc[0]
+        return pd.DataFrame({"k": [k],
+                             "lv": [float(l.v.sum()) if len(l) else 0.0],
+                             "rw": [float(r.w.sum()) if len(r) else 0.0]})
+
+    schema = dt.Schema([dt.Field("k", dt.INT64), dt.Field("lv", dt.FLOAT64),
+                        dt.Field("rw", dt.FLOAT64)])
+
+    def build(s):
+        a = s.createDataFrame({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+        b = s.createDataFrame({"k": [2, 3], "w": [10.0, 20.0]})
+        return a.groupBy("k").cogroup(b.groupBy("k")) \
+            .applyInPandas(merge, schema)
+
+    assert_tpu_and_cpu_equal(build, approx=1e-9, ignore_order=True)
+
+
+def test_cogroup_key_arg():
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    def tag(key, l, r):
+        return pd.DataFrame({"k": [key[0]], "n": [len(l) + len(r)]})
+
+    schema = dt.Schema([dt.Field("k", dt.INT64), dt.Field("n", dt.INT64)])
+    s = TpuSession.builder.getOrCreate()
+    a = s.createDataFrame({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+    b = s.createDataFrame({"k": [2, 3], "w": [10.0, 20.0]})
+    out = sorted(a.groupBy("k").cogroup(b.groupBy("k"))
+                 .applyInPandas(tag, schema).collect())
+    assert out == [(1, 2), (2, 2), (3, 1)]
+
+
+def test_cogroup_mixed_partition_counts():
+    """A multi-partition left (union) + single-partition right must still
+    pair every key once: both sides co-partition whenever either needs it."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    def merge(l, r):
+        k = l.k.iloc[0] if len(l) else r.k.iloc[0]
+        return pd.DataFrame({"k": [k],
+                             "lv": [float(l.v.sum()) if len(l) else 0.0],
+                             "rw": [float(r.w.sum()) if len(r) else 0.0]})
+
+    schema = dt.Schema([dt.Field("k", dt.INT64), dt.Field("lv", dt.FLOAT64),
+                        dt.Field("rw", dt.FLOAT64)])
+    s = TpuSession.builder.getOrCreate()
+    a1 = s.createDataFrame({"k": [1, 2], "v": [1.0, 2.0]})
+    a2 = s.createDataFrame({"k": [1, 3], "v": [4.0, 8.0]})
+    left = a1.union(a2)                      # multi-partition side
+    right = s.createDataFrame({"k": [2, 3], "w": [10.0, 20.0]})
+    out = sorted(left.groupBy("k").cogroup(right.groupBy("k"))
+                 .applyInPandas(merge, schema).collect())
+    assert out == [(1, 5.0, 0.0), (2, 2.0, 10.0), (3, 8.0, 20.0)], out
+
+
+def test_cogroup_key_count_mismatch_raises():
+    import pytest
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.getOrCreate()
+    a = s.createDataFrame({"k": [1], "k2": [1], "v": [1.0]})
+    b = s.createDataFrame({"k": [1], "w": [2.0]})
+    with pytest.raises(ValueError):
+        a.groupBy("k", "k2").cogroup(b.groupBy("k")).applyInPandas(
+            lambda l, r: l, [("k", "bigint")])
