@@ -158,7 +158,10 @@ def gen_supplier(sf: float, seed: int = 46) -> Dict[str, np.ndarray]:
     return {
         "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
         "s_name": np.array([f"Supplier#{i:09d}" for i in range(1, n + 1)]),
-        "s_nationkey": rng.integers(0, 25, n).astype(np.int64),
+        # cycling keys: every nation has suppliers at ANY scale factor
+        # (uniform draws left whole nations supplier-less at tiny SF,
+        # turning nation-filtered query tests vacuous)
+        "s_nationkey": (np.arange(n, dtype=np.int64) % 25),
         "s_acctbal": np.round(rng.uniform(-999, 9999, n), 2),
     }
 
