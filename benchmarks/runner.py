@@ -30,6 +30,10 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         from . import tpcds_queries
         queries = tpcds_queries.TPCDS_QUERIES
         register = datagen.register_tpcds_tables
+    elif suite == "tpcxbb":
+        from . import tpcxbb_queries
+        queries = tpcxbb_queries.TPCXBB_QUERIES
+        register = datagen.register_tpcds_tables
     else:
         queries = Q.QUERIES
         register = datagen.register_tables
@@ -96,7 +100,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=0.01)
     ap.add_argument("--suite", type=str, default="tpch",
-                    choices=("tpch", "tpcds"))
+                    choices=("tpch", "tpcds", "tpcxbb"))
     ap.add_argument("--queries", type=str, default=None)
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--verify", action="store_true")

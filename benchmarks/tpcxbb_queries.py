@@ -1,0 +1,74 @@
+"""TPCxBB-like query definitions (BASELINE.md milestone 3: the reference
+ships a TpcxbbLikeSpark.scala suite; this is the analog over the
+TPC-DS-like retail tables from datagen.register_tpcds_tables).
+
+Three representative retail-analytics shapes: per-unit channel comparison
+(q06-like), top items by revenue concentration (q09-like), and repeat
+customers across channels (q30-like cross-channel behavior)."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.functions import col, lit
+
+from . import datagen
+
+_D0 = datagen._D_DATE_BASE
+
+
+def tpcxbb_q06(t):
+    """Customers whose web spend grew vs their store spend (channel
+    comparison per customer with conditional sums)."""
+    ss = (t["store_sales"]
+          .groupBy("ss_customer_sk")
+          .agg(F.sum("ss_ext_sales_price").alias("store_spend"))
+          .withColumnRenamed("ss_customer_sk", "s_customer"))
+    ws = (t["web_sales"]
+          .groupBy("ws_customer_sk")
+          .agg(F.sum("ws_ext_sales_price").alias("web_spend"))
+          .withColumnRenamed("ws_customer_sk", "w_customer"))
+    return (ss.join(ws, on=(col("s_customer") == col("w_customer")))
+            .filter(col("web_spend") > col("store_spend"))
+            .select(col("s_customer").alias("customer_sk"),
+                    col("store_spend"), col("web_spend"))
+            .orderBy(col("web_spend").desc(),
+                     col("customer_sk").asc())
+            .limit(100))
+
+
+def tpcxbb_q09(t):
+    """Store-sales revenue by store unit over a date window with a
+    minimum-volume HAVING (aggregate pruning shape)."""
+    window = ((col("ss_sold_date_sk") >= lit(_D0 + 30)) &
+              (col("ss_sold_date_sk") <= lit(_D0 + 120)))
+    return (t["store_sales"].filter(window)
+            .join(t["store"],
+                  on=(col("ss_unit_sk") == col("s_store_sk")))
+            .groupBy("s_store_id")
+            .agg(F.sum("ss_ext_sales_price").alias("revenue"),
+                 F.count("*").alias("n_sales"))
+            .filter(col("n_sales") > lit(10))
+            .orderBy(col("revenue").desc(), col("s_store_id").asc()))
+
+
+def tpcxbb_q30(t):
+    """Cross-channel repeat buyers: customers present in BOTH catalog and
+    web sales with their per-channel item breadth (semi-join + distinct
+    counts)."""
+    cs = (t["catalog_sales"]
+          .groupBy("cs_customer_sk")
+          .agg(F.countDistinct(col("cs_item_sk")).alias("catalog_items")))
+    ws = (t["web_sales"]
+          .groupBy("ws_customer_sk")
+          .agg(F.countDistinct(col("ws_item_sk")).alias("web_items"))
+          .withColumnRenamed("ws_customer_sk", "w_customer"))
+    return (cs.join(ws, on=(col("cs_customer_sk") == col("w_customer")))
+            .select(col("cs_customer_sk").alias("customer_sk"),
+                    col("catalog_items"), col("web_items"))
+            .orderBy((col("catalog_items") + col("web_items")).desc(),
+                     col("customer_sk").asc())
+            .limit(100))
+
+
+TPCXBB_QUERIES = {"tpcxbb_q06": tpcxbb_q06, "tpcxbb_q09": tpcxbb_q09,
+                  "tpcxbb_q30": tpcxbb_q30}

@@ -132,4 +132,16 @@ def _compute_segment(fn, spec, seg: List[int], df, ev, okey_of, results):
                 if lo <= hi else []
             results[i] = _agg_py(fn.op, window_rows, fn.ignore_nulls)
         return
+    from ..ops.python_udf import PandasAggUDF
+    if isinstance(fn, PandasAggUDF):
+        frame = spec.frame
+        if frame is not None and not frame.is_whole_partition:
+            raise NotImplementedError(
+                "pandas window UDFs support whole-partition frames only")
+        cols = [ev.eval(c) for c in fn.children]   # once per column
+        series = [pd.Series([c[i] for i in seg]) for c in cols]
+        val = fn.fn(*series)
+        for i in seg:
+            results[i] = val
+        return
     raise NotImplementedError(f"cpu window fn {type(fn).__name__}")

@@ -106,6 +106,36 @@ class TpuWindowExec(TpuExec):
             scol = K.gather_column(col, order)
             off = fn.offset if not isinstance(fn, W.Lag) else -fn.offset
             return W.shift_in_segment(scol, seg_ids, off, fn.default, cap)
+        from ..ops.python_udf import PandasAggUDF
+        if isinstance(fn, PandasAggUDF):
+            # GpuWindowInPandasExec analog: one fn(Series...) -> scalar
+            # call per window PARTITION, broadcast to its rows. Whole-
+            # partition frames only (the reference's grouped-agg window
+            # scope); bounded frames stay native-only.
+            if frame is not None and not frame.is_whole_partition:
+                raise NotImplementedError(
+                    "pandas window UDFs support whole-partition frames "
+                    "only")
+            import numpy as np
+            import pandas as pd
+            seg = np.asarray(seg_ids)
+            lv = np.asarray(live)
+            cols = [K.gather_column(
+                ex.materialize(c.eval(batch), batch), order)
+                for c in fn.children]
+            n_rows = int(lv.sum())
+            series = [pd.Series(c.to_arrow(n_rows).to_pandas())
+                      for c in cols]
+            out_np = np.zeros(cap, dtype=object)
+            for sid in np.unique(seg[lv]):
+                rows = np.nonzero(lv & (seg == sid))[0]
+                sliced = [s.iloc[rows].reset_index(drop=True)
+                          for s in series]
+                out_np[rows] = fn.fn(*sliced)
+            # NaN results stay NaN (Spark keeps a pandas UDF's NaN as a
+            # double NaN, not NULL); only dead rows become NULL
+            vals = [out_np[i] if lv[i] else None for i in range(cap)]
+            return Column.from_pylist(vals, fn.return_type, capacity=cap)
         if isinstance(fn, lp.AggregateExpression):
             col = None
             if fn.children:

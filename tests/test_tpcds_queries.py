@@ -40,3 +40,16 @@ def test_cube_golden():
             {"a": ["x", "x", "y"], "b": [1, 2, 1], "v": [1.0, 2.0, 4.0]})
         .cube("a", "b").agg(F.sum("v").alias("sv")),
         approx=1e-9, ignore_order=True)
+
+
+@pytest.mark.parametrize("qname", ["tpcxbb_q06", "tpcxbb_q09",
+                                   "tpcxbb_q30"])
+def test_tpcxbb_query_golden(qname):
+    """TPCxBB-like suite (BASELINE milestone 3; the reference's
+    TpcxbbLikeSpark analog) over the TPC-DS-like retail tables."""
+    from benchmarks import tpcxbb_queries as XBB
+
+    assert_tpu_and_cpu_equal(
+        lambda s: XBB.TPCXBB_QUERIES[qname](
+            datagen.register_tpcds_tables(s, _SF)),
+        approx=1e-5, ignore_order=True)

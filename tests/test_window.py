@@ -230,3 +230,54 @@ def test_range_frame_desc_falls_back():
     rows = out.collect()
     s.assert_on_tpu(allowed_fallbacks=["Window"])
     assert len(rows) == 3
+
+
+def test_window_in_pandas_golden():
+    """Grouped-agg pandas UDF over a window partition (the
+    GpuWindowInPandasExec analog): one fn call per partition, broadcast
+    to its rows."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.ops import window as W
+    from spark_rapids_tpu.ops import expressions as ex
+    from spark_rapids_tpu.plan import logical as lp
+    from golden import assert_tpu_and_cpu_equal
+
+    @F.pandas_udf(returnType="double", functionType="grouped_agg")
+    def med(v):
+        return float(v.median())
+
+    def build(s):
+        df = s.createDataFrame({"k": [1, 2, 1, 2, 1],
+                                "v": [1.0, 2.0, 3.0, 4.0, 9.0]})
+        spec = W.WindowSpec([ex.ColumnRef("k")], [])
+        plan = lp.Window(df._plan, [
+            ("m", W.WindowExpression(med(F.col("v")).expr, spec))])
+        return df._df(plan)
+
+    rows = assert_tpu_and_cpu_equal(build, approx=1e-9, ignore_order=True)
+    got = sorted((r[0], r[2]) for r in rows)
+    assert got == [(1, 3.0), (1, 3.0), (1, 3.0), (2, 3.0), (2, 3.0)]
+
+
+def test_window_in_pandas_nan_stays_nan():
+    """A pandas window UDF returning NaN keeps the double NaN (Spark
+    semantics — not NULL) on both engines."""
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.ops import window as W
+    from spark_rapids_tpu.ops import expressions as ex
+    from spark_rapids_tpu.plan import logical as lp
+    from golden import assert_tpu_and_cpu_equal
+
+    @F.pandas_udf(returnType="double", functionType="grouped_agg")
+    def med(v):
+        return float(v.median())
+
+    def build(s):
+        df = s.createDataFrame({"k": [1, 1, 2, 2],
+                                "v": [None, None, 4.0, 6.0]})
+        spec = W.WindowSpec([ex.ColumnRef("k")], [])
+        plan = lp.Window(df._plan, [
+            ("m", W.WindowExpression(med(F.col("v")).expr, spec))])
+        return df._df(plan)
+
+    assert_tpu_and_cpu_equal(build, approx=1e-9, ignore_order=True)
