@@ -190,8 +190,37 @@ def _pandas_query(query: str, li):
     raise ValueError(query)
 
 
+def _probe_device(timeout_s: int = 180):
+    """Device-tunnel health probe in a CHILD process: a dead remote
+    tunnel hangs jax.devices() indefinitely, which would hang the whole
+    bench; the child takes the hang so the parent can report and exit.
+    Returns None when healthy, else a diagnostic string."""
+    import subprocess
+    import sys
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"device tunnel unreachable (jax.devices() probe timed "
+                f"out after {timeout_s}s); see axon tunnel status")
+    if out.returncode == 0 and out.stdout.strip():
+        return None
+    tail = (out.stderr or "").strip().splitlines()[-3:]
+    return (f"device probe failed (rc={out.returncode}): "
+            + " | ".join(tail)[:400])
+
+
 def main():
     global K_SLOTS
+    err = _probe_device()
+    if err is not None:
+        print(json.dumps({
+            "metric": "fused filter+project+groupby throughput",
+            "value": 0, "unit": "Mrows/s", "vs_baseline": 0,
+            "error": err}))
+        return
     import jax
     K_SLOTS = _k_slots()
     platform = jax.devices()[0].platform

@@ -852,10 +852,16 @@ class Overrides:
             return TpuMeshJoinExec(stream, build, how, stream_keys,
                                    build_keys, residual, mesh,
                                    pk_stream, pk_build)
-        return ph.TpuShuffledJoinExec(
+        j = ph.TpuShuffledJoinExec(
             TpuHashExchangeExec(stream, n, pk_stream),
             TpuHashExchangeExec(build, n, pk_build),
             how, stream_keys, build_keys, residual)
+        if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and not multiworker \
+                and threshold >= 0:
+            # AQE: estimates said shuffle; observed map-side sizes may
+            # overrule at runtime (physical._maybe_runtime_broadcast)
+            j.aqe_broadcast_threshold = threshold
+        return j
 
 
 def _shred_struct_columns(root: lp.LogicalPlan) -> lp.LogicalPlan:
