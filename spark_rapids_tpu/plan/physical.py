@@ -2629,43 +2629,45 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
         return self.children[0].output_partitions
 
     def execute(self) -> List[Partition]:
-        switched = self._maybe_runtime_broadcast()
+        switched, rparts = self._maybe_runtime_broadcast()
         if switched is not None:
             return switched
         lparts = self.children[0].execute()
-        rparts = self.children[1].execute()
+        if rparts is None:
+            rparts = self.children[1].execute()
         assert len(lparts) == len(rparts), \
             f"co-partition mismatch: {len(lparts)} vs {len(rparts)}"
         return [self._join_copart(sp, bp)
                 for sp, bp in zip(lparts, rparts)]
 
-    def _maybe_runtime_broadcast(self) -> Optional[List[Partition]]:
+    def _maybe_runtime_broadcast(self):
         """AQE runtime join-strategy switch (the reference's AQE broadcast
         conversion + GpuCustomShuffleReaderExec territory): run the BUILD
         side's exchange map phase first; when its OBSERVED output is under
         the broadcast threshold, materialize one broadcast build batch
         from the already-shuffled slices and stream-join against the
         UNexchanged stream child — the stream-side shuffle never executes.
-        Planner estimates decided shuffled; runtime sizes overrule."""
+        Planner estimates decided shuffled; runtime sizes overrule.
+
+        Returns ``(broadcast_partitions, None)`` on a switch, or
+        ``(None, build_partitions_or_None)`` when staying co-partitioned
+        (execute() owns the single co-partitioned join loop either way)."""
         from ..shuffle.exchange import TpuShuffleExchangeExec
         thr = self.aqe_broadcast_threshold
         if thr is None or thr < 0 or self.how in ("right", "full"):
             # right/full outer against a broadcast build would duplicate
             # unmatched build rows per stream partition
-            return None
+            return None, None
         sx, bx = self.children
         if not isinstance(sx, TpuShuffleExchangeExec) or \
                 not isinstance(bx, TpuShuffleExchangeExec):
-            return None
+            return None, None
         raw_stream = sx.children[0]
         bparts = bx.execute()          # map phase runs: size now observed
         observed = bx.metrics.resolve().get("dataSize", 0)
         if observed > thr:
             # stay co-partitioned (stream exchange proceeds as planned)
-            lparts = sx.execute()
-            assert len(lparts) == len(bparts)
-            return [self._join_copart(sp, bp)
-                    for sp, bp in zip(lparts, bparts)]
+            return None, bparts
         from ..exec.spill import SpillableColumnarBatch
         # concurrent drain (accumulate_spillable): a serial sweep would
         # pay one blocking readback per shuffle partition on tunnel links
@@ -2675,7 +2677,7 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
 
         def gen(p):
             yield from self._join_part(p, self._rt_broadcast)
-        return [gen(p) for p in raw_stream.execute()]
+        return [gen(p) for p in raw_stream.execute()], None
 
     def _cleanup(self) -> None:
         h = getattr(self, "_rt_broadcast", None)

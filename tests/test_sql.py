@@ -168,3 +168,71 @@ def test_sql_negative_in_list_and_regexp(session):
         "SELECT regexp_replace(name, 'a+', 'X') AS r FROM t ORDER BY r"
     ).collect()
     assert out == [("X",), ("Xb",), ("bb",), ("cc",)]
+
+
+def test_sql_tpch_q6_text():
+    """TPC-H q6 as SQL TEXT through session.sql, golden against the
+    DataFrame-API build of the same query."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from benchmarks import datagen, queries as Q
+
+    s = TpuSession.builder.getOrCreate()
+    tables = datagen.register_tables(s, 0.002)
+    sql_out = s.sql(
+        "SELECT sum(l_extendedprice * l_discount) AS revenue "
+        "FROM lineitem "
+        "WHERE l_shipdate >= DATE '1994-01-01' "
+        "AND l_shipdate < DATE '1995-01-01' "
+        "AND l_discount BETWEEN 0.05 AND 0.07 "
+        "AND l_quantity < 24").collect()
+    api_out = Q.QUERIES["q6"](tables).collect()
+    assert abs(sql_out[0][0] - api_out[0][0]) < 1e-6
+
+
+def test_sql_tpch_q1_text():
+    from spark_rapids_tpu.api.session import TpuSession
+    from benchmarks import datagen, queries as Q
+
+    s = TpuSession.builder.getOrCreate()
+    tables = datagen.register_tables(s, 0.002)
+    sql_out = s.sql(
+        "SELECT l_returnflag, l_linestatus, "
+        "sum(l_quantity) AS sum_qty, "
+        "sum(l_extendedprice) AS sum_base_price, "
+        "sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price, "
+        "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge, "
+        "avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price, "
+        "avg(l_discount) AS avg_disc, count(*) AS count_order "
+        "FROM lineitem WHERE l_shipdate <= DATE '1998-09-02' "
+        "GROUP BY l_returnflag, l_linestatus "
+        "ORDER BY l_returnflag, l_linestatus").collect()
+    api_out = Q.QUERIES["q1"](tables).collect()
+    assert len(sql_out) == len(api_out)
+    for a, b in zip(sql_out, api_out):
+        assert a[0] == b[0] and a[1] == b[1]
+        for x, y in zip(a[2:], b[2:]):
+            assert abs(x - y) <= 1e-6 * max(1.0, abs(y)), (a, b)
+
+
+def test_sql_tpch_q3_text():
+    from spark_rapids_tpu.api.session import TpuSession
+    from benchmarks import datagen, queries as Q
+
+    s = TpuSession.builder.getOrCreate()
+    tables = datagen.register_tables(s, 0.002)
+    sql_out = s.sql(
+        "SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS "
+        "revenue, o_orderdate, o_shippriority "
+        "FROM customer JOIN orders ON c_custkey = o_custkey "
+        "JOIN lineitem ON o_orderkey = l_orderkey "
+        "WHERE c_mktsegment = 'BUILDING' "
+        "AND o_orderdate < DATE '1995-03-15' "
+        "AND l_shipdate > DATE '1995-03-15' "
+        "GROUP BY l_orderkey, o_orderdate, o_shippriority "
+        "ORDER BY revenue DESC, o_orderdate LIMIT 10").collect()
+    api_out = Q.QUERIES["q3"](tables).collect()
+    assert len(sql_out) == len(api_out)
+    # SQL selects revenue second; the API groups-first form puts it last
+    for a, b in zip(sql_out, api_out):
+        assert a[0] == b[0] and abs(a[1] - b[3]) < 1e-6 and \
+            a[2] == b[1] and a[3] == b[2]
