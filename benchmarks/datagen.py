@@ -247,13 +247,38 @@ _D_DATE_BASE = 2450815        # d_date_sk epoch used by date_dim
 
 
 def gen_date_dim() -> Dict[str, np.ndarray]:
-    """5 years of days: d_date_sk plus month_seq for q97's window."""
+    """5 years of days: d_date_sk plus month_seq/year/moy for the q97
+    window and the q3/q42/q52 star joins."""
     n = 365 * 5
+    days = np.arange(n)
     sk = np.arange(_D_DATE_BASE, _D_DATE_BASE + n, dtype=np.int64)
     return {
         "d_date_sk": sk,
-        "d_month_seq": (1176 + (np.arange(n) // 30)).astype(np.int64),
-        "d_year": (1998 + np.arange(n) // 365).astype(np.int64),
+        "d_month_seq": (1176 + (days // 30)).astype(np.int64),
+        "d_year": (1998 + days // 365).astype(np.int64),
+        "d_moy": ((days % 365) // 31 + 1).astype(np.int64),
+    }
+
+
+_DS_CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
+                  "Shoes", "Sports", "Women"]
+
+
+def gen_item() -> Dict[str, np.ndarray]:
+    n = DS_ITEM_PER_SF
+    rng = np.random.default_rng(53)
+    brand_id = rng.integers(1, 1000, n).astype(np.int64)
+    return {
+        "i_item_sk": np.arange(1, n + 1, dtype=np.int64),
+        "i_brand_id": brand_id,
+        # 1:1 with the id (the TPC-DS schema relationship q3/q52's
+        # two-column grouping relies on)
+        "i_brand": np.char.add("brand#", brand_id.astype(str)),
+        "i_category_id": (np.arange(n) % len(_DS_CATEGORIES) + 1
+                          ).astype(np.int64),
+        "i_category": np.array(_DS_CATEGORIES)[
+            np.arange(n) % len(_DS_CATEGORIES)],
+        "i_manufact_id": rng.integers(1, 100, n).astype(np.int64),
     }
 
 
@@ -304,6 +329,7 @@ def register_tpcds_tables(session, sf: float, date_span: int = 365 * 5):
         "web_returns": _returns_channel(
             n_ws // RETURN_FRACTION, rng, "wr", N_WEB_SITES, date_span),
         "date_dim": gen_date_dim(),
+        "item": gen_item(),
         "store": {
             "s_store_sk": np.arange(1, N_STORES + 1, dtype=np.int64),
             "s_store_id": np.array(

@@ -1,5 +1,5 @@
 """TPC-DS-like query definitions on the DataFrame API (BASELINE.md
-milestone 2: q5 + q97).
+milestone 2's q5 + q97 plus the q3/q42/q52 star-join family).
 
 Analog of the reference's TpcdsLikeSpark.scala query objects
 (integration_tests/.../tpcds/). Each query takes the dict of DataFrames
@@ -112,4 +112,52 @@ def tpcds_q97(t):
                     F.sum(store_and_catalog).alias("store_and_catalog"))
 
 
-TPCDS_QUERIES = {"tpcds_q5": tpcds_q5, "tpcds_q97": tpcds_q97}
+def tpcds_q3(t):
+    """Brand revenue for a manufacturer by year/month (TpcdsLikeSpark
+    Query3's star-join shape: store_sales x date_dim x item)."""
+    d = t["date_dim"].filter(col("d_moy") == lit(11))
+    i = t["item"].filter(col("i_manufact_id") == lit(28))
+    return (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(i, on=(col("ss_item_sk") == col("i_item_sk")))
+            .groupBy("d_year", "i_brand_id", "i_brand")
+            .agg(F.sum("ss_ext_sales_price").alias("sum_agg"))
+            .orderBy(col("d_year").asc(), col("sum_agg").desc(),
+                     col("i_brand_id").asc())
+            .limit(100))
+
+
+def tpcds_q42(t):
+    """Category revenue for one year+month (Query42)."""
+    d = t["date_dim"].filter((col("d_moy") == lit(11)) &
+                             (col("d_year") == lit(2000)))
+    return (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(t["item"],
+                  on=(col("ss_item_sk") == col("i_item_sk")))
+            .groupBy("d_year", "i_category_id", "i_category")
+            .agg(F.sum("ss_ext_sales_price").alias("total"))
+            .orderBy(col("total").desc(), col("d_year").asc(),
+                     col("i_category_id").asc(), col("i_category").asc())
+            .limit(100))
+
+
+def tpcds_q52(t):
+    """Brand revenue for one year+month (Query52 — q3's star-join shape
+    with different month/year constants and no manufacturer filter)."""
+    d = t["date_dim"].filter((col("d_moy") == lit(12)) &
+                             (col("d_year") == lit(1999)))
+    return (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(t["item"],
+                  on=(col("ss_item_sk") == col("i_item_sk")))
+            .groupBy("d_year", "i_brand_id", "i_brand")
+            .agg(F.sum("ss_ext_sales_price").alias("ext_price"))
+            .orderBy(col("d_year").asc(), col("ext_price").desc(),
+                     col("i_brand_id").asc())
+            .limit(100))
+
+
+TPCDS_QUERIES = {"tpcds_q3": tpcds_q3, "tpcds_q5": tpcds_q5,
+                 "tpcds_q42": tpcds_q42, "tpcds_q52": tpcds_q52,
+                 "tpcds_q97": tpcds_q97}
