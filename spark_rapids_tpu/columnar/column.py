@@ -330,10 +330,22 @@ class Column:
         cap = capacity or bucket(num_rows)
         if scalar.is_null:
             return Column.full_null(scalar.dtype, cap)
-        if scalar.dtype == dt.STRING:
-            return Column.from_pylist([scalar.value] * num_rows, dt.STRING, cap)
-        data = jnp.full(cap, scalar.value, dtype=scalar.dtype.numpy_dtype)
         valid = jnp.arange(cap) < num_rows
+        if scalar.dtype == dt.STRING:
+            # trace-safe broadcast: the byte row is STATIC (the literal),
+            # only the live mask depends on num_rows — a pylist build
+            # would do `[value] * tracer` and break whole-stage fusion
+            b = scalar.value.encode("utf-8") if isinstance(
+                scalar.value, str) else bytes(scalar.value)
+            w = string_width_bucket(len(b))
+            row = np.zeros(w, dtype=np.uint8)
+            row[:len(b)] = np.frombuffer(b, dtype=np.uint8)
+            data = jnp.where(valid[:, None],
+                             jnp.broadcast_to(jnp.asarray(row), (cap, w)),
+                             jnp.zeros((), jnp.uint8))
+            lengths = jnp.where(valid, jnp.int32(len(b)), 0)
+            return Column(dt.STRING, data, valid, lengths)
+        data = jnp.full(cap, scalar.value, dtype=scalar.dtype.numpy_dtype)
         data = jnp.where(valid, data, jnp.zeros((), dtype=scalar.dtype.numpy_dtype))
         return Column(scalar.dtype, data, valid)
 

@@ -170,3 +170,21 @@ def _ref_bytes(bs, seed=42):
         b = bs[i] - 256 if bs[i] >= 128 else bs[i]
         h1 = _mixh1(h1, _mixk1(b & _M))
     return _s32(_fmix(h1, n))
+
+
+def test_string_literal_project_fuses(caplog):
+    """String literals broadcast trace-safely (static byte row + live
+    mask): the whole-stage project must NOT fall back to eager."""
+    import logging
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api.functions import col, lit
+
+    s = TpuSession.builder.getOrCreate()
+    df = s.createDataFrame({"v": [1.0, 2.0, 3.0]})
+    with caplog.at_level(logging.WARNING, logger="spark_rapids_tpu.fusion"):
+        out = df.select(lit("tag").alias("c"),
+                        (col("v") * 2).alias("d")).collect()
+    assert out == [("tag", 2.0), ("tag", 4.0), ("tag", 6.0)]
+    s.assert_on_tpu()
+    assert not [r for r in caplog.records if "fell back" in r.message], \
+        [r.message for r in caplog.records]
