@@ -856,10 +856,13 @@ class Overrides:
             TpuHashExchangeExec(stream, n, pk_stream),
             TpuHashExchangeExec(build, n, pk_build),
             how, stream_keys, build_keys, residual)
-        if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and not multiworker \
-                and threshold >= 0:
+        if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and threshold >= 0:
             # AQE: estimates said shuffle; observed map-side sizes may
-            # overrule at runtime (physical._maybe_runtime_broadcast)
+            # overrule at runtime (physical._maybe_runtime_broadcast).
+            # Multi-worker included: the runtime decision is made from the
+            # GLOBAL observed size (control-plane allreduce), so every
+            # worker takes the same branch and a switch materializes the
+            # complete build side from all peers' slices
             j.aqe_broadcast_threshold = threshold
         return j
 

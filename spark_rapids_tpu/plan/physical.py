@@ -2653,6 +2653,7 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
         ``(None, build_partitions_or_None)`` when staying co-partitioned
         (execute() owns the single co-partitioned join loop either way)."""
         from ..shuffle.exchange import TpuShuffleExchangeExec
+        from ..shuffle.manager import WorkerContext
         thr = self.aqe_broadcast_threshold
         if thr is None or thr < 0 or self.how in ("right", "full"):
             # right/full outer against a broadcast build would duplicate
@@ -2665,13 +2666,34 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
         raw_stream = sx.children[0]
         bparts = bx.execute()          # map phase runs: size now observed
         observed = bx.metrics.resolve().get("dataSize", 0)
+        ctx = WorkerContext.current
+        if ctx is not None:
+            # mesh-consistent decision: the LOCAL observed size is one
+            # shard's contribution; sum it across workers through the
+            # control-plane allreduce so every worker takes the SAME
+            # branch (a split decision would desync the lockstep
+            # shuffle-id streams — and the fingerprint handshake would
+            # abort the query)
+            observed = ctx.allreduce_bytes(bx._shuffle.shuffle_id, observed)
         if observed > thr:
             # stay co-partitioned (stream exchange proceeds as planned)
             return None, bparts
         from ..exec.spill import SpillableColumnarBatch
-        # concurrent drain (accumulate_spillable): a serial sweep would
-        # pay one blocking readback per shuffle partition on tunnel links
-        build = concat_spillable(bx.schema, accumulate_spillable(bparts))
+        if ctx is not None:
+            # the full build side = EVERY reduce partition (local + peers),
+            # not just the owned ones: each worker broadcast-joins its raw
+            # local stream shard against the complete build; one source
+            # generator per peer so fetches drain concurrently
+            build = concat_spillable(
+                bx.schema,
+                accumulate_spillable(
+                    bx._shuffle.read_all_partition_sources()))
+        else:
+            # concurrent drain (accumulate_spillable): a serial sweep would
+            # pay one blocking readback per shuffle partition on tunnel
+            # links
+            build = concat_spillable(bx.schema,
+                                     accumulate_spillable(bparts))
         self._rt_broadcast = SpillableColumnarBatch(build)
         self.metrics.inc("runtimeBroadcastJoins")
 

@@ -117,6 +117,29 @@ class TpuShuffleExchangeExec(TpuExec):
         groups = self._reduce_groups(shuffle)
         return [self._read_group(shuffle, g) for g in groups]
 
+    def plan_fingerprint(self) -> str:
+        """Structural hash of this exchange's plan subtree: exec class
+        names + output schemas + the partitioning KEY EXPRESSIONS,
+        recursively. Deliberately EXCLUDES data-dependent detail (row
+        counts, shard paths) so every worker running the same logical
+        query computes the same value, while structurally different
+        exchanges — including two identical trees hash-partitioned on
+        different columns, the exact silent-wrong-data signature —
+        compute different ones."""
+        import hashlib
+
+        def desc(node) -> str:
+            try:
+                sch = ",".join(f"{f.name}:{f.dtype.name}"
+                               for f in node.schema)
+            except Exception:
+                sch = "?"
+            kids = ";".join(desc(c) for c in node.children)
+            return f"{type(node).__name__}[{sch}]({kids})"
+        by = ",".join(repr(e) for e in self.by) if self.by else ""
+        s = f"{desc(self)}|n={self.num_partitions}|by={by}"
+        return hashlib.sha1(s.encode()).hexdigest()[:16]
+
     def _execute_distributed(self, ctx) -> List[Partition]:
         """Multi-process mode: map slices register in the worker's
         ShuffleStore (RapidsCachingWriter), reduce partitions this worker
@@ -126,8 +149,8 @@ class TpuShuffleExchangeExec(TpuExec):
         identical on every worker."""
         from ..exec.tasks import run_partition_tasks
         from .manager import DistributedShuffle
-        shuffle = self._shuffle = DistributedShuffle(self.num_partitions,
-                                                     ctx)
+        shuffle = self._shuffle = DistributedShuffle(
+            self.num_partitions, ctx, fingerprint=self.plan_fingerprint())
         partitioner = self._make_partitioner()
 
         def map_task(pid, part):

@@ -39,8 +39,9 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
-from .transport import (ShuffleClient, ShuffleFetchError, ShuffleServer,
-                        ShuffleStore, _rebuild_batch)
+from .transport import (ShuffleClient, ShuffleDesyncError, ShuffleFetchError,
+                        ShuffleServer, ShuffleStore, ShuffleWorkerLostError,
+                        _rebuild_batch)
 
 
 class WorkerContext:
@@ -51,14 +52,17 @@ class WorkerContext:
     current: Optional["WorkerContext"] = None
 
     def __init__(self, worker_id: int, n_workers: int,
-                 port: int = 0, codec: str = "none"):
+                 port: int = 0, codec: str = "none",
+                 fetch_timeout_s: float = 60.0):
         self.worker_id = worker_id
         self.n_workers = n_workers
         self.store = ShuffleStore()
+        self.store.release_quorum = n_workers
         self.server = ShuffleServer(self.store, port=port,
                                     codec=codec).start()
         self.port = self.server.port
         self.peers: Dict[int, Tuple[str, int]] = {}
+        self.fetch_timeout_s = fetch_timeout_s
         self._next_shuffle = 1
         self._peer_complete: set = set()    # (worker_id, shuffle_id)
         self._mu = threading.Lock()
@@ -84,20 +88,70 @@ class WorkerContext:
         return ShuffleClient.for_address(host, port)
 
     def fetch_from_peer(self, worker_id: int, shuffle_id: int,
-                        reduce_ids: List[int]):
+                        reduce_ids: List[int],
+                        fingerprint: Optional[str] = None):
         """Fetch with per-(peer, shuffle) completion caching: map
         completion is monotonic, so only the FIRST fetch per peer+shuffle
-        pays the completion-poll round trips."""
+        pays the completion-poll round trips. Failures surface LOUDLY and
+        with the right label: a desync keeps its type (wrong-pairing
+        detection); connection-rooted failures become
+        :class:`ShuffleWorkerLostError` naming the peer (a dead worker's
+        shard is unrecoverable from other lineage, so the query aborts
+        instead of returning partial rows); protocol/straggler failures
+        (released outputs, live-but-slow map phase) keep their
+        ShuffleFetchError identity with the peer id prepended — a slow
+        worker is not a dead worker."""
         client = self.client_for(worker_id)
         key = (worker_id, shuffle_id)
         with self._mu:
             complete = key in self._peer_complete
-        if complete:
-            return client.fetch(shuffle_id, reduce_ids)
-        out = client.fetch_when_complete(shuffle_id, reduce_ids)
+        try:
+            if complete:
+                return client.fetch(shuffle_id, reduce_ids,
+                                    fingerprint=fingerprint)
+            out = client.fetch_when_complete(
+                shuffle_id, reduce_ids, timeout_s=self.fetch_timeout_s,
+                fingerprint=fingerprint)
+        except ShuffleDesyncError as e:
+            raise ShuffleDesyncError(
+                f"worker {worker_id}: {e}") from e
+        except ShuffleFetchError as e:
+            if isinstance(e.__cause__, (ConnectionError, OSError)):
+                raise ShuffleWorkerLostError(
+                    worker_id,
+                    f"worker {worker_id} lost while fetching shuffle "
+                    f"{shuffle_id} partitions {reduce_ids}: {e}") from e
+            raise ShuffleFetchError(
+                f"worker {worker_id}: {e}") from e
         with self._mu:
             self._peer_complete.add(key)
         return out
+
+    def release_shuffle(self, shuffle_id: int) -> None:
+        """This worker finished ALL reads of ``shuffle_id``: ack locally
+        and notify every peer (fire-and-forget). Each store frees the
+        shuffle's outputs once the full quorum has acked."""
+        self.store.add_release(shuffle_id, self.worker_id)
+        for wid in sorted(self.peers):
+            self.client_for(wid).send_release(shuffle_id, self.worker_id)
+
+    def allreduce_bytes(self, tag: int, value: int) -> int:
+        """Sum one integer across all workers through the shuffle store
+        (the control-plane allreduce behind mesh-consistent runtime
+        decisions — every worker computes the SAME total, so adaptive
+        branches stay lockstep). ``tag`` keys a reserved negative shuffle
+        namespace so control values never collide with data shuffles."""
+        ctrl_sid = -abs(int(tag))
+        batch = ColumnarBatch.from_pydict({"v": [int(value)]})
+        self.store.register_batch(ctrl_sid, self.worker_id,
+                                  batch.fetch_to_host())
+        self.store.mark_complete(ctrl_sid)
+        total = int(value)
+        for wid in sorted(self.peers):
+            for b in self.fetch_from_peer(wid, ctrl_sid, [wid]):
+                total += int(b.rows()[0][0])
+        self.release_shuffle(ctrl_sid)
+        return total
 
     def shutdown(self) -> None:
         self.server.stop()
@@ -117,12 +171,24 @@ def init_worker(worker_id: int, n_workers: int, port: int = 0,
 
 class DistributedShuffle:
     """LocalShuffle-compatible exchange state backed by the worker's
-    ShuffleStore + peer fetches (the caching writer/reader pair)."""
+    ShuffleStore + peer fetches (the caching writer/reader pair).
 
-    def __init__(self, num_partitions: int, ctx: WorkerContext):
+    ``fingerprint`` is the structural hash of the exchange's plan subtree:
+    registered with the local store and sent on every peer fetch, so a
+    worker whose query stream diverged (the lockstep shuffle-id contract)
+    gets a LOUD :class:`ShuffleDesyncError` instead of silently joining
+    mismatched shuffles."""
+
+    def __init__(self, num_partitions: int, ctx: WorkerContext,
+                 fingerprint: Optional[str] = None):
         self.num_partitions = num_partitions
         self.ctx = ctx
         self.shuffle_id = ctx.next_shuffle_id()
+        self.fingerprint = fingerprint
+        if fingerprint:
+            # bind BEFORE any write: peers polling completion already get
+            # fingerprint validation on their first metadata round trip
+            ctx.store.set_fingerprint(self.shuffle_id, fingerprint)
         self._wrote = False
 
     # -- map side ------------------------------------------------------------
@@ -146,15 +212,36 @@ class DistributedShuffle:
         from ..plan.physical import concat_batches
         batches = list(self.ctx.store.local_batches(self.shuffle_id, p))
         for wid in sorted(self.ctx.peers):
-            batches.extend(self.ctx.fetch_from_peer(wid, self.shuffle_id,
-                                                    [p]))
+            batches.extend(self.ctx.fetch_from_peer(
+                wid, self.shuffle_id, [p], fingerprint=self.fingerprint))
         if batches:
             yield concat_batches(schema, batches)
 
+    def read_all_partition_sources(self) -> List:
+        """EVERY reduce partition's full data (local + all peers), not
+        just the owned ones — the mesh-consistent runtime-broadcast path:
+        when the global build size is under threshold, every worker
+        materializes the complete build side from the already-shuffled
+        slices. Returned as one generator per SOURCE (local store + each
+        peer) so the caller's task runner drains sources concurrently
+        instead of paying each peer's fetch latency serially."""
+        def local():
+            for p in range(self.num_partitions):
+                yield from self.ctx.store.local_batches(self.shuffle_id, p)
+
+        def from_peer(wid):
+            yield from self.ctx.fetch_from_peer(
+                wid, self.shuffle_id, list(range(self.num_partitions)),
+                fingerprint=self.fingerprint)
+
+        return [local()] + [from_peer(w) for w in sorted(self.ctx.peers)]
+
     def close_pending(self) -> None:
-        # NOT removed at local collect end: a faster worker's cleanup would
-        # strand slower peers still fetching its map outputs (the reference
-        # keeps shuffle data until the driver ends the stage cluster-wide;
-        # standalone, outputs live until WorkerContext.shutdown or an
-        # explicit release once every peer is known to be done)
-        pass
+        """This worker is done READING this shuffle: ack the release
+        quorum (local + every peer). Nothing is freed until ALL workers
+        have acked, so a faster worker's cleanup can never strand slower
+        peers still fetching its map outputs — but once the quorum
+        completes, every store frees the outputs instead of holding them
+        until ``WorkerContext.shutdown`` (the reference's driver-scoped
+        active-shuffle lifecycle, ShuffleBufferCatalog.scala)."""
+        self.ctx.release_shuffle(self.shuffle_id)

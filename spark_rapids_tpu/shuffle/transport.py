@@ -32,13 +32,34 @@ from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column
 from . import wire
-from .wire import (ERROR, META_REQ, META_RESP, XFER_CHUNK, XFER_DONE,
-                   XFER_REQ, ArrayDesc, BufferDesc, FrameReader, encode_frame)
+from .wire import (ERROR, META_REQ, META_RESP, RELEASE, XFER_CHUNK,
+                   XFER_DONE, XFER_REQ, ArrayDesc, BufferDesc, FrameReader,
+                   encode_frame)
 
 
 class ShuffleFetchError(RuntimeError):
     """Fetch failed after retries (RapidsShuffleFetchFailedException analog:
     the caller maps this to a stage retry / recompute)."""
+
+
+class ShuffleDesyncError(ShuffleFetchError):
+    """The peer's registered plan fingerprint for this shuffle id does not
+    match ours: the lockstep shuffle-id contract broke (one worker's query
+    stream diverged). NEVER retried — retrying a desync would fetch wrong
+    data; the query must abort loudly (the reference cannot hit this class
+    of bug because the driver issues shuffle ids; standalone, the
+    fingerprint handshake detects divergence instead)."""
+
+
+class ShuffleWorkerLostError(ShuffleFetchError):
+    """A peer worker is unreachable/dead: its local data shard cannot be
+    recomputed from any other worker's lineage, so the distributed query
+    aborts loudly naming the lost peer (the standalone analog of Spark's
+    executor-lost -> job abort when no replication exists)."""
+
+    def __init__(self, worker_id: int, message: str):
+        super().__init__(message)
+        self.worker_id = worker_id
 
 
 # ---------------------------------------------------------------------------
@@ -56,6 +77,12 @@ class ShuffleStore:
         self._buffers: Dict[int, Tuple[BufferDesc, List[np.ndarray]]] = {}
         self._by_partition: Dict[Tuple[int, int], List[int]] = {}
         self._complete: set = set()
+        self._fingerprints: Dict[int, str] = {}
+        self._release_acks: Dict[int, set] = {}
+        self._released: set = set()
+        # how many distinct worker release-acks free a shuffle's outputs
+        # (set by WorkerContext to n_workers; 0 disables the protocol)
+        self.release_quorum = 0
 
     def register_batch(self, shuffle_id: int, reduce_id: int,
                        batch: ColumnarBatch) -> int:
@@ -98,6 +125,48 @@ class ShuffleStore:
         with self._mu:
             return shuffle_id in self._complete
 
+    def set_fingerprint(self, shuffle_id: int, fingerprint: str) -> None:
+        """Bind the structural plan fingerprint of the exchange that owns
+        ``shuffle_id``; metadata requests carrying a different fingerprint
+        for the same id are rejected (lockstep-desync detection)."""
+        with self._mu:
+            self._fingerprints[shuffle_id] = fingerprint
+
+    def check_fingerprint(self, shuffle_id: int,
+                          fingerprint: Optional[str]) -> Optional[str]:
+        """None when compatible; otherwise the locally-registered
+        fingerprint that conflicts with the caller's."""
+        if not fingerprint:
+            return None
+        with self._mu:
+            local = self._fingerprints.get(shuffle_id)
+        if local is not None and local != fingerprint:
+            return local
+        return None
+
+    def is_released(self, shuffle_id: int) -> bool:
+        with self._mu:
+            return shuffle_id in self._released
+
+    def add_release(self, shuffle_id: int, worker_id: int) -> bool:
+        """Record that ``worker_id`` finished ALL its reads of this
+        shuffle. Once ``release_quorum`` distinct workers have released,
+        the outputs are freed — no one will fetch after releasing, so
+        freeing is safe (ShuffleBufferCatalog active-shuffle lifecycle;
+        Spark's driver ends the stage cluster-wide, the quorum replaces
+        it standalone). Returns True when this call freed the shuffle."""
+        with self._mu:
+            if shuffle_id in self._released:
+                return False
+            acks = self._release_acks.setdefault(shuffle_id, set())
+            acks.add(worker_id)
+            if not self.release_quorum or len(acks) < self.release_quorum:
+                return False
+            self._released.add(shuffle_id)
+            self._release_acks.pop(shuffle_id, None)
+        self.remove_shuffle(shuffle_id)
+        return True
+
     def local_batches(self, shuffle_id: int, reduce_id: int
                       ) -> List[ColumnarBatch]:
         """Short-circuit read of locally-registered slices (the
@@ -119,6 +188,11 @@ class ShuffleStore:
                 for bid in self._by_partition.pop(k):
                     self._buffers.pop(bid, None)
             self._complete.discard(shuffle_id)
+            self._fingerprints.pop(shuffle_id, None)
+
+    def buffer_count(self) -> int:
+        with self._mu:
+            return len(self._buffers)
 
 
 # ---------------------------------------------------------------------------
@@ -215,12 +289,31 @@ class ShuffleServer:
                 msg_type, header, _payload = reader.next_frame()
                 if msg_type == META_REQ:
                     sid = header["shuffle_id"]
+                    conflict = self.store.check_fingerprint(
+                        sid, header.get("fingerprint"))
+                    if conflict is not None:
+                        conn.send(encode_frame(ERROR, {
+                            "code": "desync",
+                            "message": f"shuffle {sid} fingerprint mismatch:"
+                                       f" peer registered {conflict}, fetch "
+                                       f"expects {header['fingerprint']} — "
+                                       "lockstep query streams diverged"}))
+                        continue
+                    if self.store.is_released(sid):
+                        conn.send(encode_frame(ERROR, {
+                            "code": "released",
+                            "message": f"shuffle {sid} outputs were already "
+                                       "released by the full worker quorum"}))
+                        continue
                     metas = self.store.metas(sid, header["reduce_ids"])
                     conn.send(encode_frame(META_RESP, {
                         "buffers": [m.to_json() for m in metas],
                         "complete": self.store.is_complete(sid)}))
                 elif msg_type == XFER_REQ:
                     self._send_buffers(conn, header["buffer_ids"])
+                elif msg_type == RELEASE:
+                    self.store.add_release(header["shuffle_id"],
+                                           header["worker_id"])
                 else:
                     conn.send(encode_frame(
                         ERROR, {"message": f"bad msg {msg_type}"}))
@@ -300,40 +393,59 @@ class ShuffleClient:
     # -- public API ----------------------------------------------------------
     def fetch_when_complete(self, shuffle_id: int, reduce_ids: List[int],
                             timeout_s: float = 60.0,
-                            poll_s: float = 0.05) -> List[ColumnarBatch]:
+                            poll_s: float = 0.05,
+                            fingerprint: Optional[str] = None
+                            ) -> List[ColumnarBatch]:
         """Fetch once the peer's map phase for ``shuffle_id`` is complete,
         polling its metadata endpoint with backoff (the standalone stand-in
         for Spark's stage-scheduling guarantee that map outputs exist
-        before the reduce stage fetches them)."""
+        before the reduce stage fetches them). A fingerprint-desync reply
+        aborts the poll immediately — waiting cannot fix diverged query
+        streams."""
         deadline = time.monotonic() + timeout_s
         delay = poll_s
+        last_conn_err: Optional[Exception] = None
         while True:
             conn = None
             try:
                 # the connect itself is the most likely transient failure
                 # (backlog full / peer restarting): poll it too
                 conn = self._connect()
-                conn.send(encode_frame(META_REQ, {"shuffle_id": shuffle_id,
-                                                  "reduce_ids": []}))
+                conn.send(encode_frame(META_REQ, {
+                    "shuffle_id": shuffle_id, "reduce_ids": [],
+                    "fingerprint": fingerprint}))
                 reader = FrameReader(conn.read_exact)
                 msg_type, header, _ = reader.next_frame()
+                if msg_type == ERROR and header.get("code") in (
+                        "desync", "released"):
+                    self._raise_protocol_error(shuffle_id, header)
                 complete = msg_type == META_RESP and header.get("complete")
-            except (ConnectionError, OSError):
+                last_conn_err = None
+            except (ConnectionError, OSError) as e:
                 complete = False
+                last_conn_err = e
             finally:
                 if conn is not None:
                     conn.close()
             if complete:
-                return self.fetch(shuffle_id, reduce_ids)
+                return self.fetch(shuffle_id, reduce_ids,
+                                  fingerprint=fingerprint)
             if time.monotonic() > deadline:
+                if last_conn_err is not None:
+                    # distinguishes a DEAD peer (can't even connect) from a
+                    # live straggler (reachable, map just not finished):
+                    # the caller maps the former to worker-lost
+                    raise ShuffleFetchError(
+                        f"peer unreachable for shuffle {shuffle_id} after "
+                        f"{timeout_s}s: {last_conn_err}") from last_conn_err
                 raise ShuffleFetchError(
                     f"peer map phase for shuffle {shuffle_id} not complete "
-                    f"after {timeout_s}s")
+                    f"after {timeout_s}s (peer alive)")
             time.sleep(delay)
             delay = min(delay * 2, 1.0)
 
-    def fetch(self, shuffle_id: int, reduce_ids: List[int]
-              ) -> List[ColumnarBatch]:
+    def fetch(self, shuffle_id: int, reduce_ids: List[int],
+              fingerprint: Optional[str] = None) -> List[ColumnarBatch]:
         """Fetch all batches of the given reduce partitions (doFetch,
         RapidsShuffleClient.scala:480)."""
         last_err: Optional[Exception] = None
@@ -342,23 +454,50 @@ class ShuffleClient:
                 self.metrics["retries"] += 1
                 time.sleep(self.retry_backoff_s * attempt)
             try:
-                return self._fetch_once(shuffle_id, reduce_ids)
+                return self._fetch_once(shuffle_id, reduce_ids, fingerprint)
+            except ShuffleDesyncError:
+                raise                    # retrying cannot un-diverge streams
             except (ConnectionError, OSError, ValueError) as e:
                 last_err = e
         raise ShuffleFetchError(
             f"shuffle {shuffle_id} partitions {reduce_ids} failed after "
             f"{self.max_retries + 1} attempts: {last_err}") from last_err
 
+    def send_release(self, shuffle_id: int, worker_id: int) -> None:
+        """Notify the peer this worker finished ALL reads of the shuffle
+        (fire-and-forget: an unreachable peer frees at its own shutdown)."""
+        conn = None
+        try:
+            conn = self._connect()
+            conn.send(encode_frame(RELEASE, {"shuffle_id": shuffle_id,
+                                             "worker_id": worker_id}))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if conn is not None:
+                conn.close()
+
+    @staticmethod
+    def _raise_protocol_error(shuffle_id: int, header: Dict) -> None:
+        msg = header.get("message", "protocol error")
+        if header.get("code") == "desync":
+            raise ShuffleDesyncError(msg)
+        raise ShuffleFetchError(f"shuffle {shuffle_id}: {msg}")
+
     # -- one attempt ---------------------------------------------------------
-    def _fetch_once(self, shuffle_id: int, reduce_ids: List[int]
+    def _fetch_once(self, shuffle_id: int, reduce_ids: List[int],
+                    fingerprint: Optional[str] = None
                     ) -> List[ColumnarBatch]:
         conn = self._connect()
         try:
-            conn.send(encode_frame(META_REQ, {"shuffle_id": shuffle_id,
-                                              "reduce_ids": reduce_ids}))
+            conn.send(encode_frame(META_REQ, {
+                "shuffle_id": shuffle_id, "reduce_ids": reduce_ids,
+                "fingerprint": fingerprint}))
             reader = FrameReader(conn.read_exact)
             msg_type, header, _ = reader.next_frame()
             if msg_type == ERROR:
+                if header.get("code") in ("desync", "released"):
+                    self._raise_protocol_error(shuffle_id, header)
                 raise ConnectionError(header.get("message", "server error"))
             assert msg_type == META_RESP, msg_type
             metas = [BufferDesc.from_json(d) for d in header["buffers"]]

@@ -23,12 +23,13 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 # message types
-META_REQ = 1       # {shuffle_id, reduce_ids[]}
+META_REQ = 1       # {shuffle_id, reduce_ids[], fingerprint?}
 META_RESP = 2      # {buffers: [BufferDesc...]}
 XFER_REQ = 3       # {buffer_ids[]}
 XFER_CHUNK = 4     # {buffer_id, seq, n_chunks, offset, crc32} + payload
 XFER_DONE = 5      # {buffer_ids[]}
-ERROR = 6          # {message}
+ERROR = 6          # {message, code?}  code in {"desync", "released"}
+RELEASE = 7        # {shuffle_id, worker_id} — reduce-side done-reading ack
 
 _HDR = struct.Struct("<IBI")
 

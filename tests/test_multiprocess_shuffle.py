@@ -38,9 +38,13 @@ from spark_rapids_tpu.api.session import TpuSession
 from spark_rapids_tpu.api import functions as F
 from spark_rapids_tpu.api.functions import col
 
-s = TpuSession.builder.config(
-    {{"spark.rapids.tpu.sql.explain": "NONE",
-      "spark.rapids.tpu.sql.shuffle.partitions": "4"}}).getOrCreate()
+conf = {{"spark.rapids.tpu.sql.explain": "NONE",
+         "spark.rapids.tpu.sql.shuffle.partitions": "4"}}
+if query == "join_agg":
+    # keep the co-partitioned path exercised: without this, the tiny dim
+    # table flips the runtime AQE switch and the shuffled join never runs
+    conf["spark.rapids.tpu.sql.autoBroadcastJoinThreshold"] = "-1"
+s = TpuSession.builder.config(conf).getOrCreate()
 
 # each worker holds its own data SHARD (disjoint by construction)
 base = wid * 1000
@@ -54,7 +58,7 @@ s.createDataFrame({{"k": rk, "w": [k * 10.0 for k in rk]}}) \\
 if query == "agg":
     out = s.sql("SELECT k, sum(v) AS sv, count(*) AS c FROM t GROUP BY k") \\
         .collect()
-elif query == "join_agg":
+elif query in ("join_agg", "join_agg_aqe"):
     out = (s.table("t")
            .join(s.table("dim"), on="k", how="inner")
            .groupBy("k")
@@ -62,7 +66,15 @@ elif query == "join_agg":
            .collect())
 else:
     raise SystemExit(f"unknown query {{query}}")
-print(json.dumps({{"rows": [list(r) for r in out]}}), flush=True)
+
+rtb = 0
+def _walk(n):
+    global rtb
+    rtb += int(n.metrics.resolve().get("runtimeBroadcastJoins", 0))
+    for c in n.children:
+        _walk(c)
+_walk(s.last_plan())
+print(json.dumps({{"rows": [list(r) for r in out], "rtb": rtb}}), flush=True)
 ctx.shutdown()
 """
 
@@ -88,16 +100,18 @@ def _run_cluster(query: str, n_workers: int = 2):
         for p in procs:
             p.stdin.write(peers + "\n")
             p.stdin.flush()
-        rows = []
+        rows, rtb = [], 0
         for p in procs:
             out, err = p.communicate(timeout=300)
             for line in out.splitlines():
                 try:
-                    rows.extend(tuple(r) for r in json.loads(line)["rows"])
+                    d = json.loads(line)
+                    rows.extend(tuple(r) for r in d["rows"])
+                    rtb += d.get("rtb", 0)
                 except (json.JSONDecodeError, KeyError):
                     continue
             assert p.returncode == 0, err
-        return rows
+        return rows, rtb
     finally:
         for p in procs:
             if p.poll() is None:
@@ -119,7 +133,7 @@ def test_two_process_planner_driven_aggregate():
     """Two-phase agg: partial -> hash exchange (over TCP between two OS
     processes) -> final; union of both workers' owned partitions equals
     the pandas oracle over the union of shards."""
-    rows = _run_cluster("agg")
+    rows, _ = _run_cluster("agg")
     got = sorted(rows)
     oracle = _shards().groupby("k").agg(sv=("v", "sum"), c=("v", "count"))
     exp = sorted((int(k), float(r["sv"]), int(r["c"]))
@@ -127,12 +141,7 @@ def test_two_process_planner_driven_aggregate():
     assert got == exp
 
 
-def test_two_process_shuffled_join_plus_aggregate():
-    """Co-partitioned shuffled join (both sides exchanged across the two
-    processes; broadcast is disabled because each worker only holds a
-    shard of the build side) followed by a grouped aggregate."""
-    rows = _run_cluster("join_agg")
-    got = sorted(rows)
+def _join_agg_oracle():
     sh = _shards()
     dim = pd.DataFrame({"k": list(range(7)),
                         "w": [k * 10.0 for k in range(7)]})
@@ -142,8 +151,28 @@ def test_two_process_shuffled_join_plus_aggregate():
     # not a shard): the join therefore sees it twice across the cluster —
     # matching real deployments where dims are broadcast-registered
     # per-worker; the oracle doubles it accordingly
-    exp = sorted((int(k), 2 * float(v)) for k, v in oracle.items())
-    assert got == exp
+    return sorted((int(k), 2 * float(v)) for k, v in oracle.items())
+
+
+def test_two_process_shuffled_join_plus_aggregate():
+    """Co-partitioned shuffled join (both sides exchanged across the two
+    processes; static broadcast is disabled because each worker only holds
+    a shard of the build side, and the runtime switch is off via
+    threshold=-1) followed by a grouped aggregate."""
+    rows, rtb = _run_cluster("join_agg")
+    assert rtb == 0                       # stayed co-partitioned
+    assert sorted(rows) == _join_agg_oracle()
+
+
+def test_two_process_mesh_consistent_runtime_broadcast():
+    """AQE runtime join switch ACROSS WORKERS: the build-side exchange's
+    observed size is summed through the control-plane allreduce, every
+    worker takes the same branch, and a switch materializes the COMPLETE
+    build side (all peers' slices) before broadcast-joining the raw local
+    stream shard — same rows as the co-partitioned plan."""
+    rows, rtb = _run_cluster("join_agg_aqe")
+    assert rtb == 2                       # both workers switched
+    assert sorted(rows) == _join_agg_oracle()
 
 
 def test_fetch_when_complete_waits_for_late_map():

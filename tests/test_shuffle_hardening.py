@@ -1,0 +1,189 @@
+"""Multi-worker shuffle contract hardening (VERDICT round-4 item 3):
+the lockstep shuffle-id contract must fail LOUDLY, never silently pair
+mismatched shuffles or return partial rows.
+
+- fingerprint handshake: a worker whose query stream diverged gets
+  ShuffleDesyncError on its first metadata round trip (the reference
+  cannot hit this class — the driver issues shuffle ids; standalone,
+  the structural-fingerprint check replaces the driver).
+- worker loss: a dead peer surfaces ShuffleWorkerLostError naming the
+  peer (RapidsShuffleIterator FetchFailed contract, loud-abort form —
+  a lost worker's local shard has no other lineage to recompute from).
+- release quorum: shuffle outputs free once EVERY worker acked done-
+  reading (ShuffleBufferCatalog active-shuffle lifecycle; previously a
+  no-op that accumulated outputs until shutdown).
+- control-plane allreduce: the primitive behind mesh-consistent AQE
+  decisions (every worker computes the same global build size).
+"""
+
+import threading
+
+import pytest
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.shuffle.manager import DistributedShuffle, WorkerContext
+from spark_rapids_tpu.shuffle.transport import (ShuffleDesyncError,
+                                                ShuffleFetchError,
+                                                ShuffleWorkerLostError)
+
+
+def _pair(fetch_timeout_s: float = 5.0):
+    """Two in-process worker contexts wired as peers (not installed as
+    WorkerContext.current: the planner must stay in local mode)."""
+    a = WorkerContext(0, 2, fetch_timeout_s=fetch_timeout_s)
+    b = WorkerContext(1, 2, fetch_timeout_s=fetch_timeout_s)
+    a.set_peers({1: ("127.0.0.1", b.port)})
+    b.set_peers({0: ("127.0.0.1", a.port)})
+    return a, b
+
+
+def _host_batch(vals):
+    return ColumnarBatch.from_pydict({"a": list(vals)}).fetch_to_host()
+
+
+def _wait_until(cond, timeout_s=5.0):
+    """Release acks are fire-and-forget and land on server threads:
+    poll briefly instead of asserting a racy instant."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.02)
+    return True
+
+
+def test_fingerprint_desync_fails_loudly():
+    """Peer registered shuffle 5 under a different plan fingerprint: the
+    fetch aborts immediately with ShuffleDesyncError (no retry, no poll
+    — waiting cannot un-diverge query streams)."""
+    a, b = _pair()
+    try:
+        b.store.set_fingerprint(5, "fp-worker-b")
+        b.store.register_batch(5, 0, _host_batch([1, 2, 3]))
+        b.store.mark_complete(5)
+        with pytest.raises(ShuffleDesyncError, match="diverged"):
+            a.fetch_from_peer(1, 5, [0], fingerprint="fp-worker-a")
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_matching_fingerprint_fetch_succeeds():
+    a, b = _pair()
+    try:
+        b.store.set_fingerprint(5, "fp-same")
+        b.store.register_batch(5, 0, _host_batch([1, 2, 3]))
+        b.store.mark_complete(5)
+        got = a.fetch_from_peer(1, 5, [0], fingerprint="fp-same")
+        assert len(got) == 1 and sorted(got[0].rows()) == [(1,), (2,), (3,)]
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_dead_worker_fails_loudly_naming_peer():
+    """A peer whose server died surfaces ShuffleWorkerLostError carrying
+    the peer's id — the query aborts instead of returning partial rows."""
+    a, b = _pair(fetch_timeout_s=1.0)
+    b.server.stop()
+    try:
+        with pytest.raises(ShuffleWorkerLostError) as ei:
+            a.fetch_from_peer(1, 3, [0])
+        assert ei.value.worker_id == 1
+        assert "worker 1" in str(ei.value)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_release_quorum_frees_outputs_everywhere():
+    """close_pending releases: nothing frees until ALL workers acked
+    done-reading; once the quorum completes, every store drops the
+    shuffle's buffers (no accumulation until shutdown)."""
+    a, b = _pair()
+    try:
+        sha = DistributedShuffle(4, a, fingerprint="fp-q")
+        shb = DistributedShuffle(4, b, fingerprint="fp-q")
+        assert sha.shuffle_id == shb.shuffle_id        # lockstep
+        a.store.register_batch(sha.shuffle_id, 0, _host_batch([1]))
+        b.store.register_batch(shb.shuffle_id, 1, _host_batch([2]))
+        sha.finish_writes()
+        shb.finish_writes()
+        # worker A reads its owned partition (local + peer), then acks
+        got = list(sha.read(1, _host_batch([0]).schema))
+        assert got and sorted(got[0].rows()) == [(2,)]
+        sha.close_pending()
+        # half-quorum: B's outputs must still be fetchable by... no one
+        # new, but they must not be freed yet (A acked, B did not)
+        assert b.store.buffer_count() == 1
+        assert not b.store.is_released(shb.shuffle_id)
+        shb.close_pending()
+        assert _wait_until(lambda: a.store.buffer_count() == 0)
+        assert _wait_until(lambda: b.store.buffer_count() == 0)
+        assert a.store.is_released(sha.shuffle_id)
+        # a fetch after the quorum released is LOUD, not empty/wrong
+        with pytest.raises(ShuffleFetchError, match="released"):
+            a.fetch_from_peer(1, shb.shuffle_id, [0], fingerprint="fp-q")
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_allreduce_bytes_sums_on_every_worker():
+    """The control-plane allreduce: both workers compute the SAME global
+    total (the primitive behind mesh-consistent AQE branch decisions),
+    and the control values release themselves after use."""
+    a, b = _pair()
+    try:
+        out = {}
+
+        def run(ctx, v):
+            out[ctx.worker_id] = ctx.allreduce_bytes(99, v)
+        ta = threading.Thread(target=run, args=(a, 1000))
+        tb = threading.Thread(target=run, args=(b, 234))
+        ta.start()
+        tb.start()
+        ta.join(20)
+        tb.join(20)
+        assert out == {0: 1234, 1: 1234}
+        assert _wait_until(lambda: a.store.buffer_count() == 0)
+        assert _wait_until(lambda: b.store.buffer_count() == 0)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_plan_fingerprint_structural():
+    """Same logical query -> same exchange fingerprint on every worker;
+    structurally different exchanges -> different fingerprints (the
+    desync signature)."""
+    from spark_rapids_tpu.api.session import TpuSession
+
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE",
+         "spark.rapids.tpu.sql.shuffle.partitions": "4"}).getOrCreate()
+    s.createDataFrame({"k": [1, 2, 3, 1], "v": [1.0, 2.0, 3.0, 4.0]}) \
+        .createOrReplaceTempView("hard_t")
+
+    def exchange_fps(df):
+        from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+        df.collect()
+        fps = []
+
+        def walk(n):
+            if isinstance(n, TpuShuffleExchangeExec):
+                fps.append(n.plan_fingerprint())
+            for c in n.children:
+                walk(c)
+        walk(s.last_plan())
+        return fps
+
+    from spark_rapids_tpu.api.functions import col
+    t = s.table("hard_t")
+    q1 = t.repartition(4, col("k"))
+    q2 = t.select(col("k")).repartition(3, col("k"))
+    fps1, fps1b, fps2 = (exchange_fps(q1), exchange_fps(q1),
+                         exchange_fps(q2))
+    assert fps1 and fps1 == fps1b            # deterministic across runs
+    assert set(fps1).isdisjoint(fps2)        # structure changes the hash
