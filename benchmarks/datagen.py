@@ -247,29 +247,41 @@ _D_DATE_BASE = 2450815        # d_date_sk epoch used by date_dim
 
 
 def gen_date_dim() -> Dict[str, np.ndarray]:
-    """5 years of days: d_date_sk plus month_seq/year/moy for the q97
-    window and the q3/q42/q52 star joins."""
+    """5 years of days: d_date_sk plus month_seq/year/moy/dow/dom/qoy for
+    the q97 window, the q3/q42/q52 star joins, and the day-of-week /
+    quarter pivots (q43/q79-family)."""
     n = 365 * 5
     days = np.arange(n)
-    sk = np.arange(_D_DATE_BASE, _D_DATE_BASE + n, dtype=np.int64)
+    moy = ((days % 365) // 31 + 1).astype(np.int64)
     return {
-        "d_date_sk": sk,
+        "d_date_sk": np.arange(_D_DATE_BASE, _D_DATE_BASE + n,
+                               dtype=np.int64),
         "d_month_seq": (1176 + (days // 30)).astype(np.int64),
         "d_year": (1998 + days // 365).astype(np.int64),
-        "d_moy": ((days % 365) // 31 + 1).astype(np.int64),
+        "d_moy": moy,
+        "d_dow": (days % 7).astype(np.int64),
+        "d_dom": ((days % 31) + 1).astype(np.int64),
+        "d_qoy": ((moy - 1) // 3 + 1).astype(np.int64),
     }
 
 
 _DS_CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
                   "Shoes", "Sports", "Women"]
+_DS_CLASSES = ["accent", "bath", "bedding", "blinds", "curtains",
+               "decor", "fiction", "pop", "rock", "classical"]
+_DS_COLORS = ["azure", "beige", "coral", "cyan", "gold", "ivory",
+              "linen", "navy", "plum", "teal"]
 
 
 def gen_item() -> Dict[str, np.ndarray]:
     n = DS_ITEM_PER_SF
     rng = np.random.default_rng(53)
     brand_id = rng.integers(1, 1000, n).astype(np.int64)
+    class_id = (np.arange(n) % len(_DS_CLASSES) + 1).astype(np.int64)
+    manufact_id = rng.integers(1, 100, n).astype(np.int64)
     return {
         "i_item_sk": np.arange(1, n + 1, dtype=np.int64),
+        "i_item_id": np.array([f"AAAAAAAA{i:08d}" for i in range(1, n + 1)]),
         "i_brand_id": brand_id,
         # 1:1 with the id (the TPC-DS schema relationship q3/q52's
         # two-column grouping relies on)
@@ -278,21 +290,158 @@ def gen_item() -> Dict[str, np.ndarray]:
                           ).astype(np.int64),
         "i_category": np.array(_DS_CATEGORIES)[
             np.arange(n) % len(_DS_CATEGORIES)],
-        "i_manufact_id": rng.integers(1, 100, n).astype(np.int64),
+        "i_class_id": class_id,
+        "i_class": np.array(_DS_CLASSES)[class_id - 1],
+        "i_manufact_id": manufact_id,
+        "i_manufact": np.char.add("manufact#", manufact_id.astype(str)),
+        "i_manager_id": rng.integers(1, 100, n).astype(np.int64),
+        "i_current_price": np.round(rng.uniform(0.5, 300, n), 2),
+        "i_color": np.array(_DS_COLORS)[rng.integers(0, len(_DS_COLORS), n)],
+    }
+
+
+# fixed-cardinality demographic/address dims (TPC-DS keeps these
+# scale-independent; TpcdsLikeSpark.scala table defs)
+DS_ADDR_COUNT = 25_000
+DS_HDEMO_COUNT = 7_200
+DS_CDEMO_COUNT = 19_208
+DS_PROMO_COUNT = 300
+_DS_STATES = ["AL", "CA", "CO", "FL", "GA", "IL", "IN", "KS", "KY", "LA",
+              "MI", "MN", "MO", "NC", "NY", "OH", "OK", "OR", "TN", "TX"]
+_DS_CITIES = ["Antioch", "Bethel", "Centerville", "Fairview", "Five Points",
+              "Georgetown", "Greenville", "Liberty", "Midway", "Mount Zion",
+              "Oak Grove", "Oakland", "Pleasant Hill", "Riverside", "Salem",
+              "Shiloh", "Springfield", "Union", "Walnut Grove", "Woodville"]
+_DS_COUNTIES = [c + " County" for c in
+                ["Adams", "Clark", "Franklin", "Jackson", "Jefferson",
+                 "Lincoln", "Madison", "Monroe", "Union", "Washington"]]
+_DS_BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", "5001-10000",
+                     ">10000", "Unknown"]
+_DS_EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+                 "4 yr Degree", "Advanced Degree", "Unknown"]
+_DS_MARITAL = ["S", "M", "D", "W", "U"]
+
+
+def gen_customer_address() -> Dict[str, np.ndarray]:
+    n = DS_ADDR_COUNT
+    rng = np.random.default_rng(54)
+    return {
+        "ca_address_sk": np.arange(1, n + 1, dtype=np.int64),
+        "ca_city": np.array(_DS_CITIES)[rng.integers(0, len(_DS_CITIES), n)],
+        "ca_county": np.array(_DS_COUNTIES)[
+            rng.integers(0, len(_DS_COUNTIES), n)],
+        "ca_state": np.array(_DS_STATES)[rng.integers(0, len(_DS_STATES), n)],
+        "ca_zip": np.char.zfill(
+            rng.integers(10000, 99999, n).astype(str), 5),
+        "ca_country": np.full(n, "United States"),
+        "ca_gmt_offset": rng.integers(-8, -4, n).astype(np.int64),
+    }
+
+
+def gen_household_demographics() -> Dict[str, np.ndarray]:
+    n = DS_HDEMO_COUNT
+    rng = np.random.default_rng(55)
+    return {
+        "hd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
+        "hd_dep_count": rng.integers(0, 10, n).astype(np.int64),
+        "hd_vehicle_count": rng.integers(-1, 5, n).astype(np.int64),
+        "hd_buy_potential": np.array(_DS_BUY_POTENTIAL)[
+            rng.integers(0, len(_DS_BUY_POTENTIAL), n)],
+    }
+
+
+def gen_customer_demographics() -> Dict[str, np.ndarray]:
+    n = DS_CDEMO_COUNT
+    rng = np.random.default_rng(56)
+    return {
+        "cd_demo_sk": np.arange(1, n + 1, dtype=np.int64),
+        "cd_gender": np.array(["M", "F"])[rng.integers(0, 2, n)],
+        "cd_marital_status": np.array(_DS_MARITAL)[
+            rng.integers(0, len(_DS_MARITAL), n)],
+        "cd_education_status": np.array(_DS_EDUCATION)[
+            rng.integers(0, len(_DS_EDUCATION), n)],
+    }
+
+
+def gen_ds_customer() -> Dict[str, np.ndarray]:
+    n = DS_CUSTOMER_PER_SF
+    rng = np.random.default_rng(57)
+    first = ["James", "Mary", "John", "Linda", "Robert", "Susan",
+             "Michael", "Karen", "David", "Nancy"]
+    last = ["Smith", "Jones", "Brown", "Davis", "Miller", "Wilson",
+            "Moore", "Taylor", "White", "Clark"]
+    return {
+        "c_customer_sk": np.arange(1, n + 1, dtype=np.int64),
+        "c_customer_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(1, n + 1)]),
+        "c_current_addr_sk": rng.integers(1, DS_ADDR_COUNT + 1, n
+                                          ).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(1, DS_HDEMO_COUNT + 1, n
+                                           ).astype(np.int64),
+        "c_first_name": np.array(first)[rng.integers(0, len(first), n)],
+        "c_last_name": np.array(last)[rng.integers(0, len(last), n)],
+        "c_birth_year": rng.integers(1930, 1999, n).astype(np.int64),
+        "c_preferred_cust_flag": np.array(["Y", "N"])[
+            rng.integers(0, 2, n)],
+    }
+
+
+def gen_promotion() -> Dict[str, np.ndarray]:
+    n = DS_PROMO_COUNT
+    rng = np.random.default_rng(58)
+    return {
+        "p_promo_sk": np.arange(1, n + 1, dtype=np.int64),
+        "p_channel_email": np.array(["Y", "N"])[rng.integers(0, 2, n)],
+        "p_channel_event": np.array(["Y", "N"])[rng.integers(0, 2, n)],
+    }
+
+
+def gen_time_dim() -> Dict[str, np.ndarray]:
+    """One row per minute of the day (the t_hour/t_minute bands the
+    q88/q96-family counts slice on)."""
+    n = 24 * 60
+    mins = np.arange(n)
+    return {
+        "t_time_sk": mins.astype(np.int64),
+        "t_hour": (mins // 60).astype(np.int64),
+        "t_minute": (mins % 60).astype(np.int64),
     }
 
 
 def _sales_channel(n: int, rng, key_prefix: str, n_units: int,
                    date_span: int) -> Dict[str, np.ndarray]:
+    list_price = np.round(rng.uniform(1, 300, n), 2)
     return {
         f"{key_prefix}_sold_date_sk": (
             _D_DATE_BASE + rng.integers(0, date_span, n)).astype(np.int64),
+        f"{key_prefix}_sold_time_sk": rng.integers(0, 24 * 60, n
+                                                   ).astype(np.int64),
         f"{key_prefix}_customer_sk": rng.integers(
             1, DS_CUSTOMER_PER_SF + 1, n).astype(np.int64),
+        f"{key_prefix}_cdemo_sk": rng.integers(
+            1, DS_CDEMO_COUNT + 1, n).astype(np.int64),
+        f"{key_prefix}_hdemo_sk": rng.integers(
+            1, DS_HDEMO_COUNT + 1, n).astype(np.int64),
+        f"{key_prefix}_addr_sk": rng.integers(
+            1, DS_ADDR_COUNT + 1, n).astype(np.int64),
         f"{key_prefix}_item_sk": rng.integers(
             1, DS_ITEM_PER_SF + 1, n).astype(np.int64),
+        f"{key_prefix}_promo_sk": rng.integers(
+            1, DS_PROMO_COUNT + 1, n).astype(np.int64),
         f"{key_prefix}_unit_sk": rng.integers(1, n_units + 1, n
                                               ).astype(np.int64),
+        # ~4 line items share one ticket/order (the q68/q73/q79 per-basket
+        # group key and the xBB co-purchase self-join key)
+        f"{key_prefix}_order_number": rng.integers(1, max(n // 4, 2), n
+                                                   ).astype(np.int64),
+        f"{key_prefix}_quantity": rng.integers(1, 101, n).astype(np.int64),
+        f"{key_prefix}_list_price": list_price,
+        f"{key_prefix}_sales_price": np.round(
+            list_price * rng.uniform(0.2, 1.0, n), 2),
+        f"{key_prefix}_coupon_amt": np.round(
+            np.where(rng.uniform(0, 1, n) < 0.2,
+                     rng.uniform(0, 50, n), 0.0), 2),
+        f"{key_prefix}_wholesale_cost": np.round(rng.uniform(1, 100, n), 2),
         f"{key_prefix}_ext_sales_price": np.round(
             rng.uniform(1, 300, n), 2),
         f"{key_prefix}_net_profit": np.round(rng.uniform(-50, 120, n), 2),
@@ -304,8 +453,14 @@ def _returns_channel(n: int, rng, key_prefix: str, n_units: int,
     return {
         f"{key_prefix}_returned_date_sk": (
             _D_DATE_BASE + rng.integers(0, date_span, n)).astype(np.int64),
+        f"{key_prefix}_customer_sk": rng.integers(
+            1, DS_CUSTOMER_PER_SF + 1, n).astype(np.int64),
+        f"{key_prefix}_item_sk": rng.integers(
+            1, DS_ITEM_PER_SF + 1, n).astype(np.int64),
         f"{key_prefix}_unit_sk": rng.integers(1, n_units + 1, n
                                               ).astype(np.int64),
+        f"{key_prefix}_return_quantity": rng.integers(1, 20, n
+                                                      ).astype(np.int64),
         f"{key_prefix}_return_amt": np.round(rng.uniform(1, 200, n), 2),
         f"{key_prefix}_net_loss": np.round(rng.uniform(0, 80, n), 2),
     }
@@ -330,10 +485,25 @@ def register_tpcds_tables(session, sf: float, date_span: int = 365 * 5):
             n_ws // RETURN_FRACTION, rng, "wr", N_WEB_SITES, date_span),
         "date_dim": gen_date_dim(),
         "item": gen_item(),
+        "customer": gen_ds_customer(),
+        "customer_address": gen_customer_address(),
+        "household_demographics": gen_household_demographics(),
+        "customer_demographics": gen_customer_demographics(),
+        "promotion": gen_promotion(),
+        "time_dim": gen_time_dim(),
         "store": {
             "s_store_sk": np.arange(1, N_STORES + 1, dtype=np.int64),
             "s_store_id": np.array(
                 [f"AAAAAAAA{i:04d}" for i in range(1, N_STORES + 1)]),
+            "s_city": np.array(_DS_CITIES)[
+                np.arange(N_STORES) % len(_DS_CITIES)],
+            "s_county": np.array(_DS_COUNTIES)[
+                np.arange(N_STORES) % len(_DS_COUNTIES)],
+            "s_state": np.array(_DS_STATES)[
+                np.arange(N_STORES) % len(_DS_STATES)],
+            "s_number_employees": (200 + 25 * np.arange(N_STORES)
+                                   ).astype(np.int64),
+            "s_gmt_offset": np.full(N_STORES, -5, dtype=np.int64),
         },
         "catalog_page": {
             "cp_catalog_page_sk": np.arange(1, N_CATALOG_PAGES + 1,

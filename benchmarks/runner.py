@@ -60,6 +60,14 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             "hot_s": min(timings[1:]) if len(timings) > 1 else timings[0],
             "timings_s": timings,
         }
+        try:
+            m = session.last_query_metrics()
+            entry["planTimeS"] = m.get("planTimeS")
+            entry["executeTimeS"] = m.get("executeTimeS")
+            entry["sync"] = m.get("sync")
+            entry["spans"] = m.get("spans")
+        except Exception:
+            pass
         if verify:
             entry["verified"] = _verify(session, qfn(tables))
         report["queries"][name] = entry

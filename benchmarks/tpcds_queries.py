@@ -158,6 +158,439 @@ def tpcds_q52(t):
             .limit(100))
 
 
+def tpcds_q7(t):
+    """Demographic-filtered item averages (TpcdsLikeSpark Query7:
+    ss x customer_demographics x date x item x promotion)."""
+    cd = t["customer_demographics"].filter(
+        (col("cd_gender") == lit("M")) &
+        (col("cd_marital_status") == lit("S")) &
+        (col("cd_education_status") == lit("College")))
+    d = t["date_dim"].filter(col("d_year") == lit(2000))
+    p = t["promotion"].filter((col("p_channel_email") == lit("N")) |
+                              (col("p_channel_event") == lit("N")))
+    return (t["store_sales"]
+            .join(cd, on=(col("ss_cdemo_sk") == col("cd_demo_sk")))
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk")))
+            .join(p, on=(col("ss_promo_sk") == col("p_promo_sk")))
+            .groupBy("i_item_id")
+            .agg(F.avg("ss_quantity").alias("agg1"),
+                 F.avg("ss_list_price").alias("agg2"),
+                 F.avg("ss_coupon_amt").alias("agg3"),
+                 F.avg("ss_sales_price").alias("agg4"))
+            .orderBy(col("i_item_id").asc())
+            .limit(100))
+
+
+def _channel_revenue_ratio(sales, t, pfx):
+    """q12/q20/q98 shared shape: category-filtered item revenue over a
+    30-day window with a per-class revenue-ratio WINDOW function."""
+    from spark_rapids_tpu.api.window import Window
+    d = t["date_dim"].filter(
+        (col("d_date_sk") >= lit(_D0 + 45)) &
+        (col("d_date_sk") <= lit(_D0 + 75)))
+    i = t["item"].filter(col("i_category").isin("Books", "Home", "Sports"))
+    per_item = (sales
+                .join(d, on=(col(f"{pfx}_sold_date_sk") == col("d_date_sk")))
+                .join(i, on=(col(f"{pfx}_item_sk") == col("i_item_sk")))
+                .groupBy("i_item_id", "i_category", "i_class",
+                         "i_current_price")
+                .agg(F.sum(f"{pfx}_ext_sales_price").alias("itemrevenue")))
+    w = Window.partitionBy("i_class")
+    return (per_item
+            .select(col("i_item_id"), col("i_category"), col("i_class"),
+                    col("i_current_price"), col("itemrevenue"),
+                    (col("itemrevenue") * 100 /
+                     F.sum("itemrevenue").over(w)).alias("revenueratio"))
+            .orderBy(col("i_category").asc(), col("i_class").asc(),
+                     col("i_item_id").asc(), col("i_current_price").asc(),
+                     col("revenueratio").asc())
+            .limit(100))
+
+
+def tpcds_q12(t):
+    """Web revenue ratio by class (TpcdsLikeSpark Query12)."""
+    return _channel_revenue_ratio(t["web_sales"], t, "ws")
+
+
+def tpcds_q20(t):
+    """Catalog revenue ratio by class (TpcdsLikeSpark Query20)."""
+    return _channel_revenue_ratio(t["catalog_sales"], t, "cs")
+
+
+def tpcds_q98(t):
+    """Store revenue ratio by class (TpcdsLikeSpark Query98)."""
+    return _channel_revenue_ratio(t["store_sales"], t, "ss")
+
+
+def tpcds_q15(t):
+    """Catalog sales by zip with OR'd geography/price predicates
+    (TpcdsLikeSpark Query15)."""
+    d = t["date_dim"].filter((col("d_qoy") == lit(1)) &
+                             (col("d_year") == lit(2000)))
+    return (t["catalog_sales"]
+            .join(t["customer"],
+                  on=(col("cs_customer_sk") == col("c_customer_sk")))
+            .join(t["customer_address"],
+                  on=(col("c_current_addr_sk") == col("ca_address_sk")))
+            .join(d, on=(col("cs_sold_date_sk") == col("d_date_sk")))
+            .filter(F.substring(col("ca_zip"), 1, 2).isin("80", "85", "86")
+                    | col("ca_state").isin("CA", "GA", "TX")
+                    | (col("cs_sales_price") > lit(250)))
+            .groupBy("ca_zip")
+            .agg(F.sum("cs_sales_price").alias("total"))
+            .orderBy(col("ca_zip").asc())
+            .limit(100))
+
+
+def tpcds_q19(t):
+    """Brand revenue from out-of-state baskets (TpcdsLikeSpark Query19:
+    ss x date x item x customer x customer_address x store with the
+    customer-state != store-state twist)."""
+    d = t["date_dim"].filter((col("d_moy") == lit(11)) &
+                             (col("d_year") == lit(1999)))
+    i = t["item"].filter(col("i_manager_id") == lit(7))
+    return (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(i, on=(col("ss_item_sk") == col("i_item_sk")))
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .join(t["customer_address"],
+                  on=(col("c_current_addr_sk") == col("ca_address_sk")))
+            .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk")))
+            .filter(col("ca_state") != col("s_state"))
+            .groupBy("i_brand_id", "i_brand", "i_manufact_id", "i_manufact")
+            .agg(F.sum("ss_ext_sales_price").alias("ext_price"))
+            .orderBy(col("ext_price").desc(), col("i_brand_id").asc(),
+                     col("i_manufact_id").asc())
+            .limit(100))
+
+
+def tpcds_q26(t):
+    """Catalog analog of q7 (TpcdsLikeSpark Query26)."""
+    cd = t["customer_demographics"].filter(
+        (col("cd_gender") == lit("F")) &
+        (col("cd_marital_status") == lit("W")) &
+        (col("cd_education_status") == lit("Secondary")))
+    d = t["date_dim"].filter(col("d_year") == lit(2000))
+    p = t["promotion"].filter((col("p_channel_email") == lit("N")) |
+                              (col("p_channel_event") == lit("N")))
+    return (t["catalog_sales"]
+            .join(cd, on=(col("cs_cdemo_sk") == col("cd_demo_sk")))
+            .join(d, on=(col("cs_sold_date_sk") == col("d_date_sk")))
+            .join(t["item"], on=(col("cs_item_sk") == col("i_item_sk")))
+            .join(p, on=(col("cs_promo_sk") == col("p_promo_sk")))
+            .groupBy("i_item_id")
+            .agg(F.avg("cs_quantity").alias("agg1"),
+                 F.avg("cs_list_price").alias("agg2"),
+                 F.avg("cs_coupon_amt").alias("agg3"),
+                 F.avg("cs_sales_price").alias("agg4"))
+            .orderBy(col("i_item_id").asc())
+            .limit(100))
+
+
+def tpcds_q33(t):
+    """Manufacturer revenue across all three channels for one month and
+    timezone (TpcdsLikeSpark Query33: per-channel star joins with a
+    manufacturer list drawn from one category, UNION ALL, re-aggregate)."""
+    manuf = (t["item"].filter(col("i_category") == lit("Electronics"))
+             .select(col("i_manufact_id").alias("m_id")).distinct())
+
+    def channel(sales, pfx):
+        d = t["date_dim"].filter((col("d_year") == lit(2000)) &
+                                 (col("d_moy") == lit(1)))
+        ca = t["customer_address"].filter(col("ca_gmt_offset") == lit(-5))
+        return (sales
+                .join(d, on=(col(f"{pfx}_sold_date_sk") == col("d_date_sk")))
+                .join(ca, on=(col(f"{pfx}_addr_sk") == col("ca_address_sk")))
+                .join(t["item"],
+                      on=(col(f"{pfx}_item_sk") == col("i_item_sk")))
+                .join(manuf, on=(col("i_manufact_id") == col("m_id")),
+                      how="left_semi")
+                .groupBy("i_manufact_id")
+                .agg(F.sum(f"{pfx}_ext_sales_price").alias("total_sales")))
+    u = (channel(t["store_sales"], "ss")
+         .union(channel(t["catalog_sales"], "cs"))
+         .union(channel(t["web_sales"], "ws")))
+    return (u.groupBy("i_manufact_id")
+            .agg(F.sum("total_sales").alias("total_sales"))
+            .orderBy(col("total_sales").desc(), col("i_manufact_id").asc())
+            .limit(100))
+
+
+def tpcds_q43(t):
+    """Day-of-week sales pivot per store (TpcdsLikeSpark Query43: CASE
+    sums over d_dow)."""
+    d = t["date_dim"].filter(col("d_year") == lit(2000))
+    j = (t["store_sales"]
+         .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+         .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk"))))
+
+    def dow(n):
+        return F.sum(F.when(col("d_dow") == lit(n),
+                            col("ss_sales_price")).otherwise(lit(0.0)))
+    return (j.groupBy("s_store_id")
+            .agg(dow(0).alias("sun_sales"), dow(1).alias("mon_sales"),
+                 dow(2).alias("tue_sales"), dow(3).alias("wed_sales"),
+                 dow(4).alias("thu_sales"), dow(5).alias("fri_sales"),
+                 dow(6).alias("sat_sales"))
+            .orderBy(col("s_store_id").asc())
+            .limit(100))
+
+
+def tpcds_q45(t):
+    """Web sales by zip/city with an OR'd zip-prefix / item-list predicate
+    (TpcdsLikeSpark Query45)."""
+    d = t["date_dim"].filter((col("d_qoy") == lit(2)) &
+                             (col("d_year") == lit(2000)))
+    return (t["web_sales"]
+            .join(t["customer"],
+                  on=(col("ws_customer_sk") == col("c_customer_sk")))
+            .join(t["customer_address"],
+                  on=(col("c_current_addr_sk") == col("ca_address_sk")))
+            .join(d, on=(col("ws_sold_date_sk") == col("d_date_sk")))
+            .join(t["item"], on=(col("ws_item_sk") == col("i_item_sk")))
+            .filter(F.substring(col("ca_zip"), 1, 2)
+                    .isin("85", "86", "88") |
+                    col("i_item_sk").isin(2, 3, 5, 7, 11, 13, 17, 19, 23,
+                                          29))
+            .groupBy("ca_zip", "ca_city")
+            .agg(F.sum("ws_sales_price").alias("total"))
+            .orderBy(col("ca_zip").asc(), col("ca_city").asc())
+            .limit(100))
+
+
+def tpcds_q48(t):
+    """Quantity sum under OR'd demographic/price and state/profit bands
+    (TpcdsLikeSpark Query48)."""
+    d = t["date_dim"].filter(col("d_year") == lit(2000))
+    demo_band = (
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("4 yr Degree")) &
+         (col("ss_sales_price") >= lit(100)) &
+         (col("ss_sales_price") <= lit(150))) |
+        ((col("cd_marital_status") == lit("D")) &
+         (col("cd_education_status") == lit("2 yr Degree")) &
+         (col("ss_sales_price") >= lit(50)) &
+         (col("ss_sales_price") <= lit(100))) |
+        ((col("cd_marital_status") == lit("S")) &
+         (col("cd_education_status") == lit("College")) &
+         (col("ss_sales_price") >= lit(150)) &
+         (col("ss_sales_price") <= lit(200))))
+    geo_band = (
+        (col("ca_state").isin("CO", "OH", "TX") &
+         (col("ss_net_profit") >= lit(0)) &
+         (col("ss_net_profit") <= lit(2000))) |
+        (col("ca_state").isin("OR", "MN", "KY") &
+         (col("ss_net_profit") >= lit(150)) &
+         (col("ss_net_profit") <= lit(3000))) |
+        (col("ca_state").isin("VA", "CA", "MS") &
+         (col("ss_net_profit") >= lit(50)) &
+         (col("ss_net_profit") <= lit(25000))))
+    return (t["store_sales"]
+            .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk")))
+            .join(t["customer_demographics"],
+                  on=(col("ss_cdemo_sk") == col("cd_demo_sk")))
+            .join(t["customer_address"],
+                  on=(col("ss_addr_sk") == col("ca_address_sk")))
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .filter(demo_band & geo_band)
+            .agg(F.sum("ss_quantity").alias("total_quantity")))
+
+
+def tpcds_q55(t):
+    """Manager's brand revenue for one month (TpcdsLikeSpark Query55)."""
+    d = t["date_dim"].filter((col("d_moy") == lit(11)) &
+                             (col("d_year") == lit(1999)))
+    i = t["item"].filter(col("i_manager_id") == lit(28))
+    return (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(i, on=(col("ss_item_sk") == col("i_item_sk")))
+            .groupBy("i_brand_id", "i_brand")
+            .agg(F.sum("ss_ext_sales_price").alias("ext_price"))
+            .orderBy(col("ext_price").desc(), col("i_brand_id").asc())
+            .limit(100))
+
+
+def tpcds_q61(t):
+    """Promotional-to-total revenue ratio for one month/category/timezone
+    (TpcdsLikeSpark Query61: two scalar aggregates cross-joined)."""
+    d = t["date_dim"].filter((col("d_year") == lit(1998)) &
+                             (col("d_moy") == lit(11)))
+    i = t["item"].filter(col("i_category") == lit("Jewelry"))
+    ca = t["customer_address"].filter(col("ca_gmt_offset") == lit(-5))
+    base = (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(i, on=(col("ss_item_sk") == col("i_item_sk")))
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .join(ca, on=(col("c_current_addr_sk") == col("ca_address_sk"))))
+    promo = t["promotion"].filter((col("p_channel_email") == lit("Y")) |
+                                  (col("p_channel_event") == lit("Y")))
+    promotions = (base
+                  .join(promo, on=(col("ss_promo_sk") == col("p_promo_sk")))
+                  .agg(F.sum("ss_ext_sales_price").alias("promotions")))
+    total = base.agg(F.sum("ss_ext_sales_price").alias("total"))
+    return (promotions.crossJoin(total)
+            .select(col("promotions"), col("total"),
+                    (col("promotions") / col("total") * 100)
+                    .alias("promo_pct")))
+
+
+def tpcds_q65(t):
+    """Underperforming store/item pairs: per-pair revenue at most 10% of
+    the store's average (TpcdsLikeSpark Query65: two aggregation levels
+    joined)."""
+    sa = (t["store_sales"]
+          .groupBy("ss_unit_sk", "ss_item_sk")
+          .agg(F.sum("ss_sales_price").alias("revenue")))
+    sb = (sa.groupBy("ss_unit_sk")
+          .agg(F.avg("revenue").alias("ave"))
+          .withColumnRenamed("ss_unit_sk", "sb_unit_sk"))
+    return (sa.join(sb, on=(col("ss_unit_sk") == col("sb_unit_sk")))
+            .filter(col("revenue") <= col("ave") * 0.1)
+            .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk")))
+            .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk")))
+            .select(col("s_store_id"), col("i_item_id"), col("revenue"),
+                    col("ave"))
+            .orderBy(col("s_store_id").asc(), col("i_item_id").asc())
+            .limit(100))
+
+
+def tpcds_q68(t):
+    """Per-basket extended totals where the purchase city differs from the
+    customer's current city (TpcdsLikeSpark Query68: two
+    customer_address roles in one query)."""
+    d = t["date_dim"].filter((col("d_dom") >= lit(1)) &
+                             (col("d_dom") <= lit(2)) &
+                             col("d_year").isin(1998, 1999, 2000))
+    s = t["store"].filter(col("s_city").isin("Fairview", "Midway"))
+    hd = t["household_demographics"].filter(
+        (col("hd_dep_count") == lit(4)) | (col("hd_vehicle_count") == lit(3)))
+    bought = t["customer_address"].select(
+        col("ca_address_sk").alias("b_addr_sk"),
+        col("ca_city").alias("bought_city"))
+    current = t["customer_address"].select(
+        col("ca_address_sk").alias("cur_addr_sk"),
+        col("ca_city").alias("current_city"))
+    baskets = (t["store_sales"]
+               .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+               .join(s, on=(col("ss_unit_sk") == col("s_store_sk")))
+               .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+               .join(bought, on=(col("ss_addr_sk") == col("b_addr_sk")))
+               .groupBy("ss_order_number", "ss_customer_sk", "bought_city")
+               .agg(F.sum("ss_coupon_amt").alias("amt"),
+                    F.sum("ss_net_profit").alias("profit")))
+    return (baskets
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .join(current,
+                  on=(col("c_current_addr_sk") == col("cur_addr_sk")))
+            .filter(col("current_city") != col("bought_city"))
+            .select(col("c_last_name"), col("c_first_name"),
+                    col("current_city"), col("bought_city"),
+                    col("ss_order_number"), col("amt"), col("profit"))
+            .orderBy(col("c_last_name").asc(), col("ss_order_number").asc(),
+                     col("c_first_name").asc(), col("current_city").asc(),
+                     col("bought_city").asc(), col("amt").asc())
+            .limit(100))
+
+
+def tpcds_q73(t):
+    """Customers with 1-5 item baskets under household filters
+    (TpcdsLikeSpark Query73: per-basket count HAVING band)."""
+    d = t["date_dim"].filter((col("d_dom") >= lit(1)) &
+                             (col("d_dom") <= lit(2)) &
+                             col("d_year").isin(1998, 1999, 2000))
+    hd = t["household_demographics"].filter(
+        col("hd_buy_potential").isin(">10000", "Unknown") &
+        (col("hd_vehicle_count") > lit(0)))
+    baskets = (t["store_sales"]
+               .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+               .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+               .groupBy("ss_order_number", "ss_customer_sk")
+               .agg(F.count("*").alias("cnt"))
+               .filter((col("cnt") >= lit(1)) & (col("cnt") <= lit(5))))
+    return (baskets
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .select(col("c_last_name"), col("c_first_name"),
+                    col("c_preferred_cust_flag"), col("ss_order_number"),
+                    col("cnt"))
+            .orderBy(col("cnt").desc(), col("c_last_name").asc(),
+                     col("ss_order_number").asc(), col("c_first_name").asc(),
+                     col("c_preferred_cust_flag").asc())
+            .limit(100))
+
+
+def tpcds_q79(t):
+    """Monday-shopper basket profits at mid-size stores (TpcdsLikeSpark
+    Query79)."""
+    d = t["date_dim"].filter((col("d_dow") == lit(1)) &
+                             col("d_year").isin(1998, 1999, 2000))
+    s = t["store"].filter((col("s_number_employees") >= lit(200)) &
+                          (col("s_number_employees") <= lit(295)))
+    hd = t["household_demographics"].filter(
+        (col("hd_dep_count") == lit(6)) | (col("hd_vehicle_count") > lit(2)))
+    baskets = (t["store_sales"]
+               .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+               .join(s, on=(col("ss_unit_sk") == col("s_store_sk")))
+               .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+               .groupBy("ss_order_number", "ss_customer_sk", "s_city")
+               .agg(F.sum("ss_coupon_amt").alias("amt"),
+                    F.sum("ss_net_profit").alias("profit")))
+    return (baskets
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .select(col("c_last_name"), col("c_first_name"), col("s_city"),
+                    col("ss_order_number"), col("amt"), col("profit"))
+            .orderBy(col("c_last_name").asc(), col("c_first_name").asc(),
+                     col("ss_order_number").asc(), col("s_city").asc(),
+                     col("amt").asc())
+            .limit(100))
+
+
+def tpcds_q88(t):
+    """Store-traffic counts in four time bands cross-joined into one row
+    (TpcdsLikeSpark Query88's scalar-count matrix, 4 of the 8 bands)."""
+    hd = t["household_demographics"].filter(
+        ((col("hd_dep_count") == lit(4)) &
+         (col("hd_vehicle_count") <= lit(3))) |
+        ((col("hd_dep_count") == lit(2)) &
+         (col("hd_vehicle_count") <= lit(1))))
+    base = (t["store_sales"]
+            .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+            .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk"))))
+
+    def band(h1, name):
+        td = t["time_dim"].filter((col("t_hour") == lit(h1)))
+        return (base.join(td, on=(col("ss_sold_time_sk") == col("t_time_sk")))
+                .agg(F.count("*").alias(name)))
+    return (band(8, "h8").crossJoin(band(9, "h9"))
+            .crossJoin(band(10, "h10")).crossJoin(band(11, "h11")))
+
+
+def tpcds_q96(t):
+    """Single-band store-traffic count (TpcdsLikeSpark Query96)."""
+    hd = t["household_demographics"].filter(col("hd_dep_count") == lit(3))
+    td = t["time_dim"].filter((col("t_hour") == lit(20)) &
+                              (col("t_minute") >= lit(30)))
+    return (t["store_sales"]
+            .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+            .join(td, on=(col("ss_sold_time_sk") == col("t_time_sk")))
+            .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk")))
+            .agg(F.count("*").alias("cnt")))
+
+
 TPCDS_QUERIES = {"tpcds_q3": tpcds_q3, "tpcds_q5": tpcds_q5,
-                 "tpcds_q42": tpcds_q42, "tpcds_q52": tpcds_q52,
-                 "tpcds_q97": tpcds_q97}
+                 "tpcds_q7": tpcds_q7, "tpcds_q12": tpcds_q12,
+                 "tpcds_q15": tpcds_q15, "tpcds_q19": tpcds_q19,
+                 "tpcds_q20": tpcds_q20, "tpcds_q26": tpcds_q26,
+                 "tpcds_q33": tpcds_q33, "tpcds_q42": tpcds_q42,
+                 "tpcds_q43": tpcds_q43, "tpcds_q45": tpcds_q45,
+                 "tpcds_q48": tpcds_q48, "tpcds_q52": tpcds_q52,
+                 "tpcds_q55": tpcds_q55, "tpcds_q61": tpcds_q61,
+                 "tpcds_q65": tpcds_q65, "tpcds_q68": tpcds_q68,
+                 "tpcds_q73": tpcds_q73, "tpcds_q79": tpcds_q79,
+                 "tpcds_q88": tpcds_q88, "tpcds_q96": tpcds_q96,
+                 "tpcds_q97": tpcds_q97, "tpcds_q98": tpcds_q98}

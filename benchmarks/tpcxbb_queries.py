@@ -70,5 +70,185 @@ def tpcxbb_q30(t):
             .limit(100))
 
 
-TPCXBB_QUERIES = {"tpcxbb_q06": tpcxbb_q06, "tpcxbb_q09": tpcxbb_q09,
+def tpcxbb_q01(t):
+    """Items co-purchased in the same store basket (TpcxbbLikeSpark
+    Q01Like's affinity shape: fact self-join on the basket key, pair
+    counts)."""
+    a = (t["store_sales"]
+         .select(col("ss_order_number").alias("o1"),
+                 col("ss_item_sk").alias("item_a")))
+    b = (t["store_sales"]
+         .select(col("ss_order_number").alias("o2"),
+                 col("ss_item_sk").alias("item_b")))
+    return (a.join(b, on=(col("o1") == col("o2")))
+            .filter(col("item_a") < col("item_b"))
+            .groupBy("item_a", "item_b")
+            .agg(F.count("*").alias("cnt"))
+            .filter(col("cnt") >= lit(2))
+            .orderBy(col("cnt").desc(), col("item_a").asc(),
+                     col("item_b").asc())
+            .limit(100))
+
+
+def tpcxbb_q07(t):
+    """States with many buyers of premium-priced items: price > 1.2x the
+    category average (Q07Like: per-category avg subquery joined back)."""
+    cat_avg = (t["item"]
+               .groupBy("i_category")
+               .agg(F.avg("i_current_price").alias("cat_avg"))
+               .withColumnRenamed("i_category", "avg_cat"))
+    premium = (t["item"]
+               .join(cat_avg, on=(col("i_category") == col("avg_cat")))
+               .filter(col("i_current_price") > col("cat_avg") * 1.2)
+               .select(col("i_item_sk").alias("prem_item")))
+    return (t["store_sales"]
+            .join(premium, on=(col("ss_item_sk") == col("prem_item")),
+                  how="left_semi")
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .join(t["customer_address"],
+                  on=(col("c_current_addr_sk") == col("ca_address_sk")))
+            .groupBy("ca_state")
+            .agg(F.countDistinct(col("c_customer_sk")).alias("cnt"))
+            .filter(col("cnt") >= lit(10))
+            .orderBy(col("cnt").desc(), col("ca_state").asc())
+            .limit(10))
+
+
+def tpcxbb_q13(t):
+    """Year-over-year store-spend growth per customer (Q13Like: two
+    filtered aggregates joined, growth-ratio ordering)."""
+    d = t["date_dim"]
+    y1 = d.filter(col("d_year") == lit(1999))
+    y2 = d.filter(col("d_year") == lit(2000))
+    s1 = (t["store_sales"]
+          .join(y1, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+          .groupBy("ss_customer_sk")
+          .agg(F.sum("ss_net_profit").alias("first_year"))
+          .withColumnRenamed("ss_customer_sk", "c1"))
+    s2 = (t["store_sales"]
+          .join(y2, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+          .groupBy("ss_customer_sk")
+          .agg(F.sum("ss_net_profit").alias("second_year"))
+          .withColumnRenamed("ss_customer_sk", "c2"))
+    return (s1.join(s2, on=(col("c1") == col("c2")))
+            .filter(col("first_year") > lit(0))
+            .select(col("c1").alias("customer_sk"),
+                    (col("second_year") / col("first_year")).alias("ratio"))
+            .orderBy(col("ratio").desc(), col("customer_sk").asc())
+            .limit(100))
+
+
+def tpcxbb_q15(t):
+    """Declining categories: least-squares slope of monthly store revenue
+    per category, negative slopes only (Q15Like's regression shape via
+    sum-of-products aggregates)."""
+    j = (t["store_sales"]
+         .join(t["date_dim"],
+               on=(col("ss_sold_date_sk") == col("d_date_sk")))
+         .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk"))))
+    monthly = (j.groupBy("i_category_id", "d_month_seq")
+               .agg(F.sum("ss_net_profit").alias("y")))
+    x = col("d_month_seq").cast("double")
+    fitted = (monthly.groupBy("i_category_id")
+              .agg(F.count("*").alias("n"), F.sum(x).alias("sx"),
+                   F.sum(col("y")).alias("sy"),
+                   F.sum(x * x).alias("sxx"),
+                   F.sum(x * col("y")).alias("sxy")))
+    slope = ((col("n") * col("sxy") - col("sx") * col("sy")) /
+             (col("n") * col("sxx") - col("sx") * col("sx")))
+    return (fitted
+            .select(col("i_category_id"), slope.alias("slope"))
+            .filter(col("slope") < lit(0.0))
+            .orderBy(col("slope").asc(), col("i_category_id").asc()))
+
+
+def tpcxbb_q16(t):
+    """Web revenue the week before vs after an event date (Q16Like's
+    before/after CASE sums per item)."""
+    pivot = _D0 + 180
+    d = t["date_dim"].filter((col("d_date_sk") >= lit(pivot - 30)) &
+                             (col("d_date_sk") <= lit(pivot + 30)))
+    j = (t["web_sales"]
+         .join(d, on=(col("ws_sold_date_sk") == col("d_date_sk")))
+         .join(t["item"], on=(col("ws_item_sk") == col("i_item_sk"))))
+    before = F.sum(F.when(col("d_date_sk") < lit(pivot),
+                          col("ws_ext_sales_price")).otherwise(lit(0.0)))
+    after = F.sum(F.when(col("d_date_sk") >= lit(pivot),
+                         col("ws_ext_sales_price")).otherwise(lit(0.0)))
+    return (j.groupBy("i_category")
+            .agg(before.alias("before_sales"), after.alias("after_sales"))
+            .orderBy(col("i_category").asc()))
+
+
+def tpcxbb_q20(t):
+    """Customer return-behavior features for clustering input (Q20Like:
+    orders/returns ratios per customer)."""
+    sales = (t["store_sales"]
+             .groupBy("ss_customer_sk")
+             .agg(F.countDistinct(col("ss_order_number")).alias("orders"),
+                  F.sum("ss_quantity").alias("items"),
+                  F.sum("ss_ext_sales_price").alias("spend")))
+    rets = (t["store_returns"]
+            .groupBy("sr_customer_sk")
+            .agg(F.count("*").alias("returns_"),
+                 F.sum("sr_return_quantity").alias("ret_items"),
+                 F.sum("sr_return_amt").alias("ret_amt"))
+            .withColumnRenamed("sr_customer_sk", "r_customer"))
+    return (sales.join(rets, on=(col("ss_customer_sk") == col("r_customer")))
+            .select(col("ss_customer_sk").alias("customer_sk"),
+                    (col("returns_").cast("double") /
+                     col("orders")).alias("return_order_ratio"),
+                    (col("ret_items").cast("double") /
+                     col("items")).alias("return_item_ratio"),
+                    (col("ret_amt") / col("spend")).alias("return_amt_ratio"))
+            .orderBy(col("return_amt_ratio").desc(),
+                     col("customer_sk").asc())
+            .limit(100))
+
+
+def tpcxbb_q24(t):
+    """Cross-channel price sensitivity: per item, web vs store quantity
+    share (Q24Like adapted to the generated channels)."""
+    ws = (t["web_sales"]
+          .groupBy("ws_item_sk")
+          .agg(F.sum("ws_quantity").alias("web_q"))
+          .withColumnRenamed("ws_item_sk", "w_item"))
+    ss = (t["store_sales"]
+          .groupBy("ss_item_sk")
+          .agg(F.sum("ss_quantity").alias("store_q")))
+    return (ss.join(ws, on=(col("ss_item_sk") == col("w_item")))
+            .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk")))
+            .select(col("i_item_id"),
+                    (col("web_q").cast("double") /
+                     (col("web_q") + col("store_q"))).alias("web_share"))
+            .filter(col("web_share") > lit(0.5))
+            .orderBy(col("web_share").desc(), col("i_item_id").asc())
+            .limit(100))
+
+
+def tpcxbb_q29(t):
+    """Category pairs co-purchased in one web order (Q29Like: the q01
+    affinity shape at category grain over web orders)."""
+    w = (t["web_sales"]
+         .join(t["item"], on=(col("ws_item_sk") == col("i_item_sk")))
+         .select(col("ws_order_number").alias("o"),
+                 col("i_category_id").alias("cat"))
+         .distinct())
+    a = w.select(col("o").alias("o1"), col("cat").alias("cat_a"))
+    b = w.select(col("o").alias("o2"), col("cat").alias("cat_b"))
+    return (a.join(b, on=(col("o1") == col("o2")))
+            .filter(col("cat_a") < col("cat_b"))
+            .groupBy("cat_a", "cat_b")
+            .agg(F.count("*").alias("cnt"))
+            .orderBy(col("cnt").desc(), col("cat_a").asc(),
+                     col("cat_b").asc())
+            .limit(100))
+
+
+TPCXBB_QUERIES = {"tpcxbb_q01": tpcxbb_q01, "tpcxbb_q06": tpcxbb_q06,
+                  "tpcxbb_q07": tpcxbb_q07, "tpcxbb_q09": tpcxbb_q09,
+                  "tpcxbb_q13": tpcxbb_q13, "tpcxbb_q15": tpcxbb_q15,
+                  "tpcxbb_q16": tpcxbb_q16, "tpcxbb_q20": tpcxbb_q20,
+                  "tpcxbb_q24": tpcxbb_q24, "tpcxbb_q29": tpcxbb_q29,
                   "tpcxbb_q30": tpcxbb_q30}

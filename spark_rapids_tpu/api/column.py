@@ -112,6 +112,13 @@ class Col:
     def desc_nulls_first(self) -> lp.SortOrder:
         return lp.SortOrder(self.expr, ascending=False, nulls_first=True)
 
+    def over(self, spec) -> "Col":
+        """Evaluate this aggregate/window function over a window spec
+        (pyspark Column.over; DataFrame.select hoists the resulting
+        WindowExpression into a Window node)."""
+        from ..ops.window import WindowExpression
+        return Col(WindowExpression(self.expr, spec._to_spec()))
+
     def when(self, condition, value):
         raise TypeError("use functions.when(cond, value).otherwise(...)")
 

@@ -61,7 +61,37 @@ class DataFrame:
         gen = self._lift_generator(exprs)
         if gen is not None:
             return gen
+        win = self._lift_windows(exprs)
+        if win is not None:
+            return win
         return self._df(lp.Project(self._plan, exprs))
+
+    def _lift_windows(self, exprs) -> Optional["DataFrame"]:
+        """Col.over() window expressions in a select lift into a Window
+        node under the projection (Catalyst's ExtractWindowExpressions
+        rule): each WindowExpression becomes a generated column of an
+        lp.Window, and the projection references it — so windows compose
+        inside arithmetic (e.g. ``col("rev") * 100 / sum("rev").over(w)``)."""
+        from ..ops.window import WindowExpression
+        hoisted: List = []
+
+        def repl(e):
+            if isinstance(e, WindowExpression):
+                name = f"__w{len(hoisted)}"
+                hoisted.append((name, e))
+                return ex.ColumnRef(name)
+            return None
+
+        new_exprs = []
+        for e in exprs:
+            if e.collect(lambda x: isinstance(x, WindowExpression)):
+                new_exprs.append(e.transform_down(repl))
+            else:
+                new_exprs.append(e)
+        if not hoisted:
+            return None
+        w = lp.Window(self._plan, hoisted)
+        return self._df(lp.Project(w, new_exprs))
 
     def _lift_generator(self, exprs) -> Optional["DataFrame"]:
         """explode/posexplode in a select lifts into a Generate node under
@@ -114,6 +144,9 @@ class DataFrame:
         gen = self._lift_generator(exprs)     # explode() works here too
         if gen is not None:
             return gen
+        win = self._lift_windows(exprs)       # Col.over() too
+        if win is not None:
+            return win
         return self._df(lp.Project(self._plan, exprs))
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
@@ -287,13 +320,14 @@ class DataFrame:
 
     def collect_batch(self):
         import time
-        from ..exec.tracing import SyncCounter
+        from ..exec.tracing import SpanRecorder, SyncCounter
         exec_plan = self._execute()
         t0 = time.perf_counter()
-        with SyncCounter() as sc:
+        with SyncCounter() as sc, SpanRecorder() as spans:
             out = exec_plan.execute_collect()
         self.session._last_execute_time_s = time.perf_counter() - t0
         self.session._last_sync_report = sc.report()
+        self.session._last_span_report = spans.report()
         return out
 
     def collect(self) -> List[tuple]:

@@ -202,6 +202,10 @@ class TpuSession:
             # exec/tracing.SyncCounter)
             "sync": getattr(self, "_last_sync_report",
                             {"hostSyncs": 0, "syncSites": {}}),
+            # per-span wall-clock breakdown (self time, nesting excluded):
+            # names where executeTimeS went — concurrent partition tasks
+            # can legitimately sum past the wall clock
+            "spans": getattr(self, "_last_span_report", {}),
             # driver-side planning (analyze + overrides) wall time and the
             # execute_collect wall (device work + transfers + syncs): with
             # the per-operator timers these account for the query's wall

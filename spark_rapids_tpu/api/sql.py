@@ -17,9 +17,23 @@ LIKE, IS [NOT] NULL, CASE WHEN, CAST(x AS type), DATE 'yyyy-mm-dd',
 INTERVAL 'n' DAY/MONTH/YEAR, aggregate and scalar function calls mapped
 onto ``api.functions``, ``*`` and qualified ``t.col`` references
 (resolved by name: the single-session catalog has no per-table scoping).
-Subqueries are supported in FROM only. Everything else raises
-``SqlParseError`` — the caller sees a clear message, never a silently
-wrong plan.
+
+Subqueries (the Catalyst RewritePredicateSubquery /
+RewriteCorrelatedScalarSubquery rules, collapsed into the parser):
+- FROM ( SELECT ... ) derived tables;
+- WITH name AS ( SELECT ... ) prefixes (query-scoped temp views);
+- WHERE [NOT] EXISTS ( SELECT ... correlated ) -> decorrelated into a
+  left-semi/anti join on the correlated conjuncts;
+- expr [NOT] IN ( SELECT ... ) -> semi/anti join (correlated or not);
+- scalar subqueries in comparisons/HAVING: uncorrelated execute once and
+  fold to a literal; correlated (equality correlation only) decorrelate
+  into a grouped aggregate LEFT-joined on the correlation keys.
+Correlation is resolved scope-wise while parsing a subquery's WHERE: a
+name (or ``alias.name`` with an enclosing FROM's alias) that does not
+resolve in the subquery's own FROM but does in an enclosing query's
+becomes an outer reference. Subquery predicates must sit in top-level
+AND conjuncts. Everything else raises ``SqlParseError`` — the caller
+sees a clear message, never a silently wrong plan.
 """
 from __future__ import annotations
 
@@ -102,6 +116,85 @@ _RESERVED_STOP = {
 }
 
 
+class _Scope:
+    """Per-SELECT name scope: FROM tables' columns + aliases. ``in_where``
+    gates outer-reference resolution — only a subquery's WHERE clause may
+    reach enclosing scopes (correlation lives in WHERE; select lists parse
+    BEFORE FROM, so outer fallback there would misresolve)."""
+
+    def __init__(self):
+        self.all_cols: set = set()
+        self.aliases: dict = {}          # alias -> set of columns
+        self.in_where = False
+
+
+class _OuterRef(ex.ColumnRef):
+    """A column reference that resolved in an ENCLOSING query's FROM:
+    the correlation marker the decorrelation rewrite consumes. Reaching
+    eval/planning unconsumed is a bug guard."""
+    is_outer = True
+
+
+class _SubqueryExpr(ex.Expression):
+    """Parse-time subquery predicate/value nodes, consumed by the WHERE
+    rewrite — escaping into a real plan raises."""
+
+    @property
+    def dtype(self):
+        raise SqlParseError(
+            f"{type(self).__name__} must appear in a top-level AND "
+            "conjunct of WHERE (or, for scalar subqueries, inside a "
+            "comparison there or in HAVING)")
+
+    def eval(self, batch):
+        self.dtype
+
+
+class _ExistsSQ(_SubqueryExpr):
+    def __init__(self, info):
+        super().__init__()
+        self.info = info
+
+
+class _InSQ(_SubqueryExpr):
+    def __init__(self, value_expr, info, negated):
+        super().__init__()
+        self.value_expr = value_expr
+        self.info = info
+        self.negated = negated
+
+
+class _ScalarSQ(_SubqueryExpr):
+    def __init__(self, info):
+        super().__init__()
+        self.info = info
+
+
+class _SubqueryInfo:
+    """Parsed-but-unfinished subquery: core df (FROM + pure-inner WHERE,
+    nested subqueries already applied) plus the deferred clauses and the
+    correlated conjuncts pulled out of its WHERE."""
+
+    def __init__(self, parser, df, items, group_exprs, having, distinct,
+                 corr, orders, limit):
+        self.parser = parser
+        self.df = df
+        self.items = items
+        self.group_exprs = group_exprs
+        self.having = having
+        self.distinct = distinct
+        self.corr = corr
+        self.orders = orders
+        self.limit = limit
+
+    def build_full(self):
+        """Finish as a normal derived table (only valid uncorrelated)."""
+        assert not self.corr
+        return self.parser._finish(self.df, self.items, self.group_exprs,
+                                   self.having, self.distinct, self.orders,
+                                   self.limit)
+
+
 class _Parser:
     def __init__(self, toks: List[_Tok], session):
         self.toks = toks
@@ -112,6 +205,8 @@ class _Parser:
         self._qualified_refs: List[str] = []
         self._from_columns: List[set] = []
         self._has_cross = False
+        self._scopes: List[_Scope] = []
+        self._sq_counter = 0
 
     # -- token helpers ------------------------------------------------------
     def peek(self, ahead: int = 0) -> _Tok:
@@ -151,42 +246,315 @@ class _Parser:
                 f"expected {op!r} near {self.peek().text!r}")
 
     # -- statement ----------------------------------------------------------
-    def parse_select(self):
-        """Returns a DataFrame."""
+    def parse_select(self, as_subquery: bool = False):
+        """Returns a DataFrame — or, with ``as_subquery``, a
+        :class:`_SubqueryInfo` whose finishing is deferred so the caller
+        can decorrelate."""
         outer_refs = self._qualified_refs
         outer_cols = self._from_columns
         outer_cross = self._has_cross
         self._qualified_refs, self._from_columns = [], []
         self._has_cross = False
+        scope = _Scope()
+        self._scopes.append(scope)
         self.expect_kw("SELECT")
         distinct = self.take_kw("DISTINCT")
         items = self.parse_select_list()
         self.expect_kw("FROM")
         df = self.parse_from()
+        corr: List[ex.Expression] = []
         if self.take_kw("WHERE"):
-            df = df.filter(Col(self.parse_expr()))
+            scope.in_where = True
+            cond = self.parse_expr()
+            scope.in_where = False
+            df, corr = self._apply_where(df, cond,
+                                         allow_correlated=as_subquery)
         group_exprs = None
         if self.take_kw("GROUP"):
             self.expect_kw("BY")
             group_exprs = self.parse_group_by(items)
         having = self.parse_expr() if self.take_kw("HAVING") else None
-        df = self.build_projection(df, items, group_exprs, having)
-        if distinct:
-            df = df.distinct()
+        orders = None
         if self.take_kw("ORDER"):
             self.expect_kw("BY")
-            df = df.orderBy(*self.parse_order_by(items))
+            orders = self.parse_order_by(items)
+        limit = None
         if self.take_kw("LIMIT"):
             t = self.next()
             if t.kind != "number":
                 raise SqlParseError(f"LIMIT expects a number, got {t.text!r}")
-            df = df.limit(int(t.text))
+            limit = int(t.text)
         # after EVERY clause parsed (GROUP BY / HAVING / ORDER BY refs
         # included), then restore the enclosing query's scope
         self._check_qualified_refs()
         self._qualified_refs, self._from_columns = outer_refs, outer_cols
         self._has_cross = outer_cross
+        self._scopes.pop()
+        if as_subquery:
+            return _SubqueryInfo(self, df, items, group_exprs, having,
+                                 distinct, corr, orders, limit)
+        return self._finish(df, items, group_exprs, having, distinct,
+                            orders, limit)
+
+    def _finish(self, df, items, group_exprs, having, distinct, orders,
+                limit):
+        having = self._fold_scalar_subqueries(having)
+        df = self.build_projection(df, items, group_exprs, having)
+        if distinct:
+            df = df.distinct()
+        if orders:
+            df = df.orderBy(*orders)
+        if limit is not None:
+            df = df.limit(limit)
         return df
+
+    # -- WHERE rewriting (predicate-subquery decorrelation) ------------------
+    def _apply_where(self, df, cond, allow_correlated: bool):
+        """Split the WHERE into top-level AND conjuncts; subquery
+        predicates turn into semi/anti/left joins on ``df``, correlated
+        conjuncts (containing outer refs) are pulled out for the
+        enclosing decorrelation, the rest filter."""
+        plain: List[ex.Expression] = []
+        corr: List[ex.Expression] = []
+        for c in _split_and(cond):
+            if c.collect(lambda x: isinstance(x, _OuterRef)):
+                if not allow_correlated:
+                    raise SqlParseError(
+                        "correlated column reference outside a subquery")
+                if c.collect(lambda x: isinstance(x, _SubqueryExpr)):
+                    raise SqlParseError(
+                        "a correlated conjunct cannot also contain a "
+                        "subquery")
+                corr.append(c)
+                continue
+            df, keep = self._rewrite_conjunct(df, c)
+            if keep is not None:
+                plain.append(keep)
+        if plain:
+            out = plain[0]
+            for p in plain[1:]:
+                out = pr.And(out, p)
+            df = df.filter(Col(out))
+        return df, corr
+
+    def _rewrite_conjunct(self, df, c):
+        """One WHERE conjunct: EXISTS/IN subqueries consume it into a
+        join; scalar subqueries fold into literals (uncorrelated) or a
+        grouped-aggregate LEFT join (correlated); plain conjuncts pass
+        through."""
+        neg = False
+        inner = c
+        if isinstance(inner, pr.Not) and isinstance(inner.children[0],
+                                                    _ExistsSQ):
+            neg, inner = True, inner.children[0]
+        if isinstance(inner, _ExistsSQ):
+            return self._apply_exists(df, inner.info, neg), None
+        if isinstance(inner, _InSQ):
+            return self._apply_in(df, inner), None
+        if c.collect(lambda x: isinstance(x, (_ExistsSQ, _InSQ))):
+            raise SqlParseError(
+                "EXISTS / IN-subquery predicates must stand alone in a "
+                "top-level AND conjunct (not under OR or expressions)")
+        scalars = c.collect(lambda x: isinstance(x, _ScalarSQ))
+        for sq in scalars:
+            df, repl = self._resolve_scalar(df, sq)
+            c = c.transform_down(
+                lambda n, _sq=sq, _r=repl: _r if n is _sq else None)
+        return df, c
+
+    def _prefix(self) -> str:
+        self._sq_counter += 1
+        return f"__sq{self._sq_counter}_"
+
+    def _rename_sub(self, sub_df, prefix):
+        return sub_df.select(*[Col(ex.Alias(ex.ColumnRef(c), prefix + c))
+                               for c in sub_df.columns])
+
+    @staticmethod
+    def _rewrite_corr(e, prefix, inner_cols):
+        """Correlated conjunct -> join condition: outer refs become bare
+        outer columns, inner refs get the subquery's rename prefix."""
+        def fn(n):
+            if isinstance(n, _OuterRef):
+                return ex.ColumnRef(n.col_name)
+            if isinstance(n, ex.ColumnRef) and n.col_name in inner_cols:
+                return ex.ColumnRef(prefix + n.col_name)
+            return None
+        return e.transform_down(fn)
+
+    def _apply_exists(self, df, info, neg):
+        """[NOT] EXISTS -> left-semi/anti join on the correlated
+        conjuncts (RewritePredicateSubquery)."""
+        if not info.corr:
+            raise SqlParseError(
+                "EXISTS requires a correlated subquery in this dialect")
+        if info.orders or info.limit is not None or info.group_exprs:
+            raise SqlParseError(
+                "EXISTS subqueries cannot use GROUP BY/ORDER BY/LIMIT")
+        prefix = self._prefix()
+        inner_cols = set(info.df.columns)
+        renamed = self._rename_sub(info.df, prefix)
+        cond = None
+        for e in info.corr:
+            e = self._rewrite_corr(e, prefix, inner_cols)
+            cond = e if cond is None else pr.And(cond, e)
+        return df.join(renamed, on=Col(cond),
+                       how="left_anti" if neg else "left_semi")
+
+    def _apply_in(self, df, node):
+        """expr [NOT] IN (SELECT ...) -> semi/anti join on the value
+        equality (+ correlated conjuncts)."""
+        info = node.info
+        prefix = self._prefix()
+        if info.corr:
+            if info.group_exprs or info.distinct or info.having or \
+                    info.orders or info.limit is not None:
+                raise SqlParseError(
+                    "correlated IN subqueries cannot use GROUP BY/"
+                    "DISTINCT/HAVING/ORDER BY/LIMIT")
+            if node.negated:
+                # a null-aware anti join against a correlated subquery
+                # needs per-outer-row null accounting — refuse rather
+                # than silently dropping three-valued semantics
+                raise SqlParseError(
+                    "correlated NOT IN subqueries are not supported; "
+                    "rewrite as NOT EXISTS")
+            (sel, _alias), = info.items if len(info.items) == 1 else (
+                (None, None),)
+            if sel is None or sel == "*":
+                raise SqlParseError(
+                    "IN subquery must select exactly one expression")
+            inner_cols = set(info.df.columns)
+            renamed = self._rename_sub(info.df, prefix)
+            cond = pr.EqualTo(node.value_expr,
+                              self._rewrite_corr(sel, prefix, inner_cols))
+            for e in info.corr:
+                cond = pr.And(cond,
+                              self._rewrite_corr(e, prefix, inner_cols))
+            return df.join(renamed, on=Col(cond), how="left_semi")
+        full = info.build_full()
+        if len(full.columns) != 1:
+            raise SqlParseError(
+                "IN subquery must select exactly one column")
+        out = prefix + full.columns[0]
+        renamed = full.select(
+            Col(ex.Alias(ex.ColumnRef(full.columns[0]), out)))
+        cond = pr.EqualTo(node.value_expr, ex.ColumnRef(out))
+        if not node.negated:
+            return df.join(renamed, on=Col(cond), how="left_semi")
+        # NOT IN: SQL three-valued semantics (Spark's null-aware anti
+        # join). A row qualifies iff the subquery is EMPTY, or (its value
+        # is non-null AND the subquery output has no NULLs AND the value
+        # matches none of them). Plain left_anti alone would wrongly keep
+        # rows whenever the subquery contains a NULL.
+        n_total = prefix + "ntotal"
+        n_nonnull = prefix + "nnonnull"
+        stats = full.agg(
+            Col(ex.Alias(lp.AggregateExpression("count_star", None),
+                         n_total)),
+            Col(ex.Alias(lp.AggregateExpression(
+                "count", ex.ColumnRef(full.columns[0])), n_nonnull)))
+        anti = df.join(renamed, on=Col(cond), how="left_anti") \
+                 .crossJoin(stats)
+        keep = pr.Or(
+            pr.EqualTo(ex.ColumnRef(n_total), ex.lit(0)),
+            pr.And(pr.EqualTo(ex.ColumnRef(n_total),
+                              ex.ColumnRef(n_nonnull)),
+                   pr.IsNotNull(node.value_expr)))
+        kept = anti.filter(Col(keep))
+        return kept._df(lp.Project(
+            kept._plan, [ex.ColumnRef(c) for c in df.columns]))
+
+    def _resolve_scalar(self, df, sq):
+        """Scalar subquery -> (df', replacement expr). Uncorrelated:
+        execute once, fold to a literal (Spark runs uncorrelated scalar
+        subqueries exactly once before the main query). Correlated:
+        grouped aggregate over the equality-correlation keys LEFT-joined
+        back (RewriteCorrelatedScalarSubquery) — empty groups yield NULL
+        through the left join, matching SQL's empty-scalar-subquery."""
+        info = sq.info
+        if not info.corr:
+            full = info.build_full()
+            rows = full.collect()
+            if len(rows) > 1 or (rows and len(rows[0]) != 1):
+                raise SqlParseError(
+                    "scalar subquery must produce at most one value")
+            return df, ex.lit(rows[0][0] if rows else None)
+        if info.group_exprs or info.having or info.distinct or \
+                info.orders or info.limit is not None:
+            raise SqlParseError(
+                "correlated scalar subqueries support a bare aggregate "
+                "select only")
+        (sel, _alias), = info.items if len(info.items) == 1 else (
+            (None, None),)
+        if sel is None or sel == "*" or not _has_agg(sel):
+            raise SqlParseError(
+                "correlated scalar subquery must select one aggregate")
+        prefix = self._prefix()
+        inner_keys, outer_exprs = [], []
+        for e in info.corr:
+            if not isinstance(e, pr.EqualTo):
+                raise SqlParseError(
+                    "correlated scalar subqueries support equality "
+                    "correlation only")
+            a, b = e.children
+            a_outer = bool(a.collect(lambda x: isinstance(x, _OuterRef)))
+            b_outer = bool(b.collect(lambda x: isinstance(x, _OuterRef)))
+            if a_outer == b_outer:
+                raise SqlParseError(
+                    "correlation equality must compare an inner "
+                    "expression to an outer one")
+            inner, outer = (b, a) if a_outer else (a, b)
+            inner_keys.append(inner)
+            outer_exprs.append(outer.transform_down(
+                lambda n: ex.ColumnRef(n.col_name)
+                if isinstance(n, _OuterRef) else None))
+        key_cols = [Col(ex.Alias(k, f"{prefix}k{i}"))
+                    for i, k in enumerate(inner_keys)]
+        val = f"{prefix}val"
+        agg_df = info.df.groupBy(*key_cols).agg(Col(ex.Alias(sel, val)))
+        cond = None
+        for i, o in enumerate(outer_exprs):
+            e = pr.EqualTo(o, ex.ColumnRef(f"{prefix}k{i}"))
+            cond = e if cond is None else pr.And(cond, e)
+        joined = df.join(agg_df, on=Col(cond), how="left")
+        keep = [ex.ColumnRef(c) for c in df.columns] + [ex.ColumnRef(val)]
+        repl: ex.Expression = ex.ColumnRef(val)
+        counts = sel.collect(
+            lambda x: isinstance(x, lp.AggregateExpression) and
+            x.op in ("count", "count_star"))
+        if counts:
+            # a COUNT over an empty group is 0, but the grouped rewrite
+            # has no group to join -> NULL through the left join. Spark's
+            # RewriteCorrelatedScalarSubquery substitutes the aggregate's
+            # empty-input default; a bare count folds to coalesce(val, 0),
+            # anything mixing count into a wider expression would need
+            # per-aggregate defaults — refuse loudly instead.
+            if isinstance(sel, lp.AggregateExpression):
+                from ..ops.conditionals import Coalesce
+                repl = Coalesce(ex.ColumnRef(val), ex.lit(0))
+            else:
+                raise SqlParseError(
+                    "correlated scalar subqueries mixing COUNT into a "
+                    "larger expression are not supported (empty-group "
+                    "default would be wrong)")
+        return joined._df(lp.Project(joined._plan, keep)), repl
+
+    def _fold_scalar_subqueries(self, e):
+        """HAVING may hold UNcorrelated scalar subqueries (TPC-H q11):
+        fold them eagerly; correlated ones have no join target here."""
+        if e is None:
+            return None
+        scalars = e.collect(lambda x: isinstance(x, _ScalarSQ))
+        for sq in scalars:
+            if sq.info.corr:
+                raise SqlParseError(
+                    "correlated scalar subqueries are not supported in "
+                    "HAVING")
+            _df, repl = self._resolve_scalar(None, sq)
+            e = e.transform_down(
+                lambda n, _sq=sq, _r=repl: _r if n is _sq else None)
+        return e
 
     def parse_select_list(self):
         items: List[tuple] = []   # (expr | "*", alias | None)
@@ -223,12 +591,18 @@ class _Parser:
                 df = self.session.table(t.text)
             except KeyError:
                 raise SqlParseError(f"unknown table or view: {t.text!r}")
+        alias = None
         if self.take_kw("AS"):
-            self.next()                       # alias name (namespace-free)
+            alias = self.next().text
         elif (self.peek().kind == "ident"
               and self.peek().upper not in _RESERVED_STOP):
-            self.next()
+            alias = self.next().text
         self._from_columns.append(set(df.columns))
+        if self._scopes:
+            scope = self._scopes[-1]
+            scope.all_cols.update(df.columns)
+            if alias:
+                scope.aliases[alias] = set(df.columns)
         return df
 
     def parse_from(self):
@@ -381,6 +755,10 @@ class _Parser:
             return pr.Not(out) if neg else out
         if self.take_kw("IN"):
             self.expect_op("(")
+            if self.at_kw("SELECT"):
+                info = self.parse_select(as_subquery=True)
+                self.expect_op(")")
+                return _InSQ(e, info, neg)
             vals = [self.parse_expr()]
             while self.take_op(","):
                 vals.append(self.parse_expr())
@@ -468,12 +846,22 @@ class _Parser:
             self.next()
             return ex.lit(t.text[1:-1].replace("''", "'"))
         if self.take_op("("):
+            if self.at_kw("SELECT"):
+                info = self.parse_select(as_subquery=True)
+                self.expect_op(")")
+                return _ScalarSQ(info)
             e = self.parse_expr()
             self.expect_op(")")
             return e
         if t.kind != "ident":
             raise SqlParseError(f"unexpected token {t.text!r}")
         up = t.upper
+        if up == "EXISTS" and self.peek(1).text == "(":
+            self.next()
+            self.expect_op("(")
+            info = self.parse_select(as_subquery=True)
+            self.expect_op(")")
+            return _ExistsSQ(info)
         if up == "NULL":
             self.next()
             return ex.lit(None)
@@ -500,12 +888,38 @@ class _Parser:
             return self.parse_call()
         # [qualifier.]column — single-namespace resolution: the qualifier
         # is dropped, which is only sound when the bare name is unambiguous
-        # across the FROM tables (checked after FROM parses)
+        # across the FROM tables (checked after FROM parses). Inside a
+        # subquery's WHERE, names/aliases that resolve only in an
+        # ENCLOSING query's FROM become outer (correlation) references.
         self.next()
+        qualifier = None
         name = t.text
         if self.take_op("."):
+            qualifier = name
             name = self.next().text
             self._qualified_refs.append(name)
+        return self._resolve_ref(qualifier, name)
+
+    def _resolve_ref(self, qualifier, name) -> ex.ColumnRef:
+        scope = self._scopes[-1] if self._scopes else None
+        if scope is None or not scope.in_where or len(self._scopes) < 2:
+            return ex.ColumnRef(name)
+        if qualifier is not None:
+            if qualifier in scope.aliases:
+                return ex.ColumnRef(name)
+            for outer in reversed(self._scopes[:-1]):
+                if qualifier in outer.aliases:
+                    if name not in outer.aliases[qualifier]:
+                        raise SqlParseError(
+                            f"column {name!r} not found in table aliased "
+                            f"{qualifier!r}")
+                    return _OuterRef(name)
+            return ex.ColumnRef(name)
+        if name in scope.all_cols:
+            return ex.ColumnRef(name)
+        for outer in reversed(self._scopes[:-1]):
+            if name in outer.all_cols:
+                return _OuterRef(name)
         return ex.ColumnRef(name)
 
     def _check_qualified_refs(self):
@@ -633,6 +1047,12 @@ def _date_arith(e: ex.Expression, iv: "_Interval", sign: int):
     return _unwrap(F.add_months(Col(e), months))
 
 
+def _split_and(e: ex.Expression) -> List[ex.Expression]:
+    if isinstance(e, pr.And):
+        return _split_and(e.children[0]) + _split_and(e.children[1])
+    return [e]
+
+
 def _has_agg(e) -> bool:
     if isinstance(e, lp.AggregateExpression):
         return True
@@ -677,7 +1097,25 @@ def _extract_having(cond: ex.Expression, select_exprs):
 
 def parse_sql(query: str, session):
     p = _Parser(_lex(query), session)
-    df = p.parse_select()
-    if p.peek().kind != "end":
-        raise SqlParseError(f"trailing input near {p.peek().text!r}")
-    return df
+    saved_views = dict(session._views)
+    try:
+        first = True
+        while p.at_kw("WITH") or (not first and p.take_op(",")):
+            # WITH name AS (SELECT ...) [, name2 AS (SELECT ...)]...
+            # registered as query-scoped temp views (Catalyst CTEs);
+            # the session catalog is restored after the parse
+            if p.at_kw("WITH"):
+                p.next()
+            name = p.next().text
+            p.expect_kw("AS")
+            p.expect_op("(")
+            sub = p.parse_select()
+            p.expect_op(")")
+            sub.createOrReplaceTempView(name)
+            first = False
+        df = p.parse_select()
+        if p.peek().kind != "end":
+            raise SqlParseError(f"trailing input near {p.peek().text!r}")
+        return df
+    finally:
+        session._views = saved_views

@@ -197,17 +197,15 @@ class ColumnarBatch:
     def from_flat_arrays(schema: dt.Schema, arrays: Sequence[jnp.ndarray],
                          num_rows) -> "ColumnarBatch":
         """Inverse of flat_arrays; num_rows may be a traced scalar inside
-        fused stages."""
+        fused stages. Per-column arity is a pure function of the dtype
+        (column_arity), so arrays/structs reconstruct consistently at
+        every site (fused stages, spill, shuffle wire)."""
+        from .column import build_column
         cols: List[Column] = []
         i = 0
         for f in schema:
-            if f.dtype.var_width:
-                cols.append(Column(f.dtype, arrays[i], arrays[i + 1],
-                                   arrays[i + 2]))
-                i += 3
-            else:
-                cols.append(Column(f.dtype, arrays[i], arrays[i + 1]))
-                i += 2
+            c, i = build_column(f.dtype, arrays, i)
+            cols.append(c)
         return ColumnarBatch(schema, cols, num_rows)
 
     # -- host extraction -----------------------------------------------------
@@ -247,26 +245,20 @@ class ColumnarBatch:
             if isinstance(c, ObjectColumn):   # host python payload already
                 obj_cols[ci] = c
                 continue
-            sliced.append(c.data if m == cap else c.data[:m])
-            sliced.append(c.validity if m == cap else c.validity[:m])
-            if c.dtype.var_width:
-                sliced.append(c.lengths if m == cap else c.lengths[:m])
+            for a in c.arrays():              # rows are always axis 0
+                sliced.append(a if m == cap else a[:m])
         host = jax.device_get(sliced)         # one round trip for the batch
         if not obj_cols:
             return ColumnarBatch.from_flat_arrays(self.schema, host, n)
+        from .column import build_column
         cols: List[Column] = []
         i = 0
         for ci, f in enumerate(self.schema):
             if ci in obj_cols:
                 cols.append(obj_cols[ci])
                 continue
-            if f.dtype.var_width:
-                cols.append(Column(f.dtype, host[i], host[i + 1],
-                                   host[i + 2]))
-                i += 3
-            else:
-                cols.append(Column(f.dtype, host[i], host[i + 1]))
-                i += 2
+            c, i = build_column(f.dtype, host, i)
+            cols.append(c)
         return ColumnarBatch(self.schema, cols, n)
 
     def to_pydict(self) -> Dict[str, List[Any]]:

@@ -71,16 +71,25 @@ class Column:
     their data zeroed (zeroed padding keeps kernels free of NaN/garbage hazards).
     """
 
-    __slots__ = ("dtype", "data", "validity", "lengths")
+    __slots__ = ("dtype", "data", "validity", "lengths", "elem_validity")
 
-    def __init__(self, dtype: dt.DType, data, validity, lengths=None):
+    def __init__(self, dtype: dt.DType, data, validity, lengths=None,
+                 elem_validity=None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.lengths = lengths
+        # ARRAY<primitive> element nullability: bool[cap, W] aligned with
+        # the element matrix (True = element valid). MANDATORY for device
+        # arrays so the flat-array protocol's arity is a function of the
+        # dtype (column_arity), never of the instance.
+        self.elem_validity = elem_validity
         if dtype.var_width:
             assert lengths is not None and data.ndim == 2, \
                 "var-width (string/array) column needs lengths + 2D data"
+            if dt.is_array(dtype) and dtype.numpy_dtype is not None:
+                assert elem_validity is not None, \
+                    "device ARRAY column needs an element-validity matrix"
         else:
             assert data.ndim == 1, f"fixed-width column must be 1D, got {data.ndim}D"
 
@@ -101,18 +110,23 @@ class Column:
         total += self.validity.size * 1
         if self.lengths is not None:
             total += self.lengths.size * 4
+        if self.elem_validity is not None:
+            total += self.elem_validity.size * 1
         return int(total)
 
     def arrays(self) -> List[jnp.ndarray]:
         out = [self.data, self.validity]
         if self.lengths is not None:
             out.append(self.lengths)
+        if self.elem_validity is not None:
+            out.append(self.elem_validity)
         return out
 
     def with_arrays(self, data, validity, lengths=None) -> "Column":
         return Column(self.dtype, data, validity,
                       lengths if lengths is not None else
-                      (self.lengths if self.dtype.var_width else None))
+                      (self.lengths if self.dtype.var_width else None),
+                      self.elem_validity)
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -143,8 +157,11 @@ class Column:
                     width: Optional[int] = None) -> "Column":
         n = len(values)
         if dt.is_struct(dtype):
-            # whole-struct values only exist host-side (the device sees
-            # SHREDDED child columns; see dtypes.STRUCT)
+            if all(_device_capable(t) for _, t in dtype.fields):
+                return StructColumn.from_pylist_struct(values, dtype,
+                                                       capacity)
+            # a field type with no device layout (e.g. map<string,_>):
+            # host objects carry the values across the collect boundary
             return ObjectColumn(dtype, values, capacity)
         if (dt.is_map(dtype) or dt.is_array(dtype)) and \
                 dtype.numpy_dtype is None:
@@ -185,25 +202,28 @@ class Column:
             return Column(dtype, jnp.asarray(mat), jnp.asarray(valid_full),
                           jnp.asarray(lens))
         if dt.is_array(dtype):
-            # ARRAY<primitive>: padded element matrix + per-row lengths
-            # (NULL elements inside arrays are out of scope; see ops/arrays)
+            # ARRAY<primitive>: padded element matrix + per-row lengths +
+            # element-validity matrix (NULL elements round-trip)
             max_len = max((len(v) for v in values if v is not None),
                           default=0)
             w = width or bucket(max_len, 4)
             cap = capacity or bucket(n)
             mat = np.zeros((cap, w), dtype=dtype.numpy_dtype)
             lens = np.zeros(cap, dtype=np.int32)
+            evalid = np.zeros((cap, w), dtype=np.bool_)
             for i, v in enumerate(values):
                 if v is None:
                     continue
-                if any(e is None for e in v):
-                    raise ValueError("NULL array elements not supported")
-                mat[i, :len(v)] = np.asarray(v, dtype=dtype.numpy_dtype)
+                ev = np.array([e is not None for e in v], np.bool_)
+                mat[i, :len(v)] = np.asarray(
+                    [e if e is not None else 0 for e in v],
+                    dtype=dtype.numpy_dtype)
+                evalid[i, :len(v)] = ev
                 lens[i] = len(v)
             valid_full = np.zeros(cap, np.bool_)
             valid_full[:n] = valid_np
             return Column(dtype, jnp.asarray(mat), jnp.asarray(valid_full),
-                          jnp.asarray(lens))
+                          jnp.asarray(lens), jnp.asarray(evalid))
         if dtype == dt.STRING:
             encoded = [v.encode("utf-8") if isinstance(v, str)
                        else (v if isinstance(v, bytes) else b"") for v in values]
@@ -316,12 +336,22 @@ class Column:
     @staticmethod
     def full_null(dtype: dt.DType, capacity: int, width: int = MIN_STRING_WIDTH) -> "Column":
         valid = jnp.zeros(capacity, dtype=jnp.bool_)
+        if dt.is_struct(dtype):
+            return StructColumn(
+                dtype, [Column.full_null(t, capacity) for _, t in
+                        dtype.fields], valid)
         if dtype == dt.STRING:
             return Column(dtype, jnp.zeros((capacity, width), dtype=jnp.uint8), valid,
                           jnp.zeros(capacity, dtype=jnp.int32))
-        if dtype.var_width:              # ARRAY<primitive>
+        if dt.is_array(dtype) and dtype.numpy_dtype is not None:
             return Column(dtype,
                           jnp.zeros((capacity, width), dtype=dtype.numpy_dtype),
+                          valid, jnp.zeros(capacity, dtype=jnp.int32),
+                          jnp.zeros((capacity, width), dtype=jnp.bool_))
+        if dtype.var_width:              # MAP bitpattern matrix
+            return Column(dtype,
+                          jnp.zeros((capacity, width),
+                                    dtype=dtype.numpy_dtype),
                           valid, jnp.zeros(capacity, dtype=jnp.int32))
         return Column(dtype, jnp.zeros(capacity, dtype=dtype.numpy_dtype), valid)
 
@@ -381,10 +411,14 @@ class Column:
         if dt.is_array(self.dtype):
             mat = np.asarray(self.data[:num_rows])
             lens = np.asarray(self.lengths[:num_rows])
+            ev = (np.asarray(self.elem_validity[:num_rows])
+                  if self.elem_validity is not None else None)
             elem = self.dtype.element
             conv = (int if elem.is_integral or elem in (dt.DATE, dt.TIMESTAMP)
                     else bool if elem == dt.BOOL else float)
-            return [[conv(x) for x in mat[i, :lens[i]]] if valid[i] else None
+            return [[conv(x) if ev is None or ev[i, j] else None
+                     for j, x in enumerate(mat[i, :lens[i]])]
+                    if valid[i] else None
                     for i in range(num_rows)]
         if self.dtype == dt.STRING:
             mat = np.asarray(self.data[:num_rows])
@@ -447,6 +481,7 @@ class ObjectColumn(Column):
         self.data = np.empty((cap, 0), dtype=np.uint8)
         self.validity = np.array([v is not None for v in vals], np.bool_)
         self.lengths = np.zeros(cap, np.int32)
+        self.elem_validity = None
 
     @property
     def capacity(self) -> int:
@@ -475,3 +510,115 @@ class ObjectColumn(Column):
 
     def __repr__(self):
         return f"ObjectColumn({self.dtype}, cap={self.capacity})"
+
+
+class StructColumn(Column):
+    """Device STRUCT layout: struct-of-columns + a struct-level validity
+    vector (the GpuColumnVector struct form, GpuColumnVector.java:40-535).
+    Scans still SHRED field accesses into flat columns (the fast path);
+    this layout is for WHOLE-struct values flowing through joins, sorts,
+    exchanges, and collects without the host ObjectColumn crawl: the
+    row-reorder kernels (gather/concat) recurse into the children, and
+    the flat-array protocol flattens [validity, *children...] with an
+    arity that is a pure function of the dtype (column_arity)."""
+
+    def __init__(self, dtype: dt.DType, children: List[Column], validity):
+        self.dtype = dtype
+        self.children = children
+        self.validity = validity
+        self.data = None
+        self.lengths = None
+        self.elem_validity = None
+
+    @staticmethod
+    def from_pylist_struct(values: Sequence[Any], dtype: dt.DType,
+                           capacity: Optional[int] = None) -> "StructColumn":
+        n = len(values)
+        cap = capacity or bucket(n)
+        valid = np.zeros(cap, np.bool_)
+        valid[:n] = [v is not None for v in values]
+        children = []
+        for fname, ftype in dtype.fields:
+            fvals = [None if v is None else
+                     (v.get(fname) if isinstance(v, dict)
+                      else getattr(v, fname)) for v in values]
+            children.append(Column.from_pylist(fvals, ftype, capacity=cap))
+        return StructColumn(dtype, children, jnp.asarray(valid))
+
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @property
+    def byte_width(self) -> int:
+        return sum(c.byte_width for c in self.children)
+
+    def device_size_bytes(self) -> int:
+        return int(self.validity.size) + \
+            sum(c.device_size_bytes() for c in self.children)
+
+    def arrays(self) -> List[jnp.ndarray]:
+        out = [self.validity]
+        for c in self.children:
+            out.extend(c.arrays())
+        return out
+
+    def with_arrays(self, data, validity, lengths=None) -> "Column":
+        raise TypeError("use build_column to reconstruct struct columns")
+
+    def to_pylist(self, num_rows: int) -> List[Any]:
+        valid = np.asarray(self.validity[:num_rows])
+        kids = [c.to_pylist(num_rows) for c in self.children]
+        names = [n for n, _ in self.dtype.fields]
+        return [dict(zip(names, vals)) if ok else None
+                for ok, vals in zip(valid, zip(*kids))] if kids else \
+            [None] * num_rows
+
+    def to_arrow(self, num_rows: int):
+        import pyarrow as pa
+        return pa.array(self.to_pylist(num_rows),
+                        type=dt.to_arrow(self.dtype))
+
+    def __repr__(self):
+        return f"StructColumn({self.dtype}, cap={self.capacity})"
+
+
+def _device_capable(t: dt.DType) -> bool:
+    """Types with a device layout (vs host-only ObjectColumn types)."""
+    if dt.is_struct(t):
+        return all(_device_capable(ft) for _, ft in t.fields)
+    if dt.is_array(t) or dt.is_map(t):
+        return t.numpy_dtype is not None
+    return True
+
+
+def column_arity(t: dt.DType) -> int:
+    """Number of flat storage arrays a device column of type ``t``
+    contributes — a pure function of the dtype, shared by every
+    reconstruction site (fused stages, spill, shuffle wire)."""
+    if dt.is_struct(t):
+        return 1 + sum(column_arity(ft) for _, ft in t.fields)
+    if dt.is_array(t) and t.numpy_dtype is not None:
+        return 4                      # data, validity, lengths, elem_valid
+    if t.var_width:
+        return 3                      # data, validity, lengths
+    return 2                          # data, validity
+
+
+def build_column(t: dt.DType, arrays: Sequence[Any], i: int = 0):
+    """(column, next_index): rebuild one column from the flat-array form
+    starting at ``arrays[i]`` (inverse of ``Column.arrays()``)."""
+    if dt.is_struct(t):
+        validity = arrays[i]
+        i += 1
+        children = []
+        for _, ft in t.fields:
+            c, i = build_column(ft, arrays, i)
+            children.append(c)
+        return StructColumn(t, children, validity), i
+    if dt.is_array(t) and t.numpy_dtype is not None:
+        return Column(t, arrays[i], arrays[i + 1], arrays[i + 2],
+                      arrays[i + 3]), i + 4
+    if t.var_width:
+        return Column(t, arrays[i], arrays[i + 1], arrays[i + 2]), i + 3
+    return Column(t, arrays[i], arrays[i + 1]), i + 2

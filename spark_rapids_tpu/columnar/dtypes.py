@@ -166,6 +166,24 @@ def of(name_or_dtype: Any) -> DType:
                 elif ch == "," and depth == 0:
                     return MAP(of(inner[:i].strip()),
                                of(inner[i + 1:].strip()))
+        if t is None and name_or_dtype.startswith("struct<") and \
+                name_or_dtype.endswith(">"):
+            inner = name_or_dtype[7:-1]
+            fields = []
+            depth = 0
+            start = 0
+            for i, ch in enumerate(inner + ","):
+                if ch == "<":
+                    depth += 1
+                elif ch == ">":
+                    depth -= 1
+                elif ch == "," and depth == 0:
+                    part = inner[start:i].strip()
+                    if part:
+                        fname, _, ftype = part.partition(":")
+                        fields.append((fname, of(ftype)))
+                    start = i + 1
+            return STRUCT(fields)
         if t is None:
             raise ValueError(f"unknown SQL type name {name_or_dtype!r}")
         return t

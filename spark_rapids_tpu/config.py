@@ -273,6 +273,15 @@ ADAPTIVE_MIN_PARTITION_BYTES = _conf(
     "coalescePartitions.minPartitionSize)"
 ).bytes_conf.create_with_default(8 * 1024 * 1024)
 
+SKEW_JOIN_THRESHOLD = _conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThreshold").doc(
+    "A shuffled join's stream-side reduce partition larger than this many "
+    "observed bytes splits into mapper-subset tasks, each joined against "
+    "the same (shared) build partition (ref: spark.sql.adaptive.skewJoin."
+    "skewedPartitionThresholdInBytes + partial-mapper partition specs, "
+    "ShuffledBatchRDD.scala:202). 0 disables skew splitting."
+).bytes_conf.create_with_default(256 * 1024 * 1024)
+
 AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "Build sides at or under this many bytes broadcast (materialize once, "

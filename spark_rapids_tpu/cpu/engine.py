@@ -161,10 +161,9 @@ class CpuEvaluator:
             return [None if m is None else list(m.keys()) for m in ms]
         if isinstance(e, mp_ops.MapValues):
             ms = [_as_map(m) for m in self._eval(e.children[0])]
-            # device arrays carry no per-element validity: NULL map values
-            # surface as 0 there; mirror it so golden compares align
-            return [None if m is None else
-                    [0 if v is None else v for v in m.values()] for m in ms]
+            # NULL map values surface as NULL array elements (the device
+            # array layout carries per-element validity)
+            return [None if m is None else list(m.values()) for m in ms]
         if isinstance(e, ex.ColumnRef):
             return self._col_by_name(e.col_name)
         if isinstance(e, ex.BoundReference):

@@ -134,18 +134,16 @@ class SpillableBuffer:
                 return [jnp.asarray(z[k]) for k in z.files]
 
     def get_batch(self, promote: bool = True) -> ColumnarBatch:
+        from ..columnar.column import build_column
         arrays = self._load_arrays()
         cols: List[Column] = []
         i = 0
         for ci, f in enumerate(self.meta.schema):
             if ci in self._obj_cols:
                 cols.append(self._obj_cols[ci])
-            elif f.dtype.var_width:
-                cols.append(Column(f.dtype, arrays[i], arrays[i + 1], arrays[i + 2]))
-                i += 3
             else:
-                cols.append(Column(f.dtype, arrays[i], arrays[i + 1]))
-                i += 2
+                c, i = build_column(f.dtype, arrays, i)
+                cols.append(c)
         return ColumnarBatch(self.meta.schema, cols, self.meta.num_rows)
 
     def promote_to_device(self, arrays: List[Any]) -> None:

@@ -35,21 +35,89 @@ def reset_cache() -> None:
 @contextmanager
 def trace_span(name: str, metrics=None, metric_key: Optional[str] = None):
     """Named profiler span (NvtxWithMetrics: optionally also feeds a
-    metrics timer)."""
-    if not _tracing_on():
+    metrics timer). Always feeds the active :class:`SpanRecorder` (the
+    per-query wall-clock breakdown); the jax profiler annotation is
+    config-gated."""
+    rec = SpanRecorder.active
+    if rec is None and not _tracing_on():
         if metrics is not None and metric_key:
             with metrics.timer(metric_key):
                 yield
         else:
             yield
         return
-    import jax
     import time
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        yield
-    if metrics is not None and metric_key:
-        metrics.inc(metric_key, time.perf_counter() - t0)
+    frame = rec._push(name) if rec is not None else None
+    try:
+        if _tracing_on():
+            import jax
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        else:
+            yield
+    finally:
+        elapsed = time.perf_counter() - t0
+        if rec is not None:
+            rec._pop(frame, name, elapsed)
+        if metrics is not None and metric_key:
+            metrics.inc(metric_key, elapsed)
+
+
+class SpanRecorder:
+    """Per-query wall-clock breakdown: every ``trace_span`` while a
+    recorder is active contributes its SELF time (elapsed minus enclosed
+    child spans) to a name -> seconds map, so the report names where the
+    execute wall went without double counting nesting (the NVTX-range
+    timeline of the reference, reduced to per-name totals). Partitions
+    drain on a thread pool, so stacks are thread-local and concurrent
+    spans can legitimately sum past the wall clock."""
+
+    active: Optional["SpanRecorder"] = None
+
+    def __init__(self):
+        import collections
+        import threading
+        self._self_s = collections.defaultdict(float)
+        self._count = collections.defaultdict(int)
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+
+    def __enter__(self):
+        self._prev = SpanRecorder.active
+        SpanRecorder.active = self
+        return self
+
+    def __exit__(self, *exc):
+        SpanRecorder.active = self._prev
+        return False
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _push(self, name):
+        frame = {"child_s": 0.0}
+        self._stack().append(frame)
+        return frame
+
+    def _pop(self, frame, name, elapsed):
+        st = self._stack()
+        st.pop()
+        if st:
+            st[-1]["child_s"] += elapsed
+        self_s = max(0.0, elapsed - frame["child_s"])
+        with self._mu:
+            self._self_s[name] += self_s
+            self._count[name] += 1
+
+    def report(self) -> dict:
+        with self._mu:
+            return {name: {"selfS": round(s, 4), "count": self._count[name]}
+                    for name, s in sorted(self._self_s.items(),
+                                          key=lambda kv: -kv[1])}
 
 
 def start_profiler_server(port: int = 9012) -> None:

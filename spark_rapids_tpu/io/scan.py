@@ -26,6 +26,7 @@ from ..ops import expressions as ex
 from ..plan import logical as lp
 from ..plan.physical import Partition, TpuExec
 from . import expand_paths, read_file_to_arrow
+from ..exec.tracing import trace_span
 
 
 def _pushdown_filters(exprs: List[ex.Expression]):
@@ -109,7 +110,7 @@ class TpuFileScanExec(TpuExec):
         table = self._read(f)
         if table.num_rows == 0:
             return
-        with self.metrics.timer("tpuDecodeTime"):
+        with trace_span("scan_decode", self.metrics, "tpuDecodeTime"):
             batch = ColumnarBatch.from_arrow(table)
         self.metrics.inc("numOutputRows", batch.num_rows)
         self.metrics.inc("numOutputBatches")
@@ -147,7 +148,7 @@ class TpuFileScanExec(TpuExec):
         import pyarrow as pa
         table = tables[0] if len(tables) == 1 else \
             pa.concat_tables(tables, promote_options="permissive")
-        with self.metrics.timer("tpuDecodeTime"):
+        with trace_span("scan_decode", self.metrics, "tpuDecodeTime"):
             batch = ColumnarBatch.from_arrow(table)
         self.metrics.inc("numOutputRows", batch.num_rows)
         self.metrics.inc("numOutputBatches")

@@ -64,6 +64,10 @@ class GetArrayItem(Expression):
         else:
             ok = arr.validity & ivalid & (i >= 0) & (i < arr.lengths)
         ic = jnp.clip(i, 0, w - 1)
+        if arr.elem_validity is not None:
+            # a present-but-NULL element yields NULL
+            ok = ok & jnp.take_along_axis(arr.elem_validity, ic[:, None],
+                                          axis=1)[:, 0]
         data = jnp.take_along_axis(arr.data, ic[:, None], axis=1)[:, 0]
         data = jnp.where(ok, data, jnp.zeros((), data.dtype))
         return Column(self.dtype, data, ok)
@@ -168,9 +172,16 @@ def explode_array(arr: Column, other_cols: List[Column], live: jnp.ndarray,
     others = [K.gather_column(c, src, out_valid=out_live)
               for c in other_cols]
     w = arr.data.shape[1]
-    data = arr.data[src, jnp.clip(elem, 0, w - 1)]
+    ec = jnp.clip(elem, 0, w - 1)
+    data = arr.data[src, ec]
     data = jnp.where(out_live, data, jnp.zeros((), data.dtype))
-    elem_col = Column(arr.dtype.element, data, out_live)
+    evalid = out_live
+    if arr.elem_validity is not None:
+        # exploded NULL elements become NULL rows (Spark explode keeps
+        # them; only NULL/empty ARRAYS produce no rows)
+        evalid = out_live & arr.elem_validity[src, ec]
+        data = jnp.where(evalid, data, jnp.zeros((), data.dtype))
+    elem_col = Column(arr.dtype.element, data, evalid)
     pos_col = Column(dt.INT32, jnp.where(out_live, elem, 0), out_live)
     return others, elem_col, pos_col, count
 

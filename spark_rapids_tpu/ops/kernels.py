@@ -205,18 +205,27 @@ def gather_column(col: Column, indices: jnp.ndarray,
 
     cuDF analog: ``Table.gather``. Out-of-range indices must not occur (clip upstream).
     """
+    from ..columnar.column import StructColumn
     validity = col.validity[indices]
     if out_valid is not None:
         validity = validity & out_valid
+    if isinstance(col, StructColumn):
+        kids = [gather_column(c, indices, out_valid=out_valid)
+                for c in col.children]
+        return StructColumn(col.dtype, kids, validity)
     if col.dtype.var_width:
         keep = out_valid if out_valid is not None else None
         data = col.data[indices]
         lengths = col.lengths[indices]
+        evalid = (col.elem_validity[indices]
+                  if col.elem_validity is not None else None)
         if keep is not None:
             data = jnp.where(keep[:, None], data,
                              jnp.zeros((), data.dtype))
             lengths = jnp.where(keep, lengths, jnp.int32(0))
-        return Column(col.dtype, data, validity, lengths)
+            if evalid is not None:
+                evalid = evalid & keep[:, None]
+        return Column(col.dtype, data, validity, lengths, evalid)
     data = col.data[indices]
     if out_valid is not None:
         data = jnp.where(out_valid, data, jnp.zeros((), data.dtype))
@@ -269,10 +278,22 @@ def concat_columns(cols: Sequence[Column], counts: Sequence[int],
     cuDF analog: ``Table.concatenate`` (GpuCoalesceBatches.scala:132-702). Host-known
     counts (this runs at batch-coalesce boundaries, not inside fused stages).
     """
+    from ..columnar.column import StructColumn
     dtype = cols[0].dtype
+    if isinstance(cols[0], StructColumn):
+        total = sum(counts)
+        pad = out_capacity - total
+        valids = [c.validity[:n] for c, n in zip(cols, counts)]
+        if pad:
+            valids.append(jnp.zeros(pad, jnp.bool_))
+        kids = [concat_columns([c.children[k] for c in cols], counts,
+                               out_capacity)
+                for k in range(len(cols[0].children))]
+        return StructColumn(dtype, kids, jnp.concatenate(valids))
     if dtype.var_width:
         width = max(int(c.data.shape[1]) for c in cols)
-        datas, valids, lens = [], [], []
+        has_ev = cols[0].elem_validity is not None
+        datas, valids, lens, evs = [], [], [], []
         for c, n in zip(cols, counts):
             d = c.data[:n]
             if d.shape[1] < width:
@@ -280,12 +301,21 @@ def concat_columns(cols: Sequence[Column], counts: Sequence[int],
             datas.append(d)
             valids.append(c.validity[:n])
             lens.append(c.lengths[:n])
+            if has_ev:
+                e = c.elem_validity[:n]
+                if e.shape[1] < width:
+                    e = jnp.pad(e, ((0, 0), (0, width - e.shape[1])))
+                evs.append(e)
         total = sum(counts)
         pad = out_capacity - total
         data = jnp.concatenate(datas + ([jnp.zeros((pad, width), datas[0].dtype)] if pad else []))
         valid = jnp.concatenate(valids + ([jnp.zeros(pad, jnp.bool_)] if pad else []))
         lengths = jnp.concatenate(lens + ([jnp.zeros(pad, jnp.int32)] if pad else []))
-        return Column(dtype, data, valid, lengths)
+        evalid = None
+        if has_ev:
+            evalid = jnp.concatenate(
+                evs + ([jnp.zeros((pad, width), jnp.bool_)] if pad else []))
+        return Column(dtype, data, valid, lengths, evalid)
     datas = [c.data[:n] for c, n in zip(cols, counts)]
     valids = [c.validity[:n] for c, n in zip(cols, counts)]
     total = sum(counts)

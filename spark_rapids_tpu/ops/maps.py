@@ -229,18 +229,16 @@ class MapKeys(Expression):
         m = materialize(self.children[0].eval(batch), batch)
         keys, _vals, _vv, w = _halves(m)
         lane_ok = jnp.arange(w)[None, :] < m.lengths[:, None]
-        data = jnp.where(lane_ok & m.validity[:, None], keys,
-                         jnp.zeros((), keys.dtype))
+        ok = lane_ok & m.validity[:, None]
+        data = jnp.where(ok, keys, jnp.zeros((), keys.dtype))
         return Column(self.dtype, data, m.validity,
-                      jnp.where(m.validity, m.lengths, 0))
+                      jnp.where(m.validity, m.lengths, 0), ok)
 
 
 class MapValues(Expression):
     """map_values(m) -> array<V> (collectionOperations.scala MapValues).
-
-    Limitation: ARRAY<primitive> carries no per-element validity, so NULL
-    map values surface as 0 in the produced array (GetMapValue does honor
-    them); the CPU engine mirrors this so golden compares stay aligned."""
+    NULL map values surface as NULL array elements (the array layout
+    carries per-element validity)."""
 
     fusable = False               # see module docstring: eager-only bitcast
 
@@ -254,9 +252,9 @@ class MapValues(Expression):
 
     def eval(self, batch: ColumnarBatch):
         m = materialize(self.children[0].eval(batch), batch)
-        _keys, vals, _vv, w = _halves(m)
+        _keys, vals, vv, w = _halves(m)
         lane_ok = jnp.arange(w)[None, :] < m.lengths[:, None]
-        data = jnp.where(lane_ok & m.validity[:, None], vals,
-                         jnp.zeros((), vals.dtype))
+        ok = lane_ok & m.validity[:, None] & vv
+        data = jnp.where(ok, vals, jnp.zeros((), vals.dtype))
         return Column(self.dtype, data, m.validity,
-                      jnp.where(m.validity, m.lengths, 0))
+                      jnp.where(m.validity, m.lengths, 0), ok)

@@ -1,24 +1,27 @@
 """STRUCT field access.
 
 Reference: ``complexTypeExtractors.scala`` (GetStructField). TPU-first
-design: struct columns have NO device layout — the planner SHREDS every
-referenced field into a flat child column at the scan
-(overrides._shred_struct_columns), so a GetField that survives to
-execution only ever sees the host-side ObjectColumn rendering (CPU
-fallback plans and whole-struct materializations)."""
+design: the planner SHREDS every referenced field of a SCAN's struct
+column into a flat child column (overrides._shred_struct_columns — the
+fast path); a GetField that survives to execution reads the device
+StructColumn's child directly (struct-of-columns layout,
+columnar.column.StructColumn), or falls back to the host ObjectColumn
+rendering for CPU-engine-only field types."""
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
-from ..columnar.column import Column, ObjectColumn
+from ..columnar.column import Column, ObjectColumn, StructColumn
 from .expressions import Expression, materialize
 
 
 class GetField(Expression):
     """struct.field (GetStructField analog)."""
 
-    fusable = False          # only evaluated on host object columns
+    fusable = False          # eager: struct child extraction + mask
 
     def __init__(self, child: Expression, field: str):
         super().__init__(child)
@@ -40,10 +43,19 @@ class GetField(Expression):
 
     def eval(self, batch: ColumnarBatch):
         col = materialize(self.children[0].eval(batch), batch)
+        if isinstance(col, StructColumn):
+            # device path: the child column masked by the struct validity
+            # (a NULL struct yields NULL fields)
+            idx = [n for n, _ in col.dtype.fields].index(self.field)
+            child = col.children[idx]
+            return child.with_arrays(
+                child.data, child.validity & col.validity) \
+                if not isinstance(child, StructColumn) else StructColumn(
+                    child.dtype, child.children,
+                    child.validity & col.validity)
         if not isinstance(col, ObjectColumn):
             raise RuntimeError(
-                "GetField reached a device struct column — the planner "
-                "should have shredded it (overrides._shred_struct_columns)")
+                "GetField reached a non-struct column — planner bug")
         vals = [None if v is None else v.get(self.field)
                 for v in col.values]
         return Column.from_pylist(vals, self.dtype,

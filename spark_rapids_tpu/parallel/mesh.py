@@ -737,6 +737,98 @@ def run_distributed_groupby_streaming(mesh: Mesh,
     return results
 
 
+def _string_key_words(col: Column, w8: int) -> List[Column]:
+    """Exact fixed-width encoding of a STRING key column: the padded byte
+    matrix packs into ``w8/8`` little-endian int64 word columns plus one
+    length column — so string group keys ride the streaming SPMD path's
+    fixed-width machinery (ids over the wire; no hashing, no collisions).
+    The padding invariant (bytes beyond length are zero) makes the word
+    tuple a faithful key: equal strings <=> equal words + length."""
+    data = col.data
+    if data.shape[1] < w8:
+        data = jnp.pad(data, ((0, 0), (0, w8 - data.shape[1])))
+    out: List[Column] = []
+    for j in range(w8 // 8):
+        w = jnp.zeros(data.shape[0], jnp.int64)
+        for k in range(8):
+            w = w | (data[:, j * 8 + k].astype(jnp.int64) << (8 * k))
+        out.append(Column(dt.INT64, w, col.validity))
+    out.append(Column(dt.INT64, col.lengths.astype(jnp.int64),
+                      col.validity))
+    return out
+
+
+def _string_from_words(word_cols: List[Column], length_col: Column
+                       ) -> Column:
+    """Inverse of :func:`_string_key_words`."""
+    parts = []
+    for wc in word_cols:
+        for k in range(8):
+            parts.append(((wc.data >> (8 * k)) &
+                          jnp.int64(0xFF)).astype(jnp.uint8))
+    data = jnp.stack(parts, axis=1)
+    validity = length_col.validity
+    lens = jnp.where(validity, length_col.data, 0).astype(jnp.int32)
+    data = jnp.where(validity[:, None], data, jnp.uint8(0))
+    return Column(dt.STRING, data, validity, lens)
+
+
+def _run_streaming_string_keys(mesh: Mesh, batches: List[ColumnarBatch],
+                               key_idx: List[int], val_idx: List[int],
+                               agg_ops: List[str], window_rows: int
+                               ) -> List[ColumnarBatch]:
+    """Streaming SPMD group-by with STRING keys: word-encode per shard,
+    stream fixed-width, decode the result keys (round-4 VERDICT item:
+    var-width keys must stay mesh-routed past maxStageBytes)."""
+    key_dtypes = [batches[0].columns[i].dtype for i in key_idx]
+    # one harmonized width per string key across all shards
+    w8s = {}
+    for ki, t in zip(key_idx, key_dtypes):
+        if t == dt.STRING:
+            w = max(int(b.columns[ki].data.shape[1]) for b in batches)
+            w8s[ki] = ((w + 7) // 8) * 8
+    enc_batches = []
+    for b in batches:
+        cols: List[Column] = []
+        for ki in key_idx:
+            c = b.columns[ki]
+            if ki in w8s:
+                cols.extend(_string_key_words(c, w8s[ki]))
+            else:
+                cols.append(c)
+        for vi in val_idx:
+            cols.append(b.columns[vi])
+        fields = [dt.Field(f"e{i}", c.dtype) for i, c in enumerate(cols)]
+        enc_batches.append(ColumnarBatch(dt.Schema(fields), cols,
+                                         b.num_rows))
+    n_enc_keys = len(enc_batches[0].columns) - len(val_idx)
+    enc_key_idx = list(range(n_enc_keys))
+    enc_val_idx = list(range(n_enc_keys, n_enc_keys + len(val_idx)))
+    res = run_distributed_groupby_streaming(
+        mesh, enc_batches, enc_key_idx, enc_val_idx, agg_ops, window_rows)
+    # decode: consume w8/8 + 1 encoded key columns per string key
+    out = []
+    for rb in res:
+        dec_keys: List[Column] = []
+        i = 0
+        for ki, t in zip(key_idx, key_dtypes):
+            if ki in w8s:
+                nw = w8s[ki] // 8
+                dec_keys.append(_string_from_words(
+                    rb.columns[i:i + nw], rb.columns[i + nw]))
+                i += nw + 1
+            else:
+                dec_keys.append(rb.columns[i])
+                i += 1
+        aggs = list(rb.columns[i:])
+        fields = [dt.Field(f"k{j}", c.dtype)
+                  for j, c in enumerate(dec_keys)]
+        fields += [dt.Field(f"a{j}", c.dtype) for j, c in enumerate(aggs)]
+        out.append(ColumnarBatch(dt.Schema(fields), dec_keys + aggs,
+                                 rb.num_rows))
+    return out
+
+
 def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
                             key_idx: List[int], val_idx: List[int],
                             agg_ops: List[str],
@@ -753,6 +845,11 @@ def run_distributed_groupby(mesh: Mesh, batches: List[ColumnarBatch],
         val_dtypes_chk = [batches[0].columns[i].dtype for i in val_idx]
         if all(not t.var_width for t in key_dtypes_chk + val_dtypes_chk):
             return run_distributed_groupby_streaming(
+                mesh, batches, key_idx, val_idx, agg_ops, window_rows)
+        if all(t == dt.STRING or not t.var_width
+               for t in key_dtypes_chk) and \
+                all(not t.var_width for t in val_dtypes_chk):
+            return _run_streaming_string_keys(
                 mesh, batches, key_idx, val_idx, agg_ops, window_rows)
     key_dtypes = [batches[0].columns[i].dtype for i in key_idx]
     val_dtypes = [batches[0].columns[i].dtype for i in val_idx]

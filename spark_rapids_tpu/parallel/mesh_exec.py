@@ -24,6 +24,7 @@ from ..plan import logical as lp
 from ..plan.physical import (Partition, TpuExec, TpuShuffledJoinExec,
                              accumulate_spillable, bind_refs, concat_spillable)
 from . import mesh as M
+from ..exec.tracing import trace_span
 
 # ops the SPMD group-by pipeline merges correctly (first/last are excluded:
 # their distributed result would depend on shard order)
@@ -126,7 +127,7 @@ class TpuMeshGroupByExec(TpuExec):
                        for i, c in enumerate(vals)]
             proj_shards.append(ColumnarBatch(dt.Schema(fields), keys + vals,
                                              shard.num_rows))
-        with self.metrics.timer("meshGroupByTime"):
+        with trace_span("mesh_groupby", self.metrics, "meshGroupByTime"):
             results = M.run_distributed_groupby(
                 self.mesh, proj_shards,
                 key_idx=list(range(nk)),
@@ -183,7 +184,7 @@ class TpuMeshSortExec(TpuExec):
             extb, positions = _append_eval_columns(
                 shard, [o.child for o in self.orders])
             ext_shards.append(extb)
-        with self.metrics.timer("meshSortTime"):
+        with trace_span("mesh_sort", self.metrics, "meshSortTime"):
             results = M.run_distributed_sort(
                 self.mesh, ext_shards, positions,
                 [o.ascending for o in self.orders],
@@ -232,7 +233,7 @@ class TpuMeshJoinExec(TpuShuffledJoinExec):
                 for b in co]
 
     def execute(self) -> List[Partition]:
-        with self.metrics.timer("meshExchangeTime"):
+        with trace_span("mesh_exchange", self.metrics, "meshExchangeTime"):
             l_co = self._copartition(self.children[0], self.part_left_keys)
             r_co = self._copartition(self.children[1], self.part_right_keys)
         return [self._join_copart(iter([lb]), iter([rb]))

@@ -113,6 +113,30 @@ _EXPR_RULES[st.RegExpReplaceHost] = ExprRule(st.RegExpReplaceHost,
 SUPPORTED_TYPES = set(dt.ALL_TYPES) - {dt.NULLTYPE}
 
 
+def _device_type_ok(t: dt.DType) -> bool:
+    """Types with a device column layout: primitives/strings, ARRAY/MAP of
+    primitives, and STRUCT whose fields are all device-capable
+    (StructColumn; the GpuColumnVector type matrix analog)."""
+    if t in SUPPORTED_TYPES:
+        return True
+    if dt.is_struct(t):
+        return all(_device_type_ok(ft) for _, ft in t.fields)
+    if dt.is_array(t):
+        return (t.element in SUPPORTED_TYPES and
+                not t.element.var_width)
+    if dt.is_map(t):
+        return t.numpy_dtype is not None
+    return False
+
+
+def _has_dtype(e) -> bool:
+    try:
+        e.dtype
+        return True
+    except Exception:
+        return False
+
+
 # ---------------------------------------------------------------------------
 # Meta wrappers (RapidsMeta.scala)
 # ---------------------------------------------------------------------------
@@ -162,10 +186,7 @@ class ExprMeta(BaseMeta):
                     f"{type(self.expr).__name__} disabled by {rule.conf_key}")
         try:
             t = self.expr.dtype
-            ok = (t in SUPPORTED_TYPES or t == dt.NULLTYPE or
-                  (dt.is_array(t) and t.element in SUPPORTED_TYPES and
-                   not t.element.var_width) or
-                  (dt.is_map(t) and t.numpy_dtype is not None) or
+            ok = (_device_type_ok(t) or t == dt.NULLTYPE or
                   (t == dt.ARRAY_STRING and
                    isinstance(self.expr, _AR.StringSplit)))
             if not ok:
@@ -252,17 +273,35 @@ class PlanMeta(BaseMeta):
                 for r in em.collect_reasons():
                     self.will_not_work(r)
         self._tag_self()
-        # output schema types (ARRAY/MAP of primitives allowed)
+        # output schema types (ARRAY/MAP of primitives allowed; STRUCT of
+        # device-capable fields rides the StructColumn layout)
         for f in self.plan.schema.fields:
-            ok = (f.dtype in SUPPORTED_TYPES or
-                  (dt.is_array(f.dtype) and
-                   f.dtype.element in SUPPORTED_TYPES and
-                   not f.dtype.element.var_width) or
-                  (dt.is_map(f.dtype) and
-                   f.dtype.numpy_dtype is not None))
-            if not ok:
+            if not _device_type_ok(f.dtype):
                 self.will_not_work(
                     f"unsupported column type {f.dtype} for {f.name}")
+        # structs move through row-reorder paths (scan/join/sort payload,
+        # exchange, project) but have no comparison/hash kernels: any use
+        # as a sort/group/partition/join KEY stays on the CPU engine
+        p = self.plan
+        key_exprs = []
+        if isinstance(p, lp.Sort):
+            key_exprs = [o.child for o in p.orders]
+        elif isinstance(p, lp.Aggregate):
+            key_exprs = list(p.grouping)
+        elif isinstance(p, lp.Repartition):
+            key_exprs = list(getattr(p, "by", None) or [])
+        elif isinstance(p, lp.Join) and p.condition is not None:
+            key_exprs = [p.condition]
+        for e in key_exprs:
+            try:
+                if e.collect(lambda x: dt.is_struct(x.dtype)
+                             if _has_dtype(x) else False):
+                    self.will_not_work(
+                        "struct-typed keys (sort/group/partition/join) "
+                        "are not supported on the device")
+                    break
+            except Exception:
+                pass
 
     def _tag_self(self) -> None:
         p = self.plan
@@ -632,9 +671,12 @@ class Overrides:
                 except ValueError:
                     return None
         if window_rows is not None:
-            # streaming requires fixed-width keys and agg inputs
+            # streaming requires fixed-width agg inputs; STRING group keys
+            # ride the fixed-width path through exact int64 word encoding
+            # (parallel/mesh._encode_string_keys), other var-width keys
+            # fall back to the host exchange
             for g in grouping:
-                if g.dtype.var_width:
+                if g.dtype.var_width and g.dtype != dt.STRING:
                     return None
             for e in outputs:
                 inner = e.children[0] if isinstance(e, ex.Alias) else e
@@ -864,6 +906,13 @@ class Overrides:
             # worker takes the same branch and a switch materializes the
             # complete build side from all peers' slices
             j.aqe_broadcast_threshold = threshold
+        if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and not multiworker:
+            # AQE skew split: hot stream partitions spread across
+            # mapper-subset tasks (local mode; partition->worker ownership
+            # must stay fixed multi-worker)
+            skew = int(self.conf.get(cfg.SKEW_JOIN_THRESHOLD))
+            if skew > 0:
+                j.aqe_skew_threshold = skew
         return j
 
 

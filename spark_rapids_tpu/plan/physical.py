@@ -30,6 +30,7 @@ from ..ops import expressions as ex
 from ..ops import kernels as K
 from ..ops import aggregates as agg_k
 from ..ops import joins as join_k
+from ..exec.tracing import trace_span
 from . import logical as lp
 
 Partition = Iterator[ColumnarBatch]
@@ -211,8 +212,9 @@ class TpuExec:
         try:
             per_part = run_partition_tasks(
                 self.execute(), lambda pid, part: drain_spillable(part))
-            return concat_spillable(
-                self.schema, [s for lst in per_part for s in lst])
+            with trace_span("collect_concat"):
+                return concat_spillable(
+                    self.schema, [s for lst in per_part for s in lst])
         finally:
             self.cleanup()
 
@@ -377,7 +379,8 @@ def drain_spillable(part, acquire: bool = False
             out.append(spillable(chunk[0]))
             chunk.clear()
             return
-        resolve_counts(chunk)          # one round-trip per chunk
+        with trace_span("drain_resolve"):
+            resolve_counts(chunk)      # one round-trip per chunk
         out.extend(spillable(b) for b in chunk if b.num_rows > 0)
         chunk.clear()
 
@@ -451,36 +454,32 @@ def concat_batches(schema: dt.Schema, batches: List[ColumnarBatch],
 
 def _concat_fused(schema: dt.Schema, batches: List[ColumnarBatch],
                   out_cap: int) -> ColumnarBatch:
+    """Generic over the FLAT-ARRAY protocol (Column.arrays /
+    build_column): every storage array is either rows[cap] or a row
+    matrix [cap, W]; concat row-stacks each position independently and
+    zeroes the output padding — so strings, arrays (+ element validity),
+    maps, and struct-of-columns all concat through one fused program."""
     import jax
     import jax.numpy as jnp
 
     nb = len(batches)
     caps = tuple(b.capacity for b in batches)
     max_cap = max(caps)
-    # static padded width per var-width column (inputs may differ)
+    flats_per_batch = [b.flat_arrays() for b in batches]
+    n_arr = len(flats_per_batch[0])
+    two_d = tuple(flats_per_batch[0][ai].ndim == 2 for ai in range(n_arr))
+    # static padded width per array position (inputs may differ)
     widths = tuple(
-        max(int(b.columns[ci].data.shape[1]) for b in batches)
-        if schema[ci].dtype.var_width else 0
-        for ci in range(len(schema)))
+        max(int(fb[ai].shape[1]) for fb in flats_per_batch)
+        if two_d[ai] else 0 for ai in range(n_arr))
     sig = ("concat", _schema_sig(schema), caps, widths, out_cap)
 
     def build():
         def fn(*args):
             counts = args[:nb]
             flats = args[nb:]
-            # rebuild per-batch column arrays
-            per_batch = []
-            i = 0
-            for _bi in range(nb):
-                cols = []
-                for f in schema:
-                    if f.dtype.var_width:
-                        cols.append((flats[i], flats[i + 1], flats[i + 2]))
-                        i += 3
-                    else:
-                        cols.append((flats[i], flats[i + 1], None))
-                        i += 2
-                per_batch.append(cols)
+            per_batch = [flats[bi * n_arr:(bi + 1) * n_arr]
+                         for bi in range(nb)]
             offs = []
             total = jnp.int32(0)
             for bi in range(nb):
@@ -488,56 +487,36 @@ def _concat_fused(schema: dt.Schema, batches: List[ColumnarBatch],
                 total = total + counts[bi].astype(jnp.int32)
             live = jnp.arange(out_cap) < total
             ext = out_cap + max_cap    # updates never clamp (see below)
-            out_cols = []
-            for ci, f in enumerate(schema):
-                W = widths[ci]
-                if f.dtype.var_width:
-                    data = jnp.zeros((ext, W),
-                                     per_batch[0][ci][0].dtype)
-                    valid = jnp.zeros(ext, jnp.bool_)
-                    lens = jnp.zeros(ext, jnp.int32)
-                else:
-                    data = jnp.zeros(ext, per_batch[0][ci][0].dtype)
-                    valid = jnp.zeros(ext, jnp.bool_)
-                    lens = None
+            out_arrays = []
+            for ai in range(n_arr):
+                W = widths[ai]
+                src0 = per_batch[0][ai]
+                buf = (jnp.zeros((ext, W), src0.dtype) if two_d[ai]
+                       else jnp.zeros(ext, src0.dtype))
                 # forward order: batch i+1's block starts exactly at
                 # offs[i]+counts[i], overwriting batch i's padding tail;
                 # the extended operand keeps dynamic_update_slice from
                 # clamping starts (offs[bi] <= out_cap, cap_bi <= max_cap)
                 for bi in range(nb):
-                    d, v, ln = per_batch[bi][ci]
-                    if f.dtype.var_width and d.shape[1] < W:
-                        d = jnp.pad(d, ((0, 0), (0, W - d.shape[1])))
-                    if f.dtype.var_width:
-                        data = jax.lax.dynamic_update_slice(
-                            data, d, (offs[bi], jnp.int32(0)))
-                    else:
-                        data = jax.lax.dynamic_update_slice(
-                            data, d, (offs[bi],))
-                    valid = jax.lax.dynamic_update_slice(valid, v,
-                                                         (offs[bi],))
-                    if lens is not None:
-                        lens = jax.lax.dynamic_update_slice(lens, ln,
-                                                            (offs[bi],))
-                # clip to out_cap and zero the padding (batch invariant)
-                data = data[:out_cap]
-                valid = valid[:out_cap] & live
-                if f.dtype.var_width:
-                    data = jnp.where(live[:, None], data,
-                                     jnp.zeros((), data.dtype))
-                    lens = jnp.where(live, lens[:out_cap], 0)
-                    out_cols.extend([data, valid, lens])
-                else:
-                    data = jnp.where(live, data,
-                                     jnp.zeros((), data.dtype))
-                    out_cols.extend([data, valid])
-            return tuple(out_cols) + (total,)
+                    a = per_batch[bi][ai]
+                    if two_d[ai] and a.shape[1] < W:
+                        a = jnp.pad(a, ((0, 0), (0, W - a.shape[1])))
+                    buf = jax.lax.dynamic_update_slice(
+                        buf, a, (offs[bi], jnp.int32(0)) if two_d[ai]
+                        else (offs[bi],))
+                # clip to out_cap and zero the padding (batch invariant:
+                # bools -> False, so validity masks fold in too)
+                buf = buf[:out_cap]
+                buf = jnp.where(live[:, None] if two_d[ai] else live,
+                                buf, jnp.zeros((), buf.dtype))
+                out_arrays.append(buf)
+            return tuple(out_arrays) + (total,)
         return jax.jit(fn)
 
     fn = _fused_fn(sig, build)
     args = [_dev_count(b) for b in batches]
-    for b in batches:
-        args.extend(b.flat_arrays())
+    for fb in flats_per_batch:
+        args.extend(fb)
     outs = fn(*args)
     total_host = sum(b.num_rows_raw for b in batches) \
         if all(isinstance(b.num_rows_raw, int) for b in batches) else outs[-1]
@@ -1013,7 +992,7 @@ class TpuProjectExec(TpuExec):
         fused = FusedStage.maybe(self, exprs, self.children[0].schema,
                                  self._schema, stateful)
         for batch in part:
-            with self.metrics.timer("opTime"):
+            with trace_span(f"op_{type(self).__name__}", self.metrics, "opTime"):
                 out = fused(batch) if fused is not None else None
                 if out is None:
                     cols = [ex.materialize(e.eval(batch), batch)
@@ -1049,7 +1028,7 @@ class TpuFilterExec(TpuExec):
         fused = FusedStage.maybe(self, [condition], self.children[0].schema,
                                  self._schema, stateful, mode="filter")
         for batch in part:
-            with self.metrics.timer("opTime"):
+            with trace_span(f"op_{type(self).__name__}", self.metrics, "opTime"):
                 if fused is not None:
                     res = fused(batch)
                     if res is not None:
@@ -1126,12 +1105,12 @@ class TpuCoalesceBatchesExec(TpuExec):
             if len(chunk) >= 8:
                 admit()
                 if self.goal != "single" and pending_rows >= self.target_rows:
-                    with self.metrics.timer("concatTime"):
+                    with trace_span("concat", self.metrics, "concatTime"):
                         yield concat_spillable(self.schema, pending)
                     pending, pending_rows = [], 0
         admit()
         if pending:
-            with self.metrics.timer("concatTime"):
+            with trace_span("concat", self.metrics, "concatTime"):
                 yield concat_spillable(self.schema, pending)
 
 
@@ -1334,7 +1313,7 @@ class TpuHashAggregateExec(TpuExec):
             # batch exists (upstream host IO done), GpuSemaphore.scala:74-78
             _task_begin()
             _reserve(batch.device_size_bytes())
-            with self.metrics.timer("computeAggTime"):
+            with trace_span("aggregate", self.metrics, "computeAggTime"):
                 if self.mode == "final":
                     inflight.append(("pb", batch))
                 else:
@@ -1346,7 +1325,7 @@ class TpuHashAggregateExec(TpuExec):
                         inflight.append(("tok", batch, tok))
                 if len(inflight) >= depth:
                     land_oldest(max(depth // 2, 1))
-        with self.metrics.timer("computeAggTime"):
+        with trace_span("aggregate", self.metrics, "computeAggTime"):
             while inflight:
                 land_oldest(max(depth // 2, 1))
             merge_pending()
@@ -1861,7 +1840,7 @@ class TpuHashAggregateExec(TpuExec):
             ColumnarBatch(self._partial_schema(), out_keys + aggs, n_groups))
 
     def _final(self, batch: ColumnarBatch) -> Partition:
-        with self.metrics.timer("computeAggTime"):
+        with trace_span("aggregate", self.metrics, "computeAggTime"):
             fused = self._maybe_fused_final(batch)
             if fused is not None:
                 self.metrics.inc("numOutputRows", fused.num_rows_raw)
@@ -2038,7 +2017,7 @@ class TpuSortExec(TpuExec):
         if not spillables:
             return
         batch = concat_spillable(self.schema, spillables)
-        with self.metrics.timer("sortTime"):
+        with trace_span("sort", self.metrics, "sortTime"):
             keys = [K.SortKey(ex.materialize(o.child.eval(batch), batch),
                               o.ascending, o.nulls_first)
                     for o in self.orders]
@@ -2244,7 +2223,7 @@ class TpuFlatMapGroupsInPandasExec(TpuExec):
         except (TypeError, ValueError):
             two_arg = False
         frames = []
-        with self.metrics.timer("udfTime"):
+        with trace_span("pandas_udf", self.metrics, "udfTime"):
             for key, pdf in self._group_frames(part):
                 out = fn(key, pdf) if two_arg else fn(pdf)
                 if out is not None and len(out):
@@ -2310,7 +2289,7 @@ class TpuFlatMapCoGroupsInPandasExec(TpuExec):
         rempty = (rp_df.iloc[0:0] if rp_df is not None else
                   pd.DataFrame(columns=self.children[1].schema.names()))
         frames = []
-        with self.metrics.timer("udfTime"):
+        with trace_span("pandas_udf", self.metrics, "udfTime"):
             for key in sorted(set(lgroups) | set(rgroups), key=repr):
                 l = lgroups.get(key, lempty)
                 r = rgroups.get(key, rempty)
@@ -2367,7 +2346,7 @@ class TpuAggregateInPandasExec(TpuExec):
                        for c in a.children] for a in self.aggs]
         kf = pd.DataFrame({f"_gk{i}": k for i, k in enumerate(key_lists)})
         rows = []
-        with self.metrics.timer("udfTime"):
+        with trace_span("pandas_udf", self.metrics, "udfTime"):
             for key, idx in kf.groupby(list(kf.columns), sort=True,
                                        dropna=False).groups.items():
                 if not isinstance(key, tuple):
@@ -2421,7 +2400,7 @@ class TpuGenerateExec(TpuExec):
     def _map(self, part: Partition) -> Partition:
         from ..ops import arrays as ar_ops
         for batch in part:
-            with self.metrics.timer("generateTime"):
+            with trace_span("generate", self.metrics, "generateTime"):
                 arr = ex.materialize(self.gen_input.eval(batch), batch)
                 live = batch.row_mask()
                 # one host sync sizes the output bucket (the dynamic-size
@@ -2547,7 +2526,7 @@ class TpuSortMergeJoinExec(TpuExec):
         bkey_cols = [ex.materialize(e.eval(build), build)
                      for e in self.right_keys]
         for batch in part:
-            with self.metrics.timer("joinTime"):
+            with trace_span("join", self.metrics, "joinTime"):
                 skey_cols = [ex.materialize(e.eval(batch), batch)
                              for e in self.left_keys]
                 how = self.how if self.how in (
@@ -2623,6 +2602,11 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
     # runtime AQE join switch: set by the planner to the broadcast-join
     # byte threshold when adaptive execution is on (None = off)
     aqe_broadcast_threshold: Optional[int] = None
+    # AQE skew-join split: a stream-side reduce partition larger than this
+    # many observed bytes splits into mapper-subset tasks, each joined
+    # against the SAME build partition (OptimizeSkewedJoin +
+    # GpuCustomShuffleReaderExec partial-mapper specs). None = off.
+    aqe_skew_threshold: Optional[int] = None
 
     @property
     def output_partitions(self) -> int:
@@ -2632,6 +2616,9 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
         switched, rparts = self._maybe_runtime_broadcast()
         if switched is not None:
             return switched
+        skewed = self._maybe_skew_split(rparts)
+        if skewed is not None:
+            return skewed
         lparts = self.children[0].execute()
         if rparts is None:
             rparts = self.children[1].execute()
@@ -2639,6 +2626,49 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
             f"co-partition mismatch: {len(lparts)} vs {len(rparts)}"
         return [self._join_copart(sp, bp)
                 for sp, bp in zip(lparts, rparts)]
+
+    def _maybe_skew_split(self, rparts) -> Optional[List[Partition]]:
+        """Skew handling: hot stream partitions split into mapper-subset
+        tasks (>=2 output partitions per hot input partition), the build
+        partition materialized ONCE and shared by its sub-tasks. Inner/
+        left only — right/full outer would emit unmatched build rows once
+        per sub-task."""
+        from ..shuffle.exchange import TpuShuffleExchangeExec
+        from ..shuffle.manager import WorkerContext
+        thr = self.aqe_skew_threshold
+        if thr is None or thr <= 0 or self.how in ("right", "full") or \
+                WorkerContext.current is not None:
+            return None
+        sx = self.children[0]
+        if not isinstance(sx, TpuShuffleExchangeExec):
+            return None
+        sgroups = sx.execute_skew(thr)
+        if all(len(g) == 1 for g in sgroups):
+            # nothing hot: fall through to the plain co-partitioned loop
+            return [self._join_copart(g[0], bp)
+                    for g, bp in zip(sgroups, rparts
+                                     if rparts is not None
+                                     else self.children[1].execute())]
+        if rparts is None:
+            rparts = self.children[1].execute()
+        assert len(sgroups) == len(rparts)
+        out: List[Partition] = []
+        for subs, bp in zip(sgroups, rparts):
+            if len(subs) == 1:
+                out.append(self._join_copart(subs[0], bp))
+                continue
+            self.metrics.inc("skewJoinSplits")
+            shared = _SharedBuild(self.children[1].schema, bp, len(subs))
+            for sub in subs:
+                out.append(self._join_split(sub, shared))
+        return out
+
+    def _join_split(self, stream_part: Partition,
+                    shared: "_SharedBuild") -> Partition:
+        try:
+            yield from self._join_part(stream_part, shared.handle())
+        finally:
+            shared.release()
 
     def _maybe_runtime_broadcast(self):
         """AQE runtime join-strategy switch (the reference's AQE broadcast
@@ -2724,6 +2754,39 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
             yield from self._join_part(stream_part, handle)
         finally:
             handle.close()
+
+
+class _SharedBuild:
+    """One build partition materialized once, shared by the skew-split
+    sub-tasks of its stream partition; freed when the LAST sub-task
+    releases (sub-tasks drain concurrently on the task pool, so
+    materialization and refcounting are locked)."""
+
+    def __init__(self, schema, build_part: Partition, refs: int):
+        import threading
+        self._schema = schema
+        self._part = build_part
+        self._refs = refs
+        self._handle = None
+        self._mu = threading.Lock()
+
+    def handle(self):
+        from ..exec.spill import SpillableColumnarBatch
+        with self._mu:
+            if self._handle is None:
+                build = concat_spillable(
+                    self._schema,
+                    [SpillableColumnarBatch(b) for b in self._part
+                     if b.num_rows > 0])
+                self._handle = SpillableColumnarBatch(build)
+            return self._handle
+
+    def release(self):
+        with self._mu:
+            self._refs -= 1
+            if self._refs == 0 and self._handle is not None:
+                self._handle.close()
+                self._handle = None
 
 
 class TpuCrossJoinExec(TpuExec):

@@ -60,9 +60,11 @@ class TpuWindowExec(TpuExec):
         cap = batch.capacity
         n = batch.num_rows
         out_cols = list(batch.columns)
+        from ..exec.tracing import trace_span
         for (name, fn, part_exprs, orders, frame) in self._bound:
-            out_cols.append(self._compute_one(batch, fn, part_exprs, orders,
-                                              frame))
+            with trace_span("window", self.metrics, "windowTime"):
+                out_cols.append(self._compute_one(batch, fn, part_exprs,
+                                                  orders, frame))
         self.metrics.inc("numOutputRows", n)
         yield ColumnarBatch(self._schema, out_cols, n)
 

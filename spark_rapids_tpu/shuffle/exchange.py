@@ -22,6 +22,7 @@ from ..exec.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY, BufferCatalog,
                           SpillableColumnarBatch)
 from ..ops import expressions as ex
 from ..plan.physical import Partition, TpuExec, bind_refs, concat_batches
+from ..exec.tracing import trace_span
 from .partitioning import (HashPartitioner, RoundRobinPartitioner,
                            SinglePartitioner, TpuPartitioner)
 
@@ -50,6 +51,43 @@ class LocalShuffle:
             s.close()
         if batches:
             yield concat_batches(schema, batches)
+
+    def read_slices(self, p: int, lo: int, hi: int,
+                    schema: dt.Schema) -> Partition:
+        """A mapper-subset read of reduce partition ``p``: slices
+        [lo, hi) only — the partial-mapper partition spec behind AQE skew
+        splitting (ShuffledBatchRDD.scala:202 PartialMapperPartitionSpec)."""
+        batches = []
+        for s in self.slices[p][lo:hi]:
+            batches.append(s.get_batch())
+            s.close()
+        if batches:
+            yield concat_batches(schema, batches)
+
+    def read_row_chunk(self, p: int, idx: int, chunk: int, n_chunks: int,
+                       schema: dt.Schema) -> Partition:
+        """Row-range read of one slice of partition ``p``: chunk
+        ``chunk``/``n_chunks`` by row position — sub-mapper granularity
+        for the single-giant-slice skew case (finer than the reference's
+        map-block granularity; columnar row gathers make it cheap). The
+        slice is SHARED by its chunks, so it is not closed here —
+        ``close_pending`` releases it at exchange cleanup."""
+        import jax.numpy as jnp
+        from ..columnar.column import bucket
+        from ..ops import kernels as K
+        b = self.slices[p][idx].get_batch()
+        n = b.num_rows
+        lo = (n * chunk) // n_chunks
+        hi = (n * (chunk + 1)) // n_chunks
+        count = hi - lo
+        if count <= 0:
+            return
+        cap = bucket(max(count, 1))
+        live = jnp.arange(cap) < count
+        idxs = jnp.where(live, jnp.arange(cap, dtype=jnp.int32) + lo, 0)
+        cols = [K.gather_column(c, idxs, out_valid=live)
+                for c in b.columns]
+        yield ColumnarBatch(schema, cols, count)
 
     def close_pending(self) -> None:
         """Release slices never pulled (early-terminating consumers)."""
@@ -97,13 +135,11 @@ class TpuShuffleExchangeExec(TpuExec):
             return HashPartitioner(self.num_partitions, self.by)
         return RoundRobinPartitioner(self.num_partitions)
 
-    def execute(self) -> List[Partition]:
+    def _run_map_phase(self, shuffle) -> None:
+        """Map side: split every upstream batch and register the slices,
+        one task per upstream partition, drained concurrently (shared by
+        the local, distributed, and skew-split execute forms)."""
         from ..exec.tasks import run_partition_tasks
-        from .manager import WorkerContext
-        ctx = WorkerContext.current
-        if ctx is not None:
-            return self._execute_distributed(ctx)
-        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
         partitioner = self._make_partitioner()
 
         def map_task(pid, part):
@@ -111,11 +147,80 @@ class TpuShuffleExchangeExec(TpuExec):
                 shuffle.write(partitioner, batch)
                 self.metrics.inc("dataSize", batch.device_size_bytes())
 
-        with self.metrics.timer("shuffleWriteTime"):
-            # map side: one task per upstream partition, drained concurrently
+        with trace_span("shuffle_write", self.metrics, "shuffleWriteTime"):
             run_partition_tasks(self.children[0].execute(), map_task)
+
+    def execute(self) -> List[Partition]:
+        from .manager import WorkerContext
+        ctx = WorkerContext.current
+        if ctx is not None:
+            return self._execute_distributed(ctx)
+        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
+        self._run_map_phase(shuffle)
         groups = self._reduce_groups(shuffle)
         return [self._read_group(shuffle, g) for g in groups]
+
+    def execute_skew(self, threshold: int) -> List[List[Partition]]:
+        """AQE skew-split form of :meth:`execute` (local mode): run the
+        map phase, then return per reduce partition a LIST of
+        sub-partitions — one when under ``threshold`` observed bytes,
+        multiple mapper-subset reads (partial-mapper partition specs,
+        ShuffledBatchRDD.scala:202) when a hot partition exceeds it. The
+        caller (skewed join) keeps the other side aligned per ORIGINAL
+        partition index. Unsplit partitions keep the elastic-recovery
+        read path; SPLIT chunks cannot re-run the map phase safely (other
+        chunks of the same partition may already be consumed against the
+        old slice boundaries), so a lost buffer there aborts loudly."""
+        from .manager import WorkerContext
+        assert WorkerContext.current is None, \
+            "skew split is a local-mode path"
+        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
+        self._run_map_phase(shuffle)
+        out: List[List[Partition]] = []
+        for p in range(self.num_partitions):
+            sizes = [s.size_bytes for s in shuffle.slices[p]]
+            total = sum(sizes)
+            if total <= threshold:
+                out.append([self._read_group(shuffle, [p])])
+                continue
+            if len(sizes) < 2:
+                # one giant map slice: split by row ranges instead
+                n_chunks = min(-(-total // threshold), 64)
+                chunks = [shuffle.read_row_chunk(p, 0, c, n_chunks,
+                                                 self.schema)
+                          for c in range(n_chunks)]
+            else:
+                # split on slice (mapper-output) boundaries into chunks
+                # of ~threshold bytes, at least one slice each
+                chunks = []
+                lo = 0
+                acc = 0
+                for i, sz in enumerate(sizes):
+                    acc += sz
+                    if acc >= threshold and i + 1 > lo:
+                        chunks.append(shuffle.read_slices(p, lo, i + 1,
+                                                          self.schema))
+                        lo, acc = i + 1, 0
+                if lo < len(sizes):
+                    chunks.append(shuffle.read_slices(p, lo, len(sizes),
+                                                      self.schema))
+            self.metrics.inc("skewSplitPartitions")
+            self.metrics.inc("skewSplitTasks", len(chunks))
+            out.append([self._loud_chunk(c, p) for c in chunks])
+        return out
+
+    def _loud_chunk(self, chunk: Partition, p: int) -> Partition:
+        """Split-chunk reads abort with CONTEXT on lost buffers instead
+        of recovering — re-running the map phase would move the slice/row
+        boundaries under chunks that were already consumed."""
+        from ..exec.spill import BufferLostError
+        try:
+            yield from chunk
+        except BufferLostError as e:
+            raise RuntimeError(
+                f"skew-split chunk of shuffle partition {p} lost a "
+                f"buffer; map-stage retry is unsafe for split chunks "
+                f"(consumed siblings pin the old boundaries): {e}") from e
 
     def plan_fingerprint(self) -> str:
         """Structural hash of this exchange's plan subtree: exec class
@@ -147,23 +252,14 @@ class TpuShuffleExchangeExec(TpuExec):
         other partitions are empty here — their owners produce them.
         Adaptive coalescing stays off: partition->worker ownership must be
         identical on every worker."""
-        from ..exec.tasks import run_partition_tasks
         from .manager import DistributedShuffle
         shuffle = self._shuffle = DistributedShuffle(
             self.num_partitions, ctx, fingerprint=self.plan_fingerprint())
-        partitioner = self._make_partitioner()
-
-        def map_task(pid, part):
-            for batch in part:
-                shuffle.write(partitioner, batch)
-                self.metrics.inc("dataSize", batch.device_size_bytes())
-
-        with self.metrics.timer("shuffleWriteTime"):
-            run_partition_tasks(self.children[0].execute(), map_task)
+        self._run_map_phase(shuffle)
         shuffle.finish_writes()
 
         def owned(p):
-            with self.metrics.timer("shuffleFetchTime"):
+            with trace_span("shuffle_fetch", self.metrics, "shuffleFetchTime"):
                 yield from shuffle.read(p, self.schema)
 
         def empty():
@@ -337,13 +433,13 @@ class TpuRangeExchangeExec(TpuExec):
         target = self.SAMPLE_TARGET_PER_PARTITION * self.num_partitions
         per_batch = max(8, -(-target // len(spillables)))
         samples = []
-        with self.metrics.timer("sampleTime"):
+        with trace_span("range_sample", self.metrics, "sampleTime"):
             for s in spillables:
                 samples.append(self._sample(s.get_batch(), per_batch))
         partitioner = RangePartitioner(self.num_partitions, self.orders,
                                        samples)
         shuffle = self._shuffle = LocalShuffle(self.num_partitions)
-        with self.metrics.timer("shuffleWriteTime"):
+        with trace_span("shuffle_write", self.metrics, "shuffleWriteTime"):
             for s in spillables:
                 shuffle.write(partitioner, s.get_batch())
                 s.close()
@@ -383,7 +479,7 @@ class TpuBroadcastExchangeExec(TpuExec):
         from ..plan.physical import accumulate_spillable, concat_spillable
         with self._lock:
             if self._handle is None:
-                with self.metrics.timer("broadcastTime"):
+                with trace_span("broadcast_build", self.metrics, "broadcastTime"):
                     batch = concat_spillable(
                         self.schema,
                         accumulate_spillable(self.children[0].execute()))

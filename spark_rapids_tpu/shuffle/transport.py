@@ -51,6 +51,13 @@ class ShuffleDesyncError(ShuffleFetchError):
     fingerprint handshake detects divergence instead)."""
 
 
+class ShuffleProtocolError(ShuffleFetchError):
+    """The peer is ALIVE and answered, but with a protocol-level ERROR
+    (unknown message/buffer, version skew). Kept distinct from connection
+    failures so the caller never mislabels a live-but-confused peer as a
+    dead worker."""
+
+
 class ShuffleWorkerLostError(ShuffleFetchError):
     """A peer worker is unreachable/dead: its local data shard cannot be
     recomputed from any other worker's lineage, so the distributed query
@@ -480,9 +487,14 @@ class ShuffleClient:
     @staticmethod
     def _raise_protocol_error(shuffle_id: int, header: Dict) -> None:
         msg = header.get("message", "protocol error")
-        if header.get("code") == "desync":
+        code = header.get("code")
+        if code == "desync":
             raise ShuffleDesyncError(msg)
-        raise ShuffleFetchError(f"shuffle {shuffle_id}: {msg}")
+        if code == "released":
+            raise ShuffleFetchError(f"shuffle {shuffle_id}: {msg}")
+        # any other ERROR reply: the peer is alive but confused — never
+        # a ConnectionError, or the caller would report a dead worker
+        raise ShuffleProtocolError(f"shuffle {shuffle_id}: {msg}")
 
     # -- one attempt ---------------------------------------------------------
     def _fetch_once(self, shuffle_id: int, reduce_ids: List[int],
@@ -496,9 +508,7 @@ class ShuffleClient:
             reader = FrameReader(conn.read_exact)
             msg_type, header, _ = reader.next_frame()
             if msg_type == ERROR:
-                if header.get("code") in ("desync", "released"):
-                    self._raise_protocol_error(shuffle_id, header)
-                raise ConnectionError(header.get("message", "server error"))
+                self._raise_protocol_error(shuffle_id, header)
             assert msg_type == META_RESP, msg_type
             metas = [BufferDesc.from_json(d) for d in header["buffers"]]
 
@@ -529,7 +539,12 @@ class ShuffleClient:
             while inflight or pending:
                 msg_type, header, payload = reader.next_frame()
                 if msg_type == ERROR:
-                    raise ConnectionError(header.get("message"))
+                    # mid-transfer ERROR (e.g. a buffer freed between the
+                    # metadata reply and the transfer): live peer, not a
+                    # dead one
+                    raise ShuffleProtocolError(
+                        f"shuffle {shuffle_id}: "
+                        f"{header.get('message', 'transfer error')}")
                 if msg_type == XFER_DONE:
                     continue
                 assert msg_type == XFER_CHUNK, msg_type
@@ -585,20 +600,15 @@ def _rebuild_from_arrays(meta: BufferDesc,
                          arrays: List[np.ndarray]) -> ColumnarBatch:
     """Host arrays + metadata -> device batch (shared by the wire path and
     the local short-circuit read)."""
+    from ..columnar.column import build_column
     fields = [dt.Field(n, dt.of(t))
               for n, t in zip(meta.field_names, meta.field_dtypes)]
     schema = dt.Schema(fields)
     import jax.numpy as jnp
+    dev = [jnp.asarray(a) for a in arrays]
     cols: List[Column] = []
     i = 0
     for f in fields:
-        if f.dtype.var_width:
-            cols.append(Column(f.dtype, jnp.asarray(arrays[i]),
-                               jnp.asarray(arrays[i + 1]),
-                               jnp.asarray(arrays[i + 2])))
-            i += 3
-        else:
-            cols.append(Column(f.dtype, jnp.asarray(arrays[i]),
-                               jnp.asarray(arrays[i + 1])))
-            i += 2
+        c, i = build_column(f.dtype, dev, i)
+        cols.append(c)
     return ColumnarBatch(schema, cols, meta.num_rows)

@@ -274,3 +274,32 @@ def test_dataframe_cache_golden():
     gc.collect()
     gc.collect()
     assert owner_ref() is None
+
+
+def test_span_breakdown_names_query_time():
+    """The per-query span report (trace_span -> SpanRecorder) names where
+    execute time goes: q1-shaped query must show the hot regions with
+    nonzero self time, and span self-times must be nesting-deduplicated
+    (each <= executeTimeS-ish wall, not elapsed-of-parent double counts)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    df = s.createDataFrame({
+        "k": [i % 5 for i in range(1000)],
+        "v": [float(i % 97) for i in range(1000)]})
+    (df.filter(col("v") > 3)
+       .groupBy("k")
+       .agg(F.sum("v").alias("sv"), F.avg("v").alias("av"))
+       .orderBy("k").collect())
+    m = s.last_query_metrics()
+    spans = m["spans"]
+    assert spans, "span report must not be empty"
+    for name, rec in spans.items():
+        assert rec["selfS"] >= 0.0 and rec["count"] >= 1, (name, rec)
+    # the aggregate/sort pipeline must be named
+    assert any(n in spans for n in ("aggregate", "fused_project",
+                                    "fused_filter_project", "sort",
+                                    "op_TpuSortExec")), spans

@@ -279,3 +279,57 @@ def test_runtime_broadcast_switch():
     # oracle spot check: k=0 -> sum over 40 rows of v + w
     exp0 = sum(float(i) for i in range(0, 2000, 50)) + 40 * 0.0
     assert abs(dict(rows)[0] - exp0) < 1e-6
+
+
+def test_skew_join_split():
+    """AQE skew split: a hot stream partition (one dominant key) larger
+    than the skew threshold executes as >=2 mapper-subset tasks joined
+    against the SAME shared build partition — results identical to the
+    unsplit plan (OptimizeSkewedJoin + partial-mapper partition specs)."""
+    import pandas as pd
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.plan.physical import TpuShuffledJoinExec
+    from spark_rapids_tpu.shuffle.exchange import TpuShuffleExchangeExec
+
+    def find(node, klass):
+        out = [node] if isinstance(node, klass) else []
+        for c in node.children:
+            out.extend(find(c, klass))
+        return out
+
+    # 90% of rows share one key -> one hot reduce partition
+    ks = [7] * 1800 + [i % 40 for i in range(200)]
+    vs = [float(i % 13) for i in range(2000)]
+    conf = {
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.rapids.tpu.sql.adaptive.enabled": "true",
+        "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThreshold":
+            "4096",
+        "spark.rapids.tpu.sql.shuffle.partitions": "4",
+        "spark.rapids.tpu.sql.explain": "NONE",
+    }
+    s = TpuSession.builder.config(dict(conf)).getOrCreate()
+    big = s.createDataFrame({"k": ks, "v": vs})
+    dim = s.createDataFrame({"k": list(range(41)),
+                             "w": [k * 10.0 for k in range(41)]})
+    rows = sorted(big.join(dim, on="k", how="inner")
+                  .select(col("k"), (col("v") + col("w")).alias("x"))
+                  .collect())
+    joins = find(s.last_plan(), TpuShuffledJoinExec)
+    assert joins and joins[0].aqe_skew_threshold == 4096
+    m = joins[0].metrics.resolve()
+    assert m.get("skewJoinSplits", 0) >= 1, m
+    ex_metrics = [e.metrics.resolve()
+                  for e in find(s.last_plan(), TpuShuffleExchangeExec)]
+    assert any(em.get("skewSplitTasks", 0) >= 2 for em in ex_metrics), \
+        ex_metrics
+    # oracle: same join without skew splitting
+    pb = pd.DataFrame({"k": ks, "v": vs})
+    pdim = pd.DataFrame({"k": list(range(41)),
+                         "w": [k * 10.0 for k in range(41)]})
+    j = pb.merge(pdim, on="k")
+    exp = sorted((int(r.k), float(r.v + r.w))
+                 for r in j.itertuples(index=False))
+    assert rows == exp

@@ -236,3 +236,47 @@ def test_sql_tpch_q3_text():
     for a, b in zip(sql_out, api_out):
         assert a[0] == b[0] and abs(a[1] - b[3]) < 1e-6 and \
             a[2] == b[1] and a[3] == b[2]
+
+
+def test_sql_not_in_subquery_null_aware(session):
+    """NOT IN (SELECT ...) follows SQL three-valued semantics (Spark's
+    null-aware anti join): any NULL in the subquery output empties the
+    result; NULL probe values never qualify; an EMPTY subquery keeps
+    everything."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    s.createDataFrame({"k": [1, 2, 3, None]}).createOrReplaceTempView("ti")
+    s.createDataFrame({"fk": [1, None]}).createOrReplaceTempView("tu_null")
+    s.createDataFrame({"fk": [1]}).createOrReplaceTempView("tu_plain")
+    s.createDataFrame({"fk": [9]}).createOrReplaceTempView("tu_nine")
+    # NULL in subquery -> nothing qualifies
+    assert s.sql("SELECT k FROM ti WHERE k NOT IN "
+                 "(SELECT fk FROM tu_null)").collect() == []
+    # no NULLs: plain anti semantics, NULL probe row excluded
+    assert sorted(s.sql(
+        "SELECT k FROM ti WHERE k NOT IN (SELECT fk FROM tu_plain)"
+    ).collect()) == [(2,), (3,)]
+    # empty subquery -> every row qualifies (even the NULL probe)
+    out = s.sql("SELECT k FROM ti WHERE k NOT IN "
+                "(SELECT fk FROM tu_nine WHERE fk < 0)").collect()
+    assert len(out) == 4
+
+
+def test_sql_correlated_count_scalar_empty_group(session):
+    """A correlated scalar COUNT over an empty group is 0, not NULL
+    (RewriteCorrelatedScalarSubquery's count default): rows whose group
+    is empty must still satisfy '= 0'."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    s.createDataFrame({"k": [1, 2, 3]}).createOrReplaceTempView("co_t")
+    s.createDataFrame({"fk": [1, 1, 3]}).createOrReplaceTempView("co_u")
+    out = sorted(s.sql(
+        "SELECT k FROM co_t WHERE "
+        "(SELECT count(*) FROM co_u WHERE fk = k) = 0").collect())
+    assert out == [(2,)], out
+    out = sorted(s.sql(
+        "SELECT k FROM co_t WHERE "
+        "(SELECT count(*) FROM co_u WHERE fk = k) = 2").collect())
+    assert out == [(1,)], out

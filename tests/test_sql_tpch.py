@@ -1,0 +1,344 @@
+"""All 22 TPC-H queries as SQL TEXT through session.sql(), asserted
+row-equal to the DataFrame-API builds of the same queries
+(benchmarks/queries.py) at tiny scale.
+
+This is the reference's front door — arbitrary SQL through Catalyst
+(Plugin.scala:40-59) — exercised end-to-end: the texts use the standard
+TPC-H shapes including WHERE-clause subqueries (EXISTS/NOT EXISTS, [NOT]
+IN (SELECT ...), correlated and uncorrelated scalars), WITH views, and
+derived tables, adapted only where the data generator's schema differs
+(the same adaptations the DataFrame builds document)."""
+
+import pytest
+
+from benchmarks import datagen, queries as Q
+
+
+_SF = 0.002
+
+# date literals used by the builds (days since epoch -> ISO)
+# 8766=1994-01-01  8857=+91d  9131=1995-01-01  9204=1995-03-15
+# 9374=1995-09-01  9404=+30d  9861=1996-12-31  8856=+90d
+
+TPCH_SQL = {
+    "q1": """
+SELECT l_returnflag, l_linestatus, sum(l_quantity) AS sum_qty,
+       sum(l_extendedprice) AS sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+       avg(l_quantity) AS avg_qty, avg(l_extendedprice) AS avg_price,
+       avg(l_discount) AS avg_disc, count(*) AS count_order
+FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus""",
+
+    "q2": """
+SELECT s_acctbal, s_name, n_name, p_partkey, p_type
+FROM part
+JOIN partsupp ON p_partkey = ps_partkey
+JOIN supplier ON s_suppkey = ps_suppkey
+JOIN nation ON s_nationkey = n_nationkey
+JOIN region ON n_regionkey = r_regionkey
+WHERE p_size = 15 AND p_type LIKE '%BRASS' AND r_name = 'EUROPE'
+  AND ps_supplycost = (
+    SELECT min(ps_supplycost)
+    FROM partsupp JOIN supplier ON s_suppkey = ps_suppkey
+    JOIN nation ON s_nationkey = n_nationkey
+    JOIN region ON n_regionkey = r_regionkey
+    WHERE p_partkey = ps_partkey AND r_name = 'EUROPE')
+ORDER BY s_acctbal DESC, n_name, s_name, p_partkey
+LIMIT 100""",
+
+    "q3": """
+SELECT l_orderkey, o_orderdate, o_shippriority,
+       sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer JOIN orders ON c_custkey = o_custkey
+JOIN lineitem ON o_orderkey = l_orderkey
+WHERE c_mktsegment = 'BUILDING' AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate
+LIMIT 10""",
+
+    "q4": """
+SELECT o_orderpriority, count(*) AS order_count
+FROM orders
+WHERE o_orderdate >= DATE '1994-01-01' AND o_orderdate < DATE '1994-04-02'
+  AND EXISTS (SELECT * FROM lineitem
+              WHERE l_orderkey = o_orderkey
+                AND l_commitdate < l_receiptdate)
+GROUP BY o_orderpriority
+ORDER BY o_orderpriority""",
+
+    "q5": """
+SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer
+JOIN orders ON c_custkey = o_custkey
+JOIN lineitem ON o_orderkey = l_orderkey
+JOIN supplier ON l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+JOIN nation ON s_nationkey = n_nationkey
+JOIN region ON n_regionkey = r_regionkey
+WHERE r_name = 'ASIA' AND o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1995-01-01'
+GROUP BY n_name
+ORDER BY revenue DESC""",
+
+    "q6": """
+SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24""",
+
+    "q7": """
+SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue
+FROM (SELECT supp_nation, cust_nation, year(l_shipdate) AS l_year,
+             l_extendedprice * (1 - l_discount) AS volume
+      FROM lineitem
+      JOIN supplier ON l_suppkey = s_suppkey
+      JOIN (SELECT n_nationkey AS supp_nationkey, n_name AS supp_nation
+            FROM nation WHERE n_name IN ('FRANCE', 'GERMANY')) sn
+        ON s_nationkey = supp_nationkey
+      JOIN orders ON l_orderkey = o_orderkey
+      JOIN customer ON o_custkey = c_custkey
+      JOIN (SELECT n_nationkey AS cust_nationkey, n_name AS cust_nation
+            FROM nation WHERE n_name IN ('FRANCE', 'GERMANY')) cn
+        ON c_nationkey = cust_nationkey
+      WHERE l_shipdate >= DATE '1995-01-01'
+        AND l_shipdate <= DATE '1996-12-31'
+        AND ((supp_nation = 'FRANCE' AND cust_nation = 'GERMANY') OR
+             (supp_nation = 'GERMANY' AND cust_nation = 'FRANCE'))) shipping
+GROUP BY supp_nation, cust_nation, l_year
+ORDER BY supp_nation, cust_nation, l_year""",
+
+    "q8": """
+SELECT o_year, sum(CASE WHEN supp_nation = 'BRAZIL' THEN volume
+                        ELSE 0.0 END) / sum(volume) AS mkt_share
+FROM (SELECT year(o_orderdate) AS o_year,
+             l_extendedprice * (1 - l_discount) AS volume, supp_nation
+      FROM lineitem
+      JOIN part ON l_partkey = p_partkey
+      JOIN supplier ON l_suppkey = s_suppkey
+      JOIN orders ON l_orderkey = o_orderkey
+      JOIN customer ON o_custkey = c_custkey
+      JOIN (SELECT n_nationkey AS cust_nationkey, n_regionkey
+            FROM nation) cn ON c_nationkey = cust_nationkey
+      JOIN region ON n_regionkey = r_regionkey
+      JOIN (SELECT n_nationkey AS supp_nationkey, n_name AS supp_nation
+            FROM nation) sn ON s_nationkey = supp_nationkey
+      WHERE r_name = 'AMERICA' AND p_type = 'ECONOMY ANODIZED STEEL'
+        AND o_orderdate >= DATE '1995-01-01'
+        AND o_orderdate <= DATE '1996-12-31') all_nations
+GROUP BY o_year
+ORDER BY o_year""",
+
+    "q9": """
+SELECT n_name, o_year, sum(amount) AS sum_profit
+FROM (SELECT n_name, year(o_orderdate) AS o_year,
+             l_extendedprice * (1 - l_discount) -
+             ps_supplycost * l_quantity AS amount
+      FROM lineitem
+      JOIN part ON l_partkey = p_partkey
+      JOIN supplier ON l_suppkey = s_suppkey
+      JOIN partsupp ON l_partkey = ps_partkey AND l_suppkey = ps_suppkey
+      JOIN orders ON l_orderkey = o_orderkey
+      JOIN nation ON s_nationkey = n_nationkey
+      WHERE p_type LIKE '%BRUSHED%') profit
+GROUP BY n_name, o_year
+ORDER BY n_name, o_year DESC""",
+
+    "q10": """
+SELECT c_custkey, c_name, c_acctbal, n_name,
+       sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer
+JOIN orders ON c_custkey = o_custkey
+JOIN lineitem ON o_orderkey = l_orderkey
+JOIN nation ON c_nationkey = n_nationkey
+WHERE o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1994-04-02' AND l_returnflag = 'R'
+GROUP BY c_custkey, c_name, c_acctbal, n_name
+ORDER BY revenue DESC, c_custkey
+LIMIT 20""",
+
+    "q11": """
+SELECT ps_partkey, sum(ps_supplycost * ps_availqty) AS value
+FROM partsupp
+JOIN supplier ON ps_suppkey = s_suppkey
+JOIN nation ON s_nationkey = n_nationkey
+WHERE n_name = 'GERMANY'
+GROUP BY ps_partkey
+HAVING sum(ps_supplycost * ps_availqty) > (
+  SELECT sum(ps_supplycost * ps_availqty) * 0.0001
+  FROM partsupp
+  JOIN supplier ON ps_suppkey = s_suppkey
+  JOIN nation ON s_nationkey = n_nationkey
+  WHERE n_name = 'GERMANY')
+ORDER BY value DESC, ps_partkey""",
+
+    "q12": """
+SELECT l_shipmode,
+       sum(CASE WHEN o_orderpriority IN ('1-URGENT', '2-HIGH') THEN 1
+                ELSE 0 END) AS high_line_count,
+       sum(CASE WHEN o_orderpriority NOT IN ('1-URGENT', '2-HIGH') THEN 1
+                ELSE 0 END) AS low_line_count
+FROM orders JOIN lineitem ON o_orderkey = l_orderkey
+WHERE l_shipmode IN ('MAIL', 'SHIP') AND l_commitdate < l_receiptdate
+  AND l_shipdate < l_commitdate AND l_receiptdate >= DATE '1994-01-01'
+  AND l_receiptdate < DATE '1995-01-01'
+GROUP BY l_shipmode
+ORDER BY l_shipmode""",
+
+    "q13": """
+SELECT c_count, count(*) AS custdist
+FROM (SELECT c_custkey, count(o_orderkey) AS c_count
+      FROM customer
+      LEFT JOIN (SELECT * FROM orders
+                 WHERE NOT (o_comment LIKE '%special%'
+                            AND o_comment LIKE '%requests%')) o
+        ON c_custkey = o_custkey
+      GROUP BY c_custkey) c_orders
+GROUP BY c_count
+ORDER BY custdist DESC, c_count DESC""",
+
+    "q14": """
+SELECT 100.0 * sum(CASE WHEN p_type LIKE 'PROMO%'
+                        THEN l_extendedprice * (1 - l_discount)
+                        ELSE 0.0 END) /
+       sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+FROM lineitem JOIN part ON l_partkey = p_partkey
+WHERE l_shipdate >= DATE '1995-09-01' AND l_shipdate < DATE '1995-10-01'""",
+
+    "q15": """
+WITH revenue AS (
+  SELECT l_suppkey AS supplier_no,
+         sum(l_extendedprice * (1 - l_discount)) AS total_revenue
+  FROM lineitem
+  WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1994-04-01'
+  GROUP BY l_suppkey)
+SELECT s_suppkey, s_name, total_revenue
+FROM supplier JOIN revenue ON s_suppkey = supplier_no
+WHERE total_revenue >= (SELECT max(total_revenue) FROM revenue) * 0.999999
+ORDER BY s_suppkey""",
+
+    "q16": """
+SELECT p_brand, p_type, p_size, count(DISTINCT ps_suppkey) AS supplier_cnt
+FROM partsupp JOIN part ON ps_partkey = p_partkey
+WHERE p_brand <> 'Brand#45' AND p_type NOT LIKE 'MEDIUM POLISHED%'
+  AND p_size IN (49, 14, 23, 45, 19, 3, 36, 9)
+  AND ps_suppkey NOT IN (SELECT s_suppkey FROM supplier
+                         WHERE s_acctbal < 0)
+GROUP BY p_brand, p_type, p_size
+ORDER BY supplier_cnt DESC, p_brand, p_type, p_size""",
+
+    "q17": """
+SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+FROM lineitem JOIN part ON p_partkey = l_partkey
+WHERE p_brand = 'Brand#23' AND p_container = 'MED BOX'
+  AND l_quantity < (SELECT 0.2 * avg(l_quantity) FROM lineitem
+                    WHERE l_partkey = p_partkey)""",
+
+    "q18": """
+SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity) AS sum_qty
+FROM customer
+JOIN orders ON c_custkey = o_custkey
+JOIN lineitem ON o_orderkey = l_orderkey
+WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                     GROUP BY l_orderkey HAVING sum(l_quantity) > 120)
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate
+LIMIT 100""",
+
+    "q19": """
+SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM lineitem JOIN part ON p_partkey = l_partkey
+WHERE l_shipmode IN ('AIR', 'REG AIR')
+  AND ((p_brand = 'Brand#12' AND p_container IN ('SM CASE', 'SM BOX')
+        AND l_quantity >= 1 AND l_quantity <= 11 AND p_size <= 5) OR
+       (p_brand = 'Brand#23' AND p_container IN ('MED BAG', 'MED BOX')
+        AND l_quantity >= 10 AND l_quantity <= 20 AND p_size <= 10) OR
+       (p_brand = 'Brand#34' AND p_container IN ('LG CASE', 'LG BOX')
+        AND l_quantity >= 20 AND l_quantity <= 30 AND p_size <= 15))""",
+
+    "q20": """
+SELECT s_name
+FROM supplier
+JOIN nation ON s_nationkey = n_nationkey
+WHERE n_name = 'CANADA'
+  AND s_suppkey IN (
+    SELECT ps_suppkey FROM partsupp
+    WHERE ps_partkey IN (SELECT p_partkey FROM part
+                         WHERE p_type LIKE '%TIN%')
+      AND ps_availqty > (
+        SELECT 0.5 * sum(l_quantity) FROM lineitem
+        WHERE l_partkey = ps_partkey AND l_suppkey = ps_suppkey
+          AND l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1995-01-01'))
+ORDER BY s_name""",
+
+    "q21": """
+SELECT s_name, count(*) AS numwait
+FROM supplier
+JOIN lineitem l1 ON s_suppkey = l_suppkey
+JOIN orders ON o_orderkey = l_orderkey
+JOIN nation ON s_nationkey = n_nationkey
+WHERE o_orderstatus = 'F' AND l_receiptdate > l_commitdate
+  AND n_name = 'FRANCE'
+  AND EXISTS (SELECT * FROM lineitem l2
+              WHERE l2.l_orderkey = l1.l_orderkey
+                AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (SELECT * FROM lineitem l3
+                  WHERE l3.l_orderkey = l1.l_orderkey
+                    AND l3.l_suppkey <> l1.l_suppkey
+                    AND l3.l_receiptdate > l3.l_commitdate)
+GROUP BY s_name
+ORDER BY numwait DESC, s_name
+LIMIT 100""",
+
+    "q22": """
+SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM (SELECT substring(c_phone, 1, 2) AS cntrycode, c_acctbal, c_custkey
+      FROM customer
+      WHERE substring(c_phone, 1, 2) IN
+            ('13', '31', '23', '29', '30', '18', '17')
+        AND c_acctbal > (
+          SELECT avg(c_acctbal) FROM customer
+          WHERE c_acctbal > 0.0
+            AND substring(c_phone, 1, 2) IN
+                ('13', '31', '23', '29', '30', '18', '17'))) custsale
+WHERE NOT EXISTS (SELECT * FROM orders WHERE o_custkey = c_custkey)
+GROUP BY cntrycode
+ORDER BY cntrycode""",
+}
+
+
+def _cmp_rows(sql_rows, api_rows, qname, tol=5e-5):
+    assert len(sql_rows) == len(api_rows), \
+        (qname, len(sql_rows), len(api_rows))
+    import math
+
+    def key(r):
+        return tuple(repr(type(v)) + (f"{v:.4f}" if isinstance(v, float)
+                                      else repr(v)) for v in r)
+    for a, b in zip(sorted(sql_rows, key=key), sorted(api_rows, key=key)):
+        assert len(a) == len(b), (qname, a, b)
+        for x, y in zip(a, b):
+            if isinstance(x, float) and isinstance(y, float):
+                if math.isnan(x) or math.isnan(y):
+                    assert math.isnan(x) and math.isnan(y), (qname, a, b)
+                else:
+                    assert abs(x - y) <= tol * max(1.0, abs(x), abs(y)), \
+                        (qname, a, b)
+            else:
+                assert x == y, (qname, a, b)
+
+
+@pytest.mark.parametrize("qname", sorted(TPCH_SQL, key=lambda q: int(q[1:])))
+def test_sql_tpch_text(qname):
+    from spark_rapids_tpu.api.session import TpuSession
+
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    tables = datagen.register_tables(s, _SF)
+    sql_rows = s.sql(TPCH_SQL[qname]).collect()
+    api_rows = Q.QUERIES[qname](tables).collect()
+    _cmp_rows(sql_rows, api_rows, qname)

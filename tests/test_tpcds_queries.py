@@ -42,8 +42,10 @@ def test_cube_golden():
         approx=1e-9, ignore_order=True)
 
 
-@pytest.mark.parametrize("qname", ["tpcxbb_q06", "tpcxbb_q09",
-                                   "tpcxbb_q30"])
+from benchmarks import tpcxbb_queries as _XBB
+
+
+@pytest.mark.parametrize("qname", sorted(_XBB.TPCXBB_QUERIES))
 def test_tpcxbb_query_golden(qname):
     """TPCxBB-like suite (BASELINE milestone 3; the reference's
     TpcxbbLikeSpark analog) over the TPC-DS-like retail tables."""
