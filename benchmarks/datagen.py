@@ -453,6 +453,8 @@ def _returns_channel(n: int, rng, key_prefix: str, n_units: int,
     return {
         f"{key_prefix}_returned_date_sk": (
             _D_DATE_BASE + rng.integers(0, date_span, n)).astype(np.int64),
+        f"{key_prefix}_order_number": rng.integers(
+            1, max(n * RETURN_FRACTION // 4, 2), n).astype(np.int64),
         f"{key_prefix}_customer_sk": rng.integers(
             1, DS_CUSTOMER_PER_SF + 1, n).astype(np.int64),
         f"{key_prefix}_item_sk": rng.integers(

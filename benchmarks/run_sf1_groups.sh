@@ -1,0 +1,15 @@
+#!/bin/bash
+# SF1 verified correctness gate, banked in groups (single-core machine:
+# one group at a time, each writes its own report as it completes).
+cd /root/repo
+for grp in "q1,q3,q4,q6,q12,q14,q15,q19,q22:fast" \
+           "q5,q10,q2,q7,q8,q11,q16,q17,q20:mid" \
+           "q13,q18:med2" "q9:q9" "q21:q21"; do
+  qs="${grp%%:*}"; name="${grp##*:}"
+  echo "=== $name start $(date +%H:%M) ==="
+  PYTHONPATH= JAX_PLATFORMS=cpu timeout 4800 python -m benchmarks.runner \
+    --sf 1 --queries "$qs" --iterations 1 --verify \
+    --output "benchmarks/reports/tpch_sf1_${name}_r5.json" \
+    > /dev/null 2>&1
+  echo "=== $name rc=$? done $(date +%H:%M) ==="
+done

@@ -582,7 +582,381 @@ def tpcds_q96(t):
             .agg(F.count("*").alias("cnt")))
 
 
+def tpcds_q6(t):
+    """States with many buyers of premium-priced items in one month
+    (TpcdsLikeSpark Query6: category-average price subquery joined
+    back)."""
+    cat_avg = (t["item"]
+               .groupBy("i_category")
+               .agg((F.avg("i_current_price") * 1.2).alias("price_bar"))
+               .withColumnRenamed("i_category", "avg_cat"))
+    prem = (t["item"]
+            .join(cat_avg, on=(col("i_category") == col("avg_cat")))
+            .filter(col("i_current_price") > col("price_bar"))
+            .select(col("i_item_sk").alias("prem_item")))
+    d = t["date_dim"].filter((col("d_year") == lit(2000)) &
+                             (col("d_moy") == lit(1)))
+    return (t["store_sales"]
+            .join(prem, on=(col("ss_item_sk") == col("prem_item")),
+                  how="left_semi")
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .join(t["customer_address"],
+                  on=(col("c_current_addr_sk") == col("ca_address_sk")))
+            .groupBy("ca_state")
+            .agg(F.count("*").alias("cnt"))
+            .filter(col("cnt") >= lit(10))
+            .orderBy(col("cnt").asc(), col("ca_state").asc())
+            .limit(100))
+
+
+def tpcds_q13(t):
+    """Single-row averages under OR'd demographic/geography bands
+    (TpcdsLikeSpark Query13)."""
+    d = t["date_dim"].filter(col("d_year") == lit(2001))
+    demo = (
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("Advanced Degree")) &
+         (col("ss_sales_price") >= lit(100)) &
+         (col("ss_sales_price") <= lit(150)) &
+         (col("hd_dep_count") == lit(3))) |
+        ((col("cd_marital_status") == lit("S")) &
+         (col("cd_education_status") == lit("College")) &
+         (col("ss_sales_price") >= lit(50)) &
+         (col("ss_sales_price") <= lit(100)) &
+         (col("hd_dep_count") == lit(1))) |
+        ((col("cd_marital_status") == lit("W")) &
+         (col("cd_education_status") == lit("2 yr Degree")) &
+         (col("ss_sales_price") >= lit(150)) &
+         (col("ss_sales_price") <= lit(200)) &
+         (col("hd_dep_count") == lit(1))))
+    geo = (
+        (col("ca_state").isin("TX", "OH", "MI") &
+         (col("ss_net_profit") >= lit(100)) &
+         (col("ss_net_profit") <= lit(200))) |
+        (col("ca_state").isin("OR", "MN", "KS") &
+         (col("ss_net_profit") >= lit(150)) &
+         (col("ss_net_profit") <= lit(300))) |
+        (col("ca_state").isin("VA", "CA", "MS") &
+         (col("ss_net_profit") >= lit(50)) &
+         (col("ss_net_profit") <= lit(250))))
+    return (t["store_sales"]
+            .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk")))
+            .join(t["customer_demographics"],
+                  on=(col("ss_cdemo_sk") == col("cd_demo_sk")))
+            .join(t["household_demographics"],
+                  on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+            .join(t["customer_address"],
+                  on=(col("ss_addr_sk") == col("ca_address_sk")))
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .filter(demo & geo)
+            .agg(F.avg("ss_quantity").alias("avg_qty"),
+                 F.avg("ss_ext_sales_price").alias("avg_price"),
+                 F.avg("ss_wholesale_cost").alias("avg_cost"),
+                 F.sum("ss_wholesale_cost").alias("sum_cost")))
+
+
+def _sales_returns_catalog_chain(t, agg_cols):
+    """q25/q29 shared shape: store sale -> its return (same basket/item)
+    -> a catalog re-purchase by the same customer of the same item."""
+    ss = t["store_sales"]
+    sr = t["store_returns"]
+    cs = t["catalog_sales"]
+    j = (ss.join(sr, on=[col("ss_order_number") == col("sr_order_number"),
+                         col("ss_item_sk") == col("sr_item_sk")])
+         .join(cs, on=[col("sr_customer_sk") == col("cs_customer_sk"),
+                       col("sr_item_sk") == col("cs_item_sk")])
+         .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk")))
+         .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk"))))
+    return (j.groupBy("i_item_id", "i_brand", "s_store_id")
+            .agg(*agg_cols)
+            .orderBy(col("i_item_id").asc(), col("i_brand").asc(),
+                     col("s_store_id").asc())
+            .limit(100))
+
+
+def tpcds_q25(t):
+    """Profit across the sale->return->catalog-repurchase chain
+    (TpcdsLikeSpark Query25)."""
+    return _sales_returns_catalog_chain(t, [
+        F.sum("ss_net_profit").alias("store_profit"),
+        F.sum("sr_net_loss").alias("return_loss"),
+        F.sum("cs_net_profit").alias("catalog_profit")])
+
+
+def tpcds_q29(t):
+    """Quantities across the sale->return->catalog-repurchase chain
+    (TpcdsLikeSpark Query29)."""
+    return _sales_returns_catalog_chain(t, [
+        F.sum("ss_quantity").alias("store_qty"),
+        F.sum("sr_return_quantity").alias("return_qty"),
+        F.sum("cs_quantity").alias("catalog_qty")])
+
+
+def tpcds_q27(t):
+    """Demographic item averages rolled up over states (TpcdsLikeSpark
+    Query27: the q7 shape + ROLLUP(i_item_id, s_state))."""
+    cd = t["customer_demographics"].filter(
+        (col("cd_gender") == lit("F")) &
+        (col("cd_marital_status") == lit("D")) &
+        (col("cd_education_status") == lit("Primary")))
+    d = t["date_dim"].filter(col("d_year") == lit(1999))
+    s = t["store"].filter(col("s_state").isin("CA", "TX", "NY", "OH"))
+    return (t["store_sales"]
+            .join(cd, on=(col("ss_cdemo_sk") == col("cd_demo_sk")))
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(s, on=(col("ss_unit_sk") == col("s_store_sk")))
+            .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk")))
+            .rollup("i_item_id", "s_state")
+            .agg(F.avg("ss_quantity").alias("agg1"),
+                 F.avg("ss_list_price").alias("agg2"),
+                 F.avg("ss_coupon_amt").alias("agg3"),
+                 F.avg("ss_sales_price").alias("agg4"))
+            .orderBy(col("i_item_id").asc_nulls_last(),
+                     col("s_state").asc_nulls_last())
+            .limit(100))
+
+
+def tpcds_q34(t):
+    """Mid-size baskets at month edges under buy-potential filters
+    (TpcdsLikeSpark Query34; count band adapted to the generator's
+    ~4-line baskets)."""
+    d = t["date_dim"].filter(
+        ((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(3)) |
+         (col("d_dom") >= lit(25)) & (col("d_dom") <= lit(28))) &
+        col("d_year").isin(1998, 1999, 2000))
+    hd = t["household_demographics"].filter(
+        col("hd_buy_potential").isin(">10000", "Unknown") &
+        (col("hd_vehicle_count") > lit(0)))
+    baskets = (t["store_sales"]
+               .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+               .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+               .groupBy("ss_order_number", "ss_customer_sk")
+               .agg(F.count("*").alias("cnt"))
+               .filter((col("cnt") >= lit(2)) & (col("cnt") <= lit(5))))
+    return (baskets
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .select(col("c_last_name"), col("c_first_name"),
+                    col("c_preferred_cust_flag"), col("ss_order_number"),
+                    col("cnt"))
+            .orderBy(col("c_last_name").asc(), col("c_first_name").asc(),
+                     col("c_preferred_cust_flag").asc(),
+                     col("ss_order_number").asc(), col("cnt").asc())
+            .limit(100))
+
+
+def tpcds_q36(t):
+    """Gross-margin rollup over category/class (TpcdsLikeSpark
+    Query36)."""
+    d = t["date_dim"].filter(col("d_year") == lit(2000))
+    s = t["store"].filter(col("s_state").isin("CA", "TX", "NY", "OH",
+                                              "FL", "IL", "GA", "MI"))
+    return (t["store_sales"]
+            .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+            .join(t["item"], on=(col("ss_item_sk") == col("i_item_sk")))
+            .join(s, on=(col("ss_unit_sk") == col("s_store_sk")))
+            .rollup("i_category", "i_class")
+            .agg((F.sum("ss_net_profit") /
+                  F.sum("ss_ext_sales_price")).alias("gross_margin"))
+            .orderBy(col("i_category").asc_nulls_last(),
+                     col("i_class").asc_nulls_last(),
+                     col("gross_margin").asc())
+            .limit(100))
+
+
+def tpcds_q46(t):
+    """Weekend baskets in selected cities where the bought city differs
+    from the customer's (TpcdsLikeSpark Query46: the q68 shape with
+    day-of-week + city filters)."""
+    d = t["date_dim"].filter(col("d_dow").isin(6, 0) &
+                             col("d_year").isin(1998, 1999, 2000))
+    s = t["store"].filter(col("s_city").isin("Fairview", "Midway",
+                                             "Salem", "Union"))
+    hd = t["household_demographics"].filter(
+        (col("hd_dep_count") == lit(4)) | (col("hd_vehicle_count") == lit(3)))
+    bought = t["customer_address"].select(
+        col("ca_address_sk").alias("b_addr_sk"),
+        col("ca_city").alias("bought_city"))
+    current = t["customer_address"].select(
+        col("ca_address_sk").alias("cur_addr_sk"),
+        col("ca_city").alias("current_city"))
+    baskets = (t["store_sales"]
+               .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+               .join(s, on=(col("ss_unit_sk") == col("s_store_sk")))
+               .join(hd, on=(col("ss_hdemo_sk") == col("hd_demo_sk")))
+               .join(bought, on=(col("ss_addr_sk") == col("b_addr_sk")))
+               .groupBy("ss_order_number", "ss_customer_sk", "bought_city")
+               .agg(F.sum("ss_coupon_amt").alias("amt"),
+                    F.sum("ss_net_profit").alias("profit")))
+    return (baskets
+            .join(t["customer"],
+                  on=(col("ss_customer_sk") == col("c_customer_sk")))
+            .join(current,
+                  on=(col("c_current_addr_sk") == col("cur_addr_sk")))
+            .filter(col("current_city") != col("bought_city"))
+            .select(col("c_last_name"), col("c_first_name"),
+                    col("current_city"), col("bought_city"),
+                    col("ss_order_number"), col("amt"), col("profit"))
+            .orderBy(col("c_last_name").asc(), col("c_first_name").asc(),
+                     col("ss_order_number").asc(), col("bought_city").asc(),
+                     col("amt").asc())
+            .limit(100))
+
+
+def tpcds_q50(t):
+    """Return-latency bands per store (TpcdsLikeSpark Query50: CASE sums
+    over sold->returned day gaps)."""
+    j = (t["store_sales"]
+         .join(t["store_returns"],
+               on=[col("ss_order_number") == col("sr_order_number"),
+                   col("ss_item_sk") == col("sr_item_sk"),
+                   col("ss_customer_sk") == col("sr_customer_sk")])
+         .join(t["store"], on=(col("ss_unit_sk") == col("s_store_sk"))))
+    gap = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+
+    def band(cond, name):
+        return F.sum(F.when(cond, lit(1)).otherwise(lit(0))).alias(name)
+    return (j.groupBy("s_store_id", "s_city", "s_state")
+            .agg(band(gap <= lit(30), "d30"),
+                 band((gap > lit(30)) & (gap <= lit(60)), "d60"),
+                 band((gap > lit(60)) & (gap <= lit(90)), "d90"),
+                 band((gap > lit(90)) & (gap <= lit(120)), "d120"),
+                 band(gap > lit(120), "dmore"))
+            .orderBy(col("s_store_id").asc())
+            .limit(100))
+
+
+def tpcds_q71(t):
+    """Brand revenue by hour across the three channels for one month
+    (TpcdsLikeSpark Query71: time_dim union star)."""
+    d = t["date_dim"].filter((col("d_moy") == lit(11)) &
+                             (col("d_year") == lit(1999)))
+    i = t["item"].filter(col("i_manager_id") == lit(1))
+    td = t["time_dim"].filter(col("t_hour").isin(8, 9, 17, 18))
+
+    def channel(sales, pfx):
+        return (sales
+                .join(d, on=(col(f"{pfx}_sold_date_sk") == col("d_date_sk")))
+                .select(col(f"{pfx}_item_sk").alias("sold_item_sk"),
+                        col(f"{pfx}_ext_sales_price").alias("ext_price"),
+                        col(f"{pfx}_sold_time_sk").alias("time_sk")))
+    u = (channel(t["web_sales"], "ws")
+         .union(channel(t["catalog_sales"], "cs"))
+         .union(channel(t["store_sales"], "ss")))
+    return (u.join(i, on=(col("sold_item_sk") == col("i_item_sk")))
+            .join(td, on=(col("time_sk") == col("t_time_sk")))
+            .groupBy("i_brand_id", "i_brand", "t_hour", "t_minute")
+            .agg(F.sum("ext_price").alias("ext_price"))
+            .orderBy(col("ext_price").desc(), col("i_brand_id").asc(),
+                     col("t_hour").asc(), col("t_minute").asc())
+            .limit(100))
+
+
+def tpcds_q76(t):
+    """Channel/category/year counts and sums over a three-channel union
+    (TpcdsLikeSpark Query76's union-report shape; the generator has no
+    NULL fk columns, so the filter keys off promo channels instead)."""
+    def channel(sales, pfx, name):
+        d = t["date_dim"]
+        p = t["promotion"].filter(col("p_channel_email") == lit("N"))
+        return (sales
+                .join(p, on=(col(f"{pfx}_promo_sk") == col("p_promo_sk")),
+                      how="left_semi")
+                .join(d, on=(col(f"{pfx}_sold_date_sk") == col("d_date_sk")))
+                .join(t["item"],
+                      on=(col(f"{pfx}_item_sk") == col("i_item_sk")))
+                .select(lit(name).alias("channel"), col("d_year"),
+                        col("d_qoy"), col("i_category"),
+                        col(f"{pfx}_ext_sales_price").alias("ext_price")))
+    u = (channel(t["store_sales"], "ss", "store")
+         .union(channel(t["web_sales"], "ws", "web"))
+         .union(channel(t["catalog_sales"], "cs", "catalog")))
+    return (u.groupBy("channel", "d_year", "d_qoy", "i_category")
+            .agg(F.count("*").alias("sales_cnt"),
+                 F.sum("ext_price").alias("sales_amt"))
+            .orderBy(col("channel").asc(), col("d_year").asc(),
+                     col("d_qoy").asc(), col("i_category").asc())
+            .limit(100))
+
+
+def tpcds_q89(t):
+    """Monthly class sales vs the store/category average: a windowed
+    deviation report (TpcdsLikeSpark Query89 — avg OVER (PARTITION BY
+    category, brand, store))."""
+    from spark_rapids_tpu.api.window import Window
+    d = t["date_dim"].filter(col("d_year") == lit(1999))
+    i = t["item"].filter(col("i_category").isin("Books", "Electronics",
+                                                "Sports"))
+    monthly = (t["store_sales"]
+               .join(d, on=(col("ss_sold_date_sk") == col("d_date_sk")))
+               .join(i, on=(col("ss_item_sk") == col("i_item_sk")))
+               .join(t["store"],
+                     on=(col("ss_unit_sk") == col("s_store_sk")))
+               .groupBy("i_category", "i_class", "i_brand", "s_store_id",
+                        "d_moy")
+               .agg(F.sum("ss_sales_price").alias("sum_sales")))
+    w = Window.partitionBy("i_category", "i_brand", "s_store_id")
+    out = monthly.select(
+        col("i_category"), col("i_class"), col("i_brand"),
+        col("s_store_id"), col("d_moy"), col("sum_sales"),
+        F.avg("sum_sales").over(w).alias("avg_monthly_sales"))
+    dev = (col("sum_sales") - col("avg_monthly_sales"))
+    return (out.filter((dev > col("avg_monthly_sales") * 0.1) |
+                       (dev < col("avg_monthly_sales") * -0.1))
+            .orderBy(col("i_category").asc(), col("i_class").asc(),
+                     col("i_brand").asc(), col("s_store_id").asc(),
+                     col("d_moy").asc())
+            .limit(100))
+
+
+def tpcds_q90(t):
+    """AM/PM web-sales ratio under dependent-count filters
+    (TpcdsLikeSpark Query90: two scalar counts cross-joined)."""
+    hd = t["household_demographics"].filter(col("hd_dep_count") == lit(6))
+
+    def half(h_lo, h_hi, name):
+        td = t["time_dim"].filter((col("t_hour") >= lit(h_lo)) &
+                                  (col("t_hour") <= lit(h_hi)))
+        return (t["web_sales"]
+                .join(hd, on=(col("ws_hdemo_sk") == col("hd_demo_sk")))
+                .join(td, on=(col("ws_sold_time_sk") == col("t_time_sk")))
+                .agg(F.count("*").alias(name)))
+    return (half(8, 9, "amc").crossJoin(half(19, 20, "pmc"))
+            .select((col("amc").cast("double") /
+                     col("pmc")).alias("am_pm_ratio")))
+
+
+def tpcds_q93(t):
+    """Effective sales after returns adjustment (TpcdsLikeSpark Query93:
+    store_sales LEFT JOIN its returns on basket+item; returned quantity
+    subtracts)."""
+    sr = t["store_returns"].select(
+        col("sr_order_number").alias("r_order"),
+        col("sr_item_sk").alias("r_item"),
+        col("sr_return_quantity"))
+    j = t["store_sales"].join(
+        sr, on=[col("ss_order_number") == col("r_order"),
+                col("ss_item_sk") == col("r_item")], how="left")
+    act = F.when(col("sr_return_quantity").isNotNull(),
+                 (col("ss_quantity") - col("sr_return_quantity")) *
+                 col("ss_sales_price")) \
+        .otherwise(col("ss_quantity") * col("ss_sales_price"))
+    return (j.groupBy("ss_customer_sk")
+            .agg(F.sum(act).alias("sumsales"))
+            .orderBy(col("sumsales").desc(), col("ss_customer_sk").asc())
+            .limit(100))
+
+
 TPCDS_QUERIES = {"tpcds_q3": tpcds_q3, "tpcds_q5": tpcds_q5,
+                 "tpcds_q6": tpcds_q6, "tpcds_q13": tpcds_q13,
+                 "tpcds_q25": tpcds_q25, "tpcds_q27": tpcds_q27,
+                 "tpcds_q29": tpcds_q29, "tpcds_q34": tpcds_q34,
+                 "tpcds_q36": tpcds_q36, "tpcds_q46": tpcds_q46,
+                 "tpcds_q50": tpcds_q50, "tpcds_q71": tpcds_q71,
+                 "tpcds_q76": tpcds_q76, "tpcds_q89": tpcds_q89,
+                 "tpcds_q90": tpcds_q90, "tpcds_q93": tpcds_q93,
                  "tpcds_q7": tpcds_q7, "tpcds_q12": tpcds_q12,
                  "tpcds_q15": tpcds_q15, "tpcds_q19": tpcds_q19,
                  "tpcds_q20": tpcds_q20, "tpcds_q26": tpcds_q26,
