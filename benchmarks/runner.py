@@ -21,10 +21,19 @@ from . import datagen, queries as Q
 
 def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                   iterations: int = 2, verify: bool = False,
-                  output: Optional[str] = None, suite: str = "tpch") -> Dict:
+                  output: Optional[str] = None, suite: str = "tpch",
+                  concurrent_tasks: Optional[int] = None) -> Dict:
+    import os
     from spark_rapids_tpu.api.session import TpuSession
+    if concurrent_tasks is None:
+        # pin device admission to host parallelism: the engine default (2)
+        # under a 4-thread task pool makes CPU-backend reports measure
+        # semaphore admission thrash instead of engine time
+        concurrent_tasks = os.cpu_count() or 4
     session = TpuSession.builder.config(
-        "spark.rapids.tpu.sql.explain", "NONE").getOrCreate()
+        "spark.rapids.tpu.sql.explain", "NONE").config(
+        "spark.rapids.tpu.sql.concurrentTpuTasks",
+        concurrent_tasks).getOrCreate()
 
     if suite == "tpcds":
         from . import tpcds_queries
@@ -42,23 +51,34 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
     gen_s = time.perf_counter() - t_gen0
 
     report: Dict = {"suite": suite, "sf": sf, "datagen_s": round(gen_s, 3),
+                    "concurrentTpuTasks": concurrent_tasks,
                     "queries": {}}
     names = query_names or list(queries)
     for name in names:
+        from spark_rapids_tpu.exec.device import TpuSemaphore
         qfn = queries[name]
         timings = []
         rows = 0
+        sem0 = TpuSemaphore.get().stats()
         for it in range(iterations):
             t0 = time.perf_counter()
             df = qfn(tables)
             batch = df.collect_batch().fetch_to_host()
             rows = batch.num_rows
             timings.append(round(time.perf_counter() - t0, 4))
+        sem1 = TpuSemaphore.get().stats()
         entry = {
             "rows": rows,
             "cold_s": timings[0],
             "hot_s": min(timings[1:]) if len(timings) > 1 else timings[0],
             "timings_s": timings,
+            # admission contention vs device occupancy, separable
+            # (wait = blocked acquiring a permit; hold = acquire->release)
+            "semaphore": {
+                "waitS": round(sem1["waitS"] - sem0["waitS"], 4),
+                "holdS": round(sem1["holdS"] - sem0["holdS"], 4),
+                "acquires": sem1["acquires"] - sem0["acquires"],
+            },
         }
         try:
             m = session.last_query_metrics()
@@ -113,11 +133,14 @@ def main():
     ap.add_argument("--iterations", type=int, default=2)
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--output", type=str, default=None)
+    ap.add_argument("--concurrent-tasks", type=int, default=None,
+                    help="concurrentTpuTasks (default: host cpu count)")
     args = ap.parse_args()
     report = run_benchmark(args.sf,
                            args.queries.split(",") if args.queries else None,
                            args.iterations, args.verify, args.output,
-                           suite=args.suite)
+                           suite=args.suite,
+                           concurrent_tasks=args.concurrent_tasks)
     print(json.dumps(report, indent=2))
 
 

@@ -176,7 +176,7 @@ class _SubqueryInfo:
     correlated conjuncts pulled out of its WHERE."""
 
     def __init__(self, parser, df, items, group_exprs, having, distinct,
-                 corr, orders, limit):
+                 corr, orders, limit, star_cols=None):
         self.parser = parser
         self.df = df
         self.items = items
@@ -186,13 +186,14 @@ class _SubqueryInfo:
         self.corr = corr
         self.orders = orders
         self.limit = limit
+        self.star_cols = star_cols
 
     def build_full(self):
         """Finish as a normal derived table (only valid uncorrelated)."""
         assert not self.corr
         return self.parser._finish(self.df, self.items, self.group_exprs,
                                    self.having, self.distinct, self.orders,
-                                   self.limit)
+                                   self.limit, self.star_cols)
 
 
 class _Parser:
@@ -262,6 +263,11 @@ class _Parser:
         items = self.parse_select_list()
         self.expect_kw("FROM")
         df = self.parse_from()
+        # `*` expands from the PRE-rewrite column list: subquery
+        # decorrelation (scalar-subquery LEFT joins) appends internal
+        # `__sqN_*` columns to df below, which must never leak into a
+        # user-visible star projection
+        star_cols = list(df.columns)
         corr: List[ex.Expression] = []
         if self.take_kw("WHERE"):
             scope.in_where = True
@@ -292,14 +298,15 @@ class _Parser:
         self._scopes.pop()
         if as_subquery:
             return _SubqueryInfo(self, df, items, group_exprs, having,
-                                 distinct, corr, orders, limit)
+                                 distinct, corr, orders, limit, star_cols)
         return self._finish(df, items, group_exprs, having, distinct,
-                            orders, limit)
+                            orders, limit, star_cols)
 
     def _finish(self, df, items, group_exprs, having, distinct, orders,
-                limit):
+                limit, star_cols=None):
         having = self._fold_scalar_subqueries(having)
-        df = self.build_projection(df, items, group_exprs, having)
+        df = self.build_projection(df, items, group_exprs, having,
+                                   star_cols)
         if distinct:
             df = df.distinct()
         if orders:
@@ -661,7 +668,8 @@ class _Parser:
             if not self.take_op(","):
                 return out
 
-    def build_projection(self, df, items, group_exprs, having):
+    def build_projection(self, df, items, group_exprs, having,
+                         star_cols=None):
         has_star = any(e == "*" for e, _ in items)
         exprs: List[ex.Expression] = []
         for e, alias in items:
@@ -671,10 +679,18 @@ class _Parser:
         is_agg = group_exprs is not None or any(
             _has_agg(e) for e in exprs)
         if not is_agg:
+            # star expands from the pre-rewrite column list: WHERE-clause
+            # subquery decorrelation appends internal __sqN_* columns that
+            # must not surface in the user-visible schema
+            base = [c for c in (star_cols if star_cols is not None
+                                else df.columns) if c in df.columns]
             if has_star and len(items) == 1:
-                return df
+                if list(df.columns) == base:
+                    return df
+                return df._df(lp.Project(
+                    df._plan, [ex.ColumnRef(c) for c in base]))
             if has_star:
-                cols = [ex.ColumnRef(c) for c in df.columns]
+                cols = [ex.ColumnRef(c) for c in base]
                 return df._df(lp.Project(df._plan, cols + exprs))
             return df.select(*[Col(e) for e in exprs])
         if has_star:

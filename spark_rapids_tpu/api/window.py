@@ -6,16 +6,30 @@ Catalyst, SURVEY.md §2.3 window expressions).
 Frame semantics: no ``orderBy`` -> whole-partition aggregate; with
 ``orderBy`` and no explicit frame -> rows UNBOUNDED PRECEDING..CURRENT ROW
 (Spark defaults to the RANGE form, which differs only on order-key ties —
-use ``rangeBetween`` explicitly when tie-peer inclusion matters)."""
+use ``rangeBetween`` explicitly when tie-peer inclusion matters).
+
+Because any order key can carry ties, applying this implicit ROWS default
+emits a :class:`DefaultRowsFrameWarning` (once per process): running
+aggregates over tied keys differ from Spark's peer-inclusive RANGE default
+— tied rows each see only the rows physically before them. Silence it by
+stating the frame explicitly (``rowsBetween``/``rangeBetween``) or with
+the standard ``warnings`` machinery."""
 
 from __future__ import annotations
 
 import sys
+import warnings
 from typing import List, Optional
 
 from ..ops import window as W
 from ..plan import logical as lp
 from .column import Col, _unwrap
+
+
+class DefaultRowsFrameWarning(UserWarning):
+    """An ordered window spec fell back to the implicit ROWS
+    UNBOUNDED PRECEDING..CURRENT ROW frame; Spark's default is the RANGE
+    (peer-inclusive) form, which differs on tied order keys."""
 
 
 class WindowSpec:
@@ -49,7 +63,19 @@ class WindowSpec:
     def _to_spec(self) -> W.WindowSpec:
         frame = self._frame
         if frame is None and self._order:
-            # Spark's default frame when ordered (rows form; see module doc)
+            # Spark's default frame when ordered (rows form; see module
+            # doc). Order keys may carry ties, where the ROWS form
+            # diverges from Spark's peer-inclusive RANGE default — warn
+            # through the standard machinery (its once-per-location
+            # default dedups, while 'always'/'error' filters still let
+            # users audit every implicit-frame call site)
+            warnings.warn(
+                "ordered window spec without an explicit frame uses "
+                "ROWS UNBOUNDED PRECEDING..CURRENT ROW; Spark's "
+                "default is the RANGE (peer-inclusive) form, which "
+                "differs on tied order keys — state the frame with "
+                "rowsBetween()/rangeBetween() to silence this",
+                DefaultRowsFrameWarning, stacklevel=3)
             frame = W.WindowFrame(None, 0, is_range=False)
         return W.WindowSpec(list(self._partition), list(self._order), frame)
 

@@ -392,6 +392,16 @@ AGG_PIPELINE_DEPTH = _conf("spark.rapids.tpu.sql.agg.pipelineDepth").doc(
     "batch per slot"
 ).integer_conf.check(lambda v: int(v) >= 1).create_with_default(48)
 
+JOIN_PIPELINE_DEPTH = _conf("spark.rapids.tpu.sql.join.pipelineDepth").doc(
+    "Stream batches whose join-output sizing scalars are kept in flight "
+    "before the oldest batch's gather is dispatched: the per-batch "
+    "device->host size readback (a full link round trip) resolves in ONE "
+    "batched read per half-window instead of one blocking read per batch, "
+    "making join-path host syncs O(1) per stage. 1 degenerates to "
+    "read-per-batch. Device residency grows by one stream batch's match "
+    "state per slot"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(16)
+
 READER_THREADS = _conf("spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads").doc(
     "Background decode threads for the MULTITHREADED reader "
     "(ref: RapidsConf.scala:548)").integer_conf.create_with_default(4)

@@ -1094,6 +1094,14 @@ def _exec_aggregate(plan: lp.Aggregate) -> pd.DataFrame:
 def _group_cell(v):
     if isinstance(v, float) and math.isnan(v):
         return ("nan",)
+    # struct/array cells surface as dicts/lists (unhashable): canonicalize
+    # recursively so CPU-fallback joins/group-bys on them can key a map
+    if isinstance(v, dict):
+        return ("dict",) + tuple(
+            (k, _group_cell(x))
+            for k, x in sorted(v.items(), key=lambda kv: repr(kv[0])))
+    if isinstance(v, (list, tuple)):
+        return ("seq",) + tuple(_group_cell(x) for x in v)
     return v
 
 

@@ -70,7 +70,16 @@ class TpuSemaphore:
     """Bounds the number of concurrently-executing device tasks
     (GpuSemaphore.scala:27-161). Ordering contract preserved from the
     reference: acquire only after the task's first input batch is ready
-    (i.e. after host-side IO/decode), release on task completion."""
+    (i.e. after host-side IO/decode), release on task completion.
+
+    Instrumented with a wait-vs-hold split: WAIT is the time a task blocks
+    acquiring a permit (admission contention — fixed by raising
+    concurrentTpuTasks), HOLD is acquire->release (device occupancy —
+    fixed by making the held work faster, e.g. pipelining its readbacks).
+    Both feed the per-query span report (``semaphore_wait`` /
+    ``semaphore_hold``) and cumulative counters the bench harness reads,
+    so the two failure modes are separable in reports instead of one
+    undifferentiated ``semaphore_acquire`` bucket."""
 
     _instance: Optional["TpuSemaphore"] = None
     _lock = threading.Lock()
@@ -79,6 +88,10 @@ class TpuSemaphore:
         self.max_concurrent = max_concurrent
         self._sem = threading.Semaphore(max_concurrent)
         self._held = threading.local()
+        self._stats_mu = threading.Lock()
+        self.wait_s = 0.0
+        self.hold_s = 0.0
+        self.acquires = 0
 
     @classmethod
     def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
@@ -99,17 +112,41 @@ class TpuSemaphore:
         with cls._lock:
             cls._instance = None
 
+    def stats(self) -> dict:
+        """Cumulative wait/hold seconds + acquire count (bench harness)."""
+        with self._stats_mu:
+            return {"waitS": round(self.wait_s, 4),
+                    "holdS": round(self.hold_s, 4),
+                    "acquires": self.acquires}
+
     def acquire_if_necessary(self) -> None:
         """Idempotent per-thread acquire (GpuSemaphore.acquireIfNecessary)."""
+        import time
+        from .tracing import record_span
         if getattr(self._held, "value", False):
             return
+        t0 = time.perf_counter()
         self._sem.acquire()
+        now = time.perf_counter()
+        waited = now - t0
         self._held.value = True
+        self._held.acquired_at = now
+        record_span("semaphore_wait", waited)
+        with self._stats_mu:
+            self.wait_s += waited
+            self.acquires += 1
 
     def release_if_necessary(self) -> None:
+        import time
+        from .tracing import record_span
         if getattr(self._held, "value", False):
+            held_for = time.perf_counter() - getattr(
+                self._held, "acquired_at", time.perf_counter())
             self._sem.release()
             self._held.value = False
+            record_span("semaphore_hold", held_for)
+            with self._stats_mu:
+                self.hold_s += held_for
 
     def __enter__(self):
         self.acquire_if_necessary()

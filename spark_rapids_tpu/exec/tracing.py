@@ -104,13 +104,34 @@ class SpanRecorder:
         return frame
 
     def _pop(self, frame, name, elapsed):
+        # remove THIS frame by identity, not the stack top: spans held open
+        # across generator yields (the pipelined join suspends mid-span)
+        # close out of order, and popping the top would steal an unrelated
+        # open frame — misattributing every enclosing span's self-time
         st = self._stack()
-        st.pop()
-        if st:
-            st[-1]["child_s"] += elapsed
+        idx = None
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is frame:
+                idx = i
+                break
+        if idx is not None:
+            del st[idx]
+            if idx > 0:
+                # elapsed counts as child time of the frame that was the
+                # parent at open time (the one below it), even if younger
+                # frames are still open above
+                st[idx - 1]["child_s"] += elapsed
         self_s = max(0.0, elapsed - frame["child_s"])
         with self._mu:
             self._self_s[name] += self_s
+            self._count[name] += 1
+
+    def add(self, name, seconds):
+        """Account an externally-timed interval as a leaf span (semaphore
+        hold time is measured acquire->release, which brackets yields and
+        cannot be a context-managed span)."""
+        with self._mu:
+            self._self_s[name] += seconds
             self._count[name] += 1
 
     def report(self) -> dict:
@@ -118,6 +139,14 @@ class SpanRecorder:
             return {name: {"selfS": round(s, 4), "count": self._count[name]}
                     for name, s in sorted(self._self_s.items(),
                                           key=lambda kv: -kv[1])}
+
+
+def record_span(name: str, seconds: float) -> None:
+    """Feed an externally-timed interval into the active recorder (no-op
+    when no query is recording)."""
+    rec = SpanRecorder.active
+    if rec is not None:
+        rec.add(name, seconds)
 
 
 def start_profiler_server(port: int = 9012) -> None:

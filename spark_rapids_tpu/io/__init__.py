@@ -192,7 +192,12 @@ def read_file_to_arrow(fmt: str, path: str, options: Dict[str, Any],
                        pschema: Optional[dt.Schema] = None):
     if fmt == "parquet":
         import pyarrow.parquet as pq
-        t = pq.read_table(path, columns=columns, filters=filters)
+        # partitioning=None: k=v dir segments are appended as typed
+        # columns by append_partition_columns below — pyarrow's own hive
+        # inference must stay off (it fails outright on an all-NULL
+        # partition dir, region=__HIVE_DEFAULT_PARTITION__)
+        t = pq.read_table(path, columns=columns, filters=filters,
+                          partitioning=None)
     elif fmt == "orc":
         import pyarrow.orc as orc
         t = orc.ORCFile(path).read(columns=columns)

@@ -12,7 +12,13 @@ hash tables but sorts fast, so all equality joins are sort-merge:
 
 Two-phase dynamic-size protocol (DESIGN.md): ``join_match`` returns the device
 total pair count; the host reads it, buckets an output capacity, and calls
-``join_gather`` — the same cadence as cuDF's size-returning join calls.
+``join_gather`` — the same cadence as cuDF's size-returning join calls. The
+exec layer PIPELINES the two phases (exec/pipeline.PipelineWindow): match
+dispatches for batches k+1..k+depth before batch k's size scalar resolves,
+and sizes land in batched readbacks, so the per-batch device->host round
+trip overlaps compute instead of serializing the stream. To keep the
+dispatch half sync-free, every ``n_build``/``n_stream`` argument here
+accepts a python int OR a device int scalar (all consumers are jnp ops).
 
 SQL semantics: NULL keys never match (null-aware anti join is handled at the
 exec level); Spark float semantics make NaN == NaN for joins, which the

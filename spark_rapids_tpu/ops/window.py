@@ -183,7 +183,7 @@ def rank_k(seg_ids: jnp.ndarray, starts: jnp.ndarray,
     idx = jnp.arange(capacity, dtype=jnp.int32)
     run_start = jnp.where(new_val, rn, 0)
     # propagate forward within ties: cummax over (new_val index)
-    last_new = jnp.maximum.accumulate(jnp.where(new_val, idx, -1))
+    last_new = jax.lax.cummax(jnp.where(new_val, idx, -1))
     return rn[jnp.clip(last_new, 0, capacity - 1)]
 
 
@@ -241,7 +241,6 @@ def running_agg(op: str, col: Column, seg_ids: jnp.ndarray,
             info = jnp.iinfo(col.data.dtype)
             fill = info.max if op == "min" else info.min
         d = jnp.where(contrib, col.data, jnp.asarray(fill, col.data.dtype))
-        acc = jnp.minimum.accumulate if op == "min" else jnp.maximum.accumulate
         # segment-aware scan: reset at starts by scanning a keyed trick —
         # compute global scan of (segment_id, value) pairs is complex; use
         # the associative_scan with a reset flag instead

@@ -290,8 +290,27 @@ class PlanMeta(BaseMeta):
             key_exprs = list(p.grouping)
         elif isinstance(p, lp.Repartition):
             key_exprs = list(getattr(p, "by", None) or [])
-        elif isinstance(p, lp.Join) and p.condition is not None:
-            key_exprs = [p.condition]
+        elif isinstance(p, lp.Join):
+            if p.condition is not None:
+                key_exprs = [p.condition]
+            if p.using:
+                # using-style joins (on=['col']) name their keys: the
+                # condition holds unresolved ColumnRef/_UsingRight nodes
+                # whose dtype the expression walk below cannot see, so
+                # resolve each name against the child schemas directly —
+                # struct keys must fall back, not crash device kernels
+                for ch in p.children:
+                    sch = ch.schema
+                    for cname in p.using:
+                        try:
+                            f = sch[cname]
+                        except Exception:
+                            continue
+                        if dt.is_struct(f.dtype):
+                            self.will_not_work(
+                                "struct-typed keys (sort/group/partition/"
+                                "join) are not supported on the device")
+                            break
         for e in key_exprs:
             try:
                 if e.collect(lambda x: dt.is_struct(x.dtype)
@@ -868,9 +887,11 @@ class Overrides:
             # local 'broadcast' would join against 1/N of it — the shuffled
             # path co-partitions both sides correctly over the transport
             from ..shuffle.exchange import TpuBroadcastExchangeExec
-            return ph.TpuSortMergeJoinExec(
+            j = ph.TpuSortMergeJoinExec(
                 stream, TpuBroadcastExchangeExec(build), how,
                 stream_keys, build_keys, residual)
+            j.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
+            return j
         from ..shuffle.exchange import TpuHashExchangeExec
         n = self.conf.shuffle_partitions
         # co-partitioning correctness: murmur3 is type-sensitive, so both
@@ -891,13 +912,17 @@ class Overrides:
         if mesh is not None:
             # SPMD co-partition: one fused all_to_all per side over ICI
             from ..parallel.mesh_exec import TpuMeshJoinExec
-            return TpuMeshJoinExec(stream, build, how, stream_keys,
-                                   build_keys, residual, mesh,
-                                   pk_stream, pk_build)
+            mj = TpuMeshJoinExec(stream, build, how, stream_keys,
+                                 build_keys, residual, mesh,
+                                 pk_stream, pk_build)
+            # inherits the pipelined per-pair join loop
+            mj.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
+            return mj
         j = ph.TpuShuffledJoinExec(
             TpuHashExchangeExec(stream, n, pk_stream),
             TpuHashExchangeExec(build, n, pk_build),
             how, stream_keys, build_keys, residual)
+        j.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
         if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and threshold >= 0:
             # AQE: estimates said shuffle; observed map-side sizes may
             # overrule at runtime (physical._maybe_runtime_broadcast).

@@ -335,12 +335,12 @@ def _task_begin() -> None:
     """Device admission at task (partition evaluation) start: the semaphore
     bounds concurrently-executing device tasks. Ordering contract preserved
     from the reference (GpuSemaphore.scala:74-78): acquire after host-side
-    input is ready, before device work. Traced like the reference's NVTX
-    span around the acquire (GpuSemaphore.scala:107)."""
+    input is ready, before device work. The semaphore itself records the
+    wait-vs-hold span split (``semaphore_wait`` / ``semaphore_hold``) —
+    the NVTX-range analog of GpuSemaphore.scala:107, but separable into
+    admission contention vs device occupancy."""
     from ..exec.device import TpuSemaphore
-    from ..exec.tracing import trace_span
-    with trace_span("semaphore_acquire"):
-        TpuSemaphore.get().acquire_if_necessary()
+    TpuSemaphore.get().acquire_if_necessary()
 
 
 def _reserve(nbytes: int) -> None:
@@ -1240,15 +1240,17 @@ class TpuHashAggregateExec(TpuExec):
         merge cadence). All state lives in the spill catalog between
         batches, so aggregation residency stays bounded.
 
-        The update phase is PIPELINED: each input batch's fused probe is
-        dispatched immediately (with async host copies of its stats), but
-        the kernel half only runs once the batch is ``pipelineDepth`` deep
-        in the window — by then the stat readback has landed, so the
-        per-batch device->host round-trip (hundreds of ms on a tunneled
-        device) overlaps compute instead of serializing the stream."""
-        from collections import deque
-
+        The update phase is PIPELINED on the shared deferred-scalar window
+        (exec/pipeline.PipelineWindow — the same primitive the join stream
+        loop uses): each input batch's fused probe is dispatched
+        immediately, its stats scalar parked on the window, and the kernel
+        half only runs once the window lands it — by then the stat
+        readback has resolved in ONE batched device_get with its
+        half-window peers, so the per-batch device->host round-trip
+        (hundreds of ms on a tunneled device) overlaps compute instead of
+        serializing the stream."""
         from .. import config as cfg
+        from ..exec.pipeline import PipelineWindow
         from ..exec.spill import SpillableColumnarBatch
         pschema = self._partial_schema()
         pending: List[SpillableColumnarBatch] = []
@@ -1269,45 +1271,25 @@ class TpuHashAggregateExec(TpuExec):
             pending.append(SpillableColumnarBatch(
                 self._merge_to_partial(merged_in)))
 
-        def land_oldest(k: int) -> None:
-            """Second half for the k oldest in-flight batches: ONE batched
-            device_get fetches their probe stats (a single host round-trip
-            instead of one blocking readback per batch), then each batch's
-            kernel dispatches. The younger half of the window keeps its
-            stats in flight, so by the time THEY land the transfers have
-            had a full window of dispatch work to hide behind."""
-            k = min(k, len(inflight))
-            stats_for = {}
-            reads = [it[2] for it in list(inflight)[:k]
-                     if it[0] == "tok" and it[2][0] in ("dense", "sortmm")]
-            if reads:
-                import jax
-                try:
-                    vals = jax.device_get([t[-1] for t in reads])
-                    for t, v in zip(reads, vals):
-                        stats_for[id(t)] = v
-                except Exception:
-                    # a dispatched probe failed at execution time: leave
-                    # stats unset — _fused_finish re-raises per batch and
-                    # its handler degrades that batch to the eager path
-                    pass
-            for _ in range(k):
-                item = inflight.popleft()
-                if item[0] == "pb":
-                    pb = item[1]
-                else:
-                    _tag, batch, tok = item
-                    pb = self._fused_finish(tok, stats_for.get(id(tok)))
-                    pb = self._shrink_partial(pb) if pb is not None and \
-                        pb.capacity > agg_k.DENSE_MAX_SLOTS else pb
-                    if pb is None:
-                        pb = self._update_partial_eager(batch)
-                pending.append(SpillableColumnarBatch(pb))
+        def bank(pb: ColumnarBatch) -> None:
+            pending.append(SpillableColumnarBatch(pb))
             if len(pending) >= self.MERGE_FAN_IN:
                 merge_pending()
 
+        def finish(batch: ColumnarBatch, tok, stats=None) -> ColumnarBatch:
+            """Kernel half for one landed batch: ``stats`` is the
+            window-resolved probe readback (None if the batched get
+            failed — _fused_finish then re-reads and its handler degrades
+            this one batch to the eager path)."""
+            pb = self._fused_finish(tok, stats)
+            if pb is not None and pb.capacity > agg_k.DENSE_MAX_SLOTS:
+                pb = self._shrink_partial(pb)
+            if pb is None:
+                pb = self._update_partial_eager(batch)
+            return pb
+
         depth = max(1, int(cfg.TpuConf().get(cfg.AGG_PIPELINE_DEPTH)))
-        inflight: deque = deque()
+        win = PipelineWindow(depth)
         for batch in batches:
             # semaphore ordering contract: acquire only once the first input
             # batch exists (upstream host IO done), GpuSemaphore.scala:74-78
@@ -1315,19 +1297,27 @@ class TpuHashAggregateExec(TpuExec):
             _reserve(batch.device_size_bytes())
             with trace_span("aggregate", self.metrics, "computeAggTime"):
                 if self.mode == "final":
-                    inflight.append(("pb", batch))
+                    ready = win.push(lambda b=batch: b)
                 else:
                     tok = self._fused_dispatch(batch, "update")
                     if tok is None:
-                        inflight.append(
-                            ("pb", self._update_partial_eager(batch)))
+                        pb = self._update_partial_eager(batch)
+                        ready = win.push(lambda p=pb: p)
+                    elif tok[0] in ("dense", "sortmm"):
+                        # park the probe stats scalar on the window
+                        ready = win.push(
+                            lambda v, b=batch, t=tok: finish(b, t, v),
+                            tok[-1])
                     else:
-                        inflight.append(("tok", batch, tok))
-                if len(inflight) >= depth:
-                    land_oldest(max(depth // 2, 1))
+                        # 'done': whole kernel already dispatched, count
+                        # device-resident — nothing to resolve
+                        ready = win.push(
+                            lambda b=batch, t=tok: finish(b, t))
+                for pb in ready:
+                    bank(pb)
         with trace_span("aggregate", self.metrics, "computeAggTime"):
-            while inflight:
-                land_oldest(max(depth // 2, 1))
+            for pb in win.flush():
+                bank(pb)
             merge_pending()
         if not pending:
             final_in = ColumnarBatch.empty(pschema)
@@ -1507,8 +1497,8 @@ class TpuHashAggregateExec(TpuExec):
     def _fused_dispatch(self, batch: ColumnarBatch, phase: str):
         """First half of the fused phase: dispatch the probe (or, where no
         probe is needed, the whole kernel) without any blocking sync. The
-        streaming loop keeps a window of these in flight and fetches every
-        pending probe's stats in one batched device_get (land_oldest).
+        streaming loop parks these on the shared PipelineWindow, which
+        fetches every landing probe's stats in one batched readback.
         Returns an opaque token for `_fused_finish`, or None -> eager."""
         if getattr(self, "_fusion_broken", False) or not _fusion_enabled(self):
             return None
@@ -2517,45 +2507,94 @@ class TpuSortMergeJoinExec(TpuExec):
             h.close()
             self._build_handle = None
 
+    def _pipeline_depth(self) -> int:
+        """Join pipeline window depth: planner-set override (the session
+        conf wired through overrides) or the global conf default."""
+        d = getattr(self, "pipeline_depth", None)
+        if d is None:
+            from .. import config as cfg
+            d = cfg.TpuConf().get(cfg.JOIN_PIPELINE_DEPTH)
+        return max(1, int(d))
+
     def _join_part(self, part: Partition,
                    build_handle: "SpillableColumnarBatch") -> Partition:
         # full outer: execute() has already merged the whole stream side into
         # this one partition as a single (possibly empty) batch
+        from ..exec.pipeline import PipelineWindow
+        import jax.numpy as jnp
         _task_begin()
         build = build_handle.get_batch()
         bkey_cols = [ex.materialize(e.eval(build), build)
                      for e in self.right_keys]
+
+        # PIPELINED stream loop (the reference's per-batch join stream loop
+        # has no host sync at all, GpuHashJoin.scala:193-249): join_match
+        # for batches k+1..k+depth dispatches before batch k's gather
+        # sizing resolves; the window lands half a depth of size scalars
+        # per batched readback, so join-path host syncs are O(1) per stage
+        # instead of one blocking RTT per stream batch.
+        win = PipelineWindow(self._pipeline_depth())
         for batch in part:
+            # admission: up to `depth` stream batches (+ match state) stay
+            # device-resident while their sizing scalars are in flight —
+            # account each to the spill manager like the aggregate window
+            _reserve(batch.device_size_bytes())
             with trace_span("join", self.metrics, "joinTime"):
                 skey_cols = [ex.materialize(e.eval(batch), batch)
                              for e in self.left_keys]
                 how = self.how if self.how in (
                     "inner", "left", "left_semi", "left_anti") else (
                     "left" if self.how == "full" else "inner")
-                m = join_k.join_match(bkey_cols, build.num_rows,
-                                      skey_cols, batch.num_rows, batch.capacity)
-                # ONE batched scalar readback sizes the static output
-                # bucket (left-outer's emit total computes on DEVICE — a
-                # full per-row counts download costs ~capacity bytes over
-                # a slow link)
-                import jax
-                import jax.numpy as jnp
+                m = join_k.join_match(bkey_cols, build.num_rows_raw,
+                                      skey_cols, batch.num_rows_raw,
+                                      batch.capacity)
                 if how in ("left_semi", "left_anti"):
                     # semi/anti outputs compact at STREAM capacity —
-                    # join_gather ignores out_capacity, so no readback
-                    out_cap = batch.capacity
-                elif how == "left":
-                    live = batch.row_mask_raw()
-                    left_total = jnp.sum(
-                        jnp.where(live, jnp.maximum(m.count, 1), 0))
-                    total = int(jax.device_get(left_total))
-                    out_cap = bucket(max(total, 1))
+                    # join_gather ignores out_capacity, so no size scalar:
+                    # the entry rides through the window immediately
+                    cont = (lambda b=batch, mm=m, h=how:
+                            self._join_finish(build, b, mm, h, None, None))
+                    scalars = ()
                 else:
-                    total = int(jax.device_get(m.total_pairs))
-                    out_cap = bucket(max(total, 1))
-                s_out, b_out, cnt = join_k.join_gather(
-                    m, batch.columns, build.columns, out_cap, how,
-                    n_stream=batch.num_rows)
+                    # the sizing scalar stays in flight on the window
+                    # (left-outer's emit total computes on DEVICE — a full
+                    # per-row counts download costs ~capacity bytes over a
+                    # slow link)
+                    if how == "left":
+                        live = batch.row_mask_raw()
+                        size_dev = jnp.sum(
+                            jnp.where(live, jnp.maximum(m.count, 1), 0))
+                    else:
+                        size_dev = m.total_pairs
+                    cont = (lambda total, b=batch, mm=m, h=how, sd=size_dev:
+                            self._join_finish(build, b, mm, h, sd, total))
+                    scalars = (size_dev,)
+            # push OUTSIDE the dispatch span: a landing runs _join_finish's
+            # own metered "join" span, which must be a sibling (the two
+            # halves SUM into joinTime), never nested (it would double-count)
+            for outs in win.push(cont, *scalars):
+                yield from outs
+        for outs in win.flush():
+            yield from outs
+
+    def _join_finish(self, build: ColumnarBatch, batch: ColumnarBatch,
+                     m, how: str, size_dev, total) -> List[ColumnarBatch]:
+        """Second half of one stream batch's join: gather at the
+        host-sized output bucket. Runs when the pipeline window resolves
+        this batch's sizing scalar; returns the output batches."""
+        import jax
+        with trace_span("join", self.metrics, "joinTime"):
+            if how in ("left_semi", "left_anti"):
+                out_cap = batch.capacity
+            else:
+                if total is None:
+                    # window-degraded entry (batched readback failed):
+                    # re-read this batch's scalar alone
+                    total = jax.device_get(size_dev)
+                out_cap = bucket(max(int(total), 1))
+            s_out, b_out, cnt = join_k.join_gather(
+                m, batch.columns, build.columns, out_cap, how,
+                n_stream=batch.num_rows_raw)
             # the output count stays device-resident; downstream boundaries
             # resolve it in batched readbacks (possibly-empty batches flow)
             if self.how in ("left_semi", "left_anti"):
@@ -2570,23 +2609,22 @@ class TpuSortMergeJoinExec(TpuExec):
                 keep = pred.data & pred.validity & out.row_mask_raw()
                 cols, count = K.compact_columns(out.columns, keep)
                 out = ColumnarBatch(self._out_schema, cols, count)
-            # counts are device-resident here: possibly-empty batches flow
-            # and downstream boundaries drop them after a batched resolve
             self.metrics.inc("numOutputRows", out.num_rows_raw)
-            yield out
+            outs = [out]
             if self.how == "full":
-                # append unmatched build rows with NULL left columns
+                # append unmatched build rows with NULL left columns; the
+                # count stays device-resident too (the tail's former
+                # blocking `int(ucnt)` was one more RTT per stage)
                 un_cols, ucnt = join_k.unmatched_build_gather(
-                    m, build.columns, build.num_rows)
-                un = int(ucnt)
-                if un > 0:
-                    left_nulls = [
-                        Column.full_null(f.dtype, un_cols[0].capacity)
-                        for f in self.children[0].schema]
-                    uout = ColumnarBatch(self._out_schema,
-                                         left_nulls + un_cols, un)
-                    self.metrics.inc("numOutputRows", un)
-                    yield uout
+                    m, build.columns, build.num_rows_raw)
+                ucap = un_cols[0].capacity if un_cols else build.capacity
+                left_nulls = [Column.full_null(f.dtype, ucap)
+                              for f in self.children[0].schema]
+                uout = ColumnarBatch(self._out_schema,
+                                     left_nulls + un_cols, ucnt)
+                self.metrics.inc("numOutputRows", uout.num_rows_raw)
+                outs.append(uout)
+            return outs
 
 
 class TpuShuffledJoinExec(TpuSortMergeJoinExec):
@@ -2862,8 +2900,16 @@ def _df_to_batch(df, schema: dt.Schema) -> ColumnarBatch:
     cols = []
     n = len(df)
     cap = bucket(n)
-    for f in schema:
-        vals = list(df[f.name]) if f.name in df.columns else [None] * n
+    # positional alignment when the frame carries duplicate names (USING
+    # joins, self-joins): df[name] would return a sub-frame there
+    names = list(df.columns)
+    positional = len(names) == len(schema.fields) and \
+        len(set(names)) != len(names)
+    for i, f in enumerate(schema):
+        if positional:
+            vals = list(df.iloc[:, i])
+        else:
+            vals = list(df[f.name]) if f.name in df.columns else [None] * n
         vals = [None if _is_na(v) else v for v in vals]
         cols.append(Column.from_pylist(vals, f.dtype, capacity=cap))
     return ColumnarBatch(schema, cols, n)

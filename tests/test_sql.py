@@ -280,3 +280,26 @@ def test_sql_correlated_count_scalar_empty_group(session):
         "SELECT k FROM co_t WHERE "
         "(SELECT count(*) FROM co_u WHERE fk = k) = 2").collect())
     assert out == [(1,)], out
+
+
+def test_sql_select_star_no_subquery_column_leak():
+    """SELECT * must expand from the pre-rewrite column list: correlated
+    scalar-subquery decorrelation LEFT-joins a hidden __sqN_val column
+    onto the frame, which leaked into the star projection (ADVICE r5 —
+    silent wrong output)."""
+    from spark_rapids_tpu.api.session import TpuSession
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    s.createDataFrame({"k": [1, 2, 3], "v": [10.0, 20.0, 30.0]}
+                      ).createOrReplaceTempView("sl_t")
+    s.createDataFrame({"fk": [1, 1, 2], "w": [4.0, 6.0, 100.0]}
+                      ).createOrReplaceTempView("sl_u")
+    df = s.sql("SELECT * FROM sl_t WHERE "
+               "v > (SELECT avg(w) FROM sl_u WHERE fk = k)")
+    assert df.columns == ["k", "v"], df.columns
+    assert sorted(df.collect()) == [(1, 10.0)]
+    # star + extra expression: same pre-rewrite expansion
+    df2 = s.sql("SELECT *, v + 1 AS v1 FROM sl_t WHERE "
+                "v > (SELECT avg(w) FROM sl_u WHERE fk = k)")
+    assert df2.columns == ["k", "v", "v1"], df2.columns
+    assert sorted(df2.collect()) == [(1, 10.0, 11.0)]

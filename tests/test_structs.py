@@ -125,3 +125,26 @@ def test_struct_device_getfield_no_shred():
            .select(col("s").getField("x").alias("sx"), col("w"))
            .collect())
     assert sorted(out, key=lambda t: t[1]) == [(1, 1.0), (None, 3.0)]
+
+
+def test_struct_key_using_join_falls_back_to_cpu():
+    """A using-style join (on=['col']) whose key is STRUCT-typed must hit
+    the struct-key CPU-fallback guard (resolved from the child schema —
+    the condition's unresolved refs carry no dtype) instead of crashing
+    device kernels."""
+    st = pa.struct([("x", pa.int64()), ("y", pa.float64())])
+    s = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    left = s.createDataFrame(pa.table({
+        "sk": pa.array([{"x": 1, "y": 1.5}, {"x": 2, "y": 2.5},
+                        {"x": 3, "y": 3.5}], type=st),
+        "v": [1, 2, 3]}))
+    right = s.createDataFrame(pa.table({
+        "sk": pa.array([{"x": 1, "y": 1.5}, {"x": 3, "y": 99.0}],
+                       type=st),
+        "w": [10, 30]}))
+    df = left.join(right, on=["sk"], how="inner")
+    # struct equality is whole-value: (3, 3.5) != (3, 99.0)
+    assert sorted(df.collect(), key=repr) == [({"x": 1, "y": 1.5}, 1, 10)]
+    with pytest.raises(AssertionError, match="ran on CPU"):
+        s.assert_on_tpu()

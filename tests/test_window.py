@@ -281,3 +281,27 @@ def test_window_in_pandas_nan_stays_nan():
         return df._df(plan)
 
     assert_tpu_and_cpu_equal(build, approx=1e-9, ignore_order=True)
+
+
+def test_default_rows_frame_warns_on_ordered_spec():
+    """An ordered spec without an explicit frame applies the implicit ROWS
+    default — documented DefaultRowsFrameWarning (Spark's default is the
+    peer-inclusive RANGE form, which differs on tied order keys). Standard
+    warnings filters apply, so an 'error'/'always' audit sees every
+    implicit-frame call site."""
+    import warnings as _warnings
+
+    from spark_rapids_tpu.api.window import (DefaultRowsFrameWarning,
+                                             Window)
+
+    with pytest.warns(DefaultRowsFrameWarning):
+        Window.partitionBy("k").orderBy("v")._to_spec()
+    # user-controlled escalation works (no hand-rolled once-flag eats it)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        with pytest.raises(DefaultRowsFrameWarning):
+            Window.partitionBy("k").orderBy("v")._to_spec()
+        # explicit frames / unordered specs never warn
+        Window.partitionBy("k").orderBy("v").rowsBetween(
+            Window.unboundedPreceding, Window.currentRow)._to_spec()
+        Window.partitionBy("k")._to_spec()
