@@ -56,10 +56,12 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
     names = query_names or list(queries)
     for name in names:
         from spark_rapids_tpu.exec.device import TpuSemaphore
+        from spark_rapids_tpu.analysis import recompile
         qfn = queries[name]
         timings = []
         rows = 0
         sem0 = TpuSemaphore.get().stats()
+        rc0 = recompile.snapshot()
         for it in range(iterations):
             t0 = time.perf_counter()
             df = qfn(tables)
@@ -79,11 +81,20 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                 "holdS": round(sem1["holdS"] - sem0["holdS"], 4),
                 "acquires": sem1["acquires"] - sem0["acquires"],
             },
+            # distinct-compile counts across this query's iterations
+            # (analysis/recompile.py): a kernel compiling per iteration
+            # means its shapes never hit the fused cache
+            "recompiles": recompile.delta(rc0),
         }
+        flags = recompile.flagged(entry["recompiles"])
+        if flags:
+            entry["recompileFlags"] = flags
         try:
             m = session.last_query_metrics()
             entry["planTimeS"] = m.get("planTimeS")
             entry["executeTimeS"] = m.get("executeTimeS")
+            # sync includes the per-span breakdown (syncSpans): which named
+            # execute region paid the device->host round trips
             entry["sync"] = m.get("sync")
             entry["spans"] = m.get("spans")
         except Exception:

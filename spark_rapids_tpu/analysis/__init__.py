@@ -1,0 +1,22 @@
+"""Static analysis passes over the engine's own invariants.
+
+Four cooperating passes (the ApiValidation.scala / assertIsOnTheGpu shape
+of tooling, turned on the invariants this port's hot paths depend on):
+
+* :mod:`.lint` — AST project linter (``python -m tools.lint``): no implicit
+  device->host materialization in hot-path modules, conf/doc agreement,
+  exec contract declarations.
+* :mod:`.contracts` — plan-contract validator: ``validate_plan`` walks the
+  converted physical tree before execution and checks schema/dtype
+  agreement between execs, exchange distribution invariants, and that the
+  conversion matches what tagging promised.
+* :mod:`.sync_audit` — runtime sync auditor: arms ``jax.transfer_guard``
+  around partition-drain task regions, with an explicit allowlist for the
+  sanctioned host-transfer helpers.
+* :mod:`.recompile` — recompile audit: distinct compiled shapes per fused
+  kernel, flagging operators that compile once per batch shape (missed
+  capacity-bucket padding).
+
+None of these import jax at module import time; the engine stays importable
+in analysis-only contexts (the linter runs on a bare checkout).
+"""

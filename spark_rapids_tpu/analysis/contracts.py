@@ -1,0 +1,425 @@
+"""Plan-contract validator: static checks over the converted physical tree.
+
+The reference's correctness story is static: GpuOverrides tags every node
+children-first and ApiValidation.scala diffs the registered surface against
+Spark. This pass is the physical-plan half of that story for the port —
+after conversion, before execution, ``validate_plan`` walks the exec tree
+and checks the invariants the executors assume:
+
+* **schema agreement** — a passthrough exec (filter, coalesce, exchange,
+  sort) emits exactly its child's schema; a join emits stream+build (or
+  stream alone for semi/anti); a union's children agree on dtypes
+  positionally.
+* **bound references** — every ``BoundReference`` an exec will evaluate
+  points inside the child schema it was bound against, with the dtype the
+  child actually produces (a stale ordinal after a planner rewrite is a
+  silent wrong-answer generator).
+* **distribution invariants** — a ``per_partition_final`` aggregate sits
+  on a hash exchange over its grouping keys (disjoint key ownership); a
+  shuffled join's children are co-partitioned with equal partition counts.
+* **tagging consistency** — a CPU fallback/bridge node only appears where
+  the meta tree recorded a will-not-work reason; conversion must not
+  quietly drop a subtree tagging promised to the device.
+
+Every exec class *declares* its contract as a ``CONTRACT`` class attribute
+(:func:`exec_contract`); the project linter enforces the declaration
+exists, this pass enforces the declaration holds.
+
+Modes (conf ``spark.rapids.tpu.sql.analysis.validatePlan``): ``off``,
+``warn`` (default; violations append to the overrides explain output and
+log once), ``error`` (reject the plan with :class:`PlanContractError`).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("spark_rapids_tpu.analysis.contracts")
+
+SCHEMA_KINDS = ("passthrough", "defined", "union")
+PARTITIONING_KINDS = ("preserve", "single", "defined", "source")
+
+
+@dataclass(frozen=True)
+class ExecContract:
+    """Declared output contract of one physical exec class.
+
+    ``schema``: how the output schema relates to the children —
+    ``passthrough`` (identical to child 0), ``union`` (all children agree
+    on dtypes positionally, output is child 0's), ``defined`` (exec
+    constructs its own; shape-specific ``extras`` checks apply).
+
+    ``partitioning``: ``preserve`` (output_partitions == child 0's),
+    ``single`` (always 1), ``source`` (leaf; declares its own count),
+    ``defined`` (exec-specific; extras may constrain it).
+
+    ``bound``: mapping of expression-holding attribute name -> child index
+    the expressions were bound against (ordinal/dtype checked).
+
+    ``extras``: names of shape-specific validators implemented in this
+    module (``join_schema``, ``copartitioned``, ``agg_distribution``,
+    ``window_schema``, ``reorder_permutation``, ``empty_schema``).
+    """
+
+    schema: str = "defined"
+    partitioning: str = "defined"
+    bound: Tuple[Tuple[str, int], ...] = ()
+    extras: Tuple[str, ...] = ()
+
+
+def exec_contract(schema: str = "defined", partitioning: str = "defined",
+                  bound: Optional[Dict[str, int]] = None,
+                  extras: Tuple[str, ...] = ()) -> ExecContract:
+    assert schema in SCHEMA_KINDS, schema
+    assert partitioning in PARTITIONING_KINDS, partitioning
+    return ExecContract(schema=schema, partitioning=partitioning,
+                        bound=tuple(sorted((bound or {}).items())),
+                        extras=tuple(extras))
+
+
+@dataclass
+class Violation:
+    node: str                       # exec class name
+    path: str                       # root->node class-name path
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.node} [{self.path}]: {self.message}"
+
+
+class PlanContractError(RuntimeError):
+    """Raised in ``error`` mode; the message is the explain-integrated
+    diagnostic (same text appended to ``Overrides.last_explain``)."""
+
+
+# ---------------------------------------------------------------------------
+# Schema helpers (duck-typed over columnar.dtypes.Schema)
+# ---------------------------------------------------------------------------
+
+def _fields_sig(schema) -> List[Tuple[str, Any]]:
+    return [(f.name, f.dtype) for f in schema.fields]
+
+
+def _dtypes_sig(schema) -> List[Any]:
+    return [f.dtype for f in schema.fields]
+
+
+def _schema_str(schema) -> str:
+    return ", ".join(f"{n}:{t}" for n, t in _fields_sig(schema))
+
+
+# ---------------------------------------------------------------------------
+# Core walk
+# ---------------------------------------------------------------------------
+
+def validate_plan(root, meta=None) -> List[Violation]:
+    """Static contract walk over a converted physical exec tree. Returns
+    violations (empty on a clean plan). Never executes the plan and never
+    touches the device."""
+    out: List[Violation] = []
+    promised = _meta_reasons(meta) if meta is not None else None
+
+    def walk(node, path: str) -> None:
+        name = type(node).__name__
+        here = f"{path}/{name}" if path else name
+        contract = getattr(type(node), "CONTRACT", None)
+        if contract is None:
+            out.append(Violation(name, here,
+                                 "exec class declares no CONTRACT"))
+        else:
+            try:
+                _check_node(node, contract, here, out)
+            except Exception as e:      # a check crashing is itself a finding
+                out.append(Violation(
+                    name, here, f"contract check failed to run: {e!r}"))
+        if promised is not None:
+            _check_promise(node, promised, here, out)
+        for c in getattr(node, "children", ()):
+            walk(c, here)
+
+    walk(root, "")
+    return out
+
+
+def _check_node(node, contract: ExecContract, path: str,
+                out: List[Violation]) -> None:
+    name = type(node).__name__
+    children = list(getattr(node, "children", ()))
+
+    # -- schema kind --------------------------------------------------------
+    if contract.schema == "passthrough":
+        if not children:
+            out.append(Violation(name, path,
+                                 "passthrough schema but no children"))
+        elif _fields_sig(node.schema) != _fields_sig(children[0].schema):
+            out.append(Violation(
+                name, path,
+                "output schema diverges from child: "
+                f"[{_schema_str(node.schema)}] vs "
+                f"[{_schema_str(children[0].schema)}]"))
+    elif contract.schema == "union":
+        base = _dtypes_sig(children[0].schema) if children else []
+        for i, c in enumerate(children[1:], start=1):
+            if _dtypes_sig(c.schema) != base:
+                out.append(Violation(
+                    name, path,
+                    f"union child {i} dtypes [{_schema_str(c.schema)}] "
+                    f"disagree with child 0 [{_schema_str(children[0].schema)}]"))
+
+    # -- partitioning kind --------------------------------------------------
+    if contract.partitioning == "preserve" and children:
+        if node.output_partitions != children[0].output_partitions:
+            out.append(Violation(
+                name, path,
+                f"declares partition-preserving but outputs "
+                f"{node.output_partitions} partitions over a "
+                f"{children[0].output_partitions}-partition child"))
+    elif contract.partitioning == "single":
+        if node.output_partitions != 1:
+            out.append(Violation(
+                name, path,
+                f"declares single-partition output but reports "
+                f"{node.output_partitions}"))
+
+    # -- bound references ---------------------------------------------------
+    for attr, child_idx in contract.bound:
+        if child_idx >= len(children):
+            continue
+        child_schema = children[child_idx].schema
+        for ref in _bound_refs(getattr(node, attr, None)):
+            if ref.ordinal < 0 or ref.ordinal >= len(child_schema.fields):
+                out.append(Violation(
+                    name, path,
+                    f"{attr}: bound ordinal {ref.ordinal} outside child "
+                    f"schema of {len(child_schema.fields)} columns"))
+            elif child_schema.fields[ref.ordinal].dtype != ref.dtype:
+                out.append(Violation(
+                    name, path,
+                    f"{attr}: bound ordinal {ref.ordinal} declares dtype "
+                    f"{ref.dtype} but child produces "
+                    f"{child_schema.fields[ref.ordinal].dtype}"))
+
+    # -- shape-specific extras ---------------------------------------------
+    for extra in contract.extras:
+        _EXTRAS[extra](node, path, out)
+
+
+def _bound_refs(value):
+    """Yield every BoundReference inside an expression-holding attribute
+    (expressions, lists of expressions, SortOrder lists, nested lists)."""
+    from ..ops import expressions as ex
+    from ..plan import logical as lp
+
+    def rec(v):
+        if v is None:
+            return
+        if isinstance(v, ex.Expression):
+            yield from (n for n in v.collect(
+                lambda x: isinstance(x, ex.BoundReference)))
+        elif isinstance(v, lp.SortOrder):
+            yield from rec(v.child)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from rec(x)
+    yield from rec(value)
+
+
+# ---------------------------------------------------------------------------
+# Extras: shape-specific validators
+# ---------------------------------------------------------------------------
+
+def _extra_join_schema(node, path: str, out: List[Violation]) -> None:
+    """Join output = stream schema (semi/anti) or stream + build fields
+    (dtype-exact; nullability is join-type-adjusted so only names/dtypes
+    are compared)."""
+    name = type(node).__name__
+    stream, build = node.children[0].schema, node.children[1].schema
+    got = _fields_sig(node.schema)
+    if node.how in ("left_semi", "left_anti"):
+        want = _fields_sig(stream)
+    else:
+        want = _fields_sig(stream) + _fields_sig(build)
+    if got != want:
+        out.append(Violation(
+            name, path,
+            f"{node.how} join schema [{_schema_str(node.schema)}] does not "
+            f"concatenate its children's "
+            f"([{_schema_str(stream)}] + [{_schema_str(build)}])"))
+    n_stream, n_build = len(node.left_keys), len(node.right_keys)
+    if n_stream != n_build or n_stream == 0:
+        out.append(Violation(
+            name, path,
+            f"equi-join key arity mismatch: {n_stream} stream keys vs "
+            f"{n_build} build keys"))
+
+
+def _extra_copartitioned(node, path: str, out: List[Violation]) -> None:
+    """A shuffled join's children must be co-partitioned: both exchanges,
+    equal partition counts, equal key arity (partition i joins only
+    partition i)."""
+    name = type(node).__name__
+    left, right = node.children
+    ln = getattr(left, "num_partitions", None)
+    rn = getattr(right, "num_partitions", None)
+    if ln is None or rn is None:
+        out.append(Violation(
+            name, path,
+            "shuffled join children are not exchanges "
+            f"({type(left).__name__}, {type(right).__name__})"))
+        return
+    if ln != rn:
+        out.append(Violation(
+            name, path,
+            f"co-partitioning broken: stream exchange has {ln} partitions, "
+            f"build exchange {rn}"))
+    lb = getattr(left, "by", None) or []
+    rb = getattr(right, "by", None) or []
+    if len(lb) != len(rb):
+        out.append(Violation(
+            name, path,
+            f"co-partitioning key arity mismatch: {len(lb)} vs {len(rb)}"))
+
+
+def _extra_agg_distribution(node, path: str, out: List[Violation]) -> None:
+    """A final-mode aggregate that merges per partition requires the
+    clustered distribution an exchange provides: hash exchange on the
+    grouping keys, or a single-partition exchange for global aggregates
+    (the reference's HashClusteredDistribution requirement)."""
+    name = type(node).__name__
+    if node.mode != "final" or not getattr(node, "per_partition_final", False):
+        return
+    child = node.children[0]
+    n_keys = len(getattr(child, "by", None) or [])
+    if getattr(child, "num_partitions", None) is None:
+        out.append(Violation(
+            name, path,
+            "per-partition final merge over a non-exchange child "
+            f"({type(child).__name__}): groups may straddle partitions"))
+        return
+    if node.grouping:
+        if n_keys != len(node.grouping):
+            out.append(Violation(
+                name, path,
+                f"final merge groups on {len(node.grouping)} keys but the "
+                f"exchange below hashes {n_keys}"))
+    elif child.num_partitions != 1:
+        out.append(Violation(
+            name, path,
+            "global aggregate merged per partition over a "
+            f"{child.num_partitions}-partition exchange"))
+
+
+def _extra_window_schema(node, path: str, out: List[Violation]) -> None:
+    """Window output = child fields + one generated column per window
+    expression, in declaration order."""
+    name = type(node).__name__
+    child = node.children[0].schema
+    got = _fields_sig(node.schema)
+    want_names = [f.name for f in child.fields] + \
+        [n for n, _w in node.window_exprs]
+    if [n for n, _t in got] != want_names or \
+            [t for _n, t in got[:len(child.fields)]] != _dtypes_sig(child):
+        out.append(Violation(
+            name, path,
+            f"window schema [{_schema_str(node.schema)}] is not child "
+            f"[{_schema_str(child)}] + {len(node.window_exprs)} window "
+            "columns"))
+
+
+def _extra_reorder_permutation(node, path: str, out: List[Violation]) -> None:
+    """A column reorder must emit a permutation of its child's dtypes."""
+    name = type(node).__name__
+    got = sorted(map(str, _dtypes_sig(node.schema)))
+    want = sorted(map(str, _dtypes_sig(node.children[0].schema)))
+    if got != want:
+        out.append(Violation(
+            name, path,
+            f"reorder output dtypes {got} are not a permutation of the "
+            f"child's {want}"))
+
+
+def _extra_empty_schema(node, path: str, out: List[Violation]) -> None:
+    if len(node.schema.fields) != 0:
+        out.append(Violation(
+            type(node).__name__, path,
+            "write exec must have an empty output schema"))
+
+
+_EXTRAS = {
+    "join_schema": _extra_join_schema,
+    "copartitioned": _extra_copartitioned,
+    "agg_distribution": _extra_agg_distribution,
+    "window_schema": _extra_window_schema,
+    "reorder_permutation": _extra_reorder_permutation,
+    "empty_schema": _extra_empty_schema,
+}
+
+
+# ---------------------------------------------------------------------------
+# Tagging consistency: conversion vs what the meta walk promised
+# ---------------------------------------------------------------------------
+
+def _meta_reasons(meta) -> Dict[int, List[str]]:
+    """id(logical plan node) -> accumulated will-not-work reasons."""
+    out: Dict[int, List[str]] = {}
+
+    def walk(m) -> None:
+        out[id(m.plan)] = list(m.reasons)
+        for c in m.children:
+            walk(c)
+    walk(meta)
+    return out
+
+
+def _check_promise(node, promised: Dict[int, List[str]], path: str,
+                   out: List[Violation]) -> None:
+    name = type(node).__name__
+    if name not in ("CpuFallbackExec", "CpuOpBridgeExec"):
+        return
+    reasons = promised.get(id(getattr(node, "plan", None)))
+    if reasons is not None and not reasons:
+        out.append(Violation(
+            name, path,
+            "subtree fell back to CPU although tagging recorded no "
+            "will-not-work reason (conversion contradicts the promise)"))
+
+
+# ---------------------------------------------------------------------------
+# Enforcement policy (the one production entry point; tests exercise it)
+# ---------------------------------------------------------------------------
+
+def format_violations(violations: List[Violation]) -> str:
+    lines = ["! plan-contract violations "
+             f"({len(violations)}; see docs/analysis.md):"]
+    lines += [f"  ! contract: {v}" for v in violations]
+    return "\n".join(lines)
+
+
+_warned_once = False
+
+
+def enforce(root, meta, mode: str) -> Optional[str]:
+    """Run validation per ``mode``: returns the diagnostic text to append
+    to the explain output (None when clean or off); raises
+    :class:`PlanContractError` in ``error`` mode."""
+    mode = (mode or "warn").lower()
+    if mode == "off":
+        return None
+    violations = validate_plan(root, meta)
+    if not violations:
+        return None
+    diag = format_violations(violations)
+    if mode == "error":
+        raise PlanContractError(diag)
+    global _warned_once
+    if not _warned_once:
+        _warned_once = True
+        logger.warning(
+            "plan-contract validation found violations (set "
+            "spark.rapids.tpu.sql.analysis.validatePlan=error to reject, "
+            "off to silence):\n%s", diag)
+    else:
+        logger.debug("plan-contract violations:\n%s", diag)
+    return diag

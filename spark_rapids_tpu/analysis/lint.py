@@ -1,0 +1,334 @@
+"""Project linter: AST enforcement of the repo's device-residency and
+registry invariants. Run as ``python -m tools.lint`` (tier-1 enforces a
+clean run; see docs/analysis.md).
+
+Rules
+-----
+``host-sync`` (hot-path modules only: ``ops/``, ``exec/``, ``shuffle/``,
+``plan/physical.py``): flags constructs that force (or strongly smell of)
+a blocking device->host materialization inside an operator hot path —
+
+* ``np.asarray(...)`` — the implicit-readback funnel,
+* ``jax.device_get(...)`` / ``.block_until_ready(...)`` outside the
+  allowlisted helpers (PipelineWindow's batched resolve, Metrics.resolve),
+* ``float()``/``int()``/``bool()`` applied to a ``jnp.``/``jax.`` call
+  result, and ``.item()``.
+
+A deliberate sync carries a pragma on the flagged line::
+
+    x = np.asarray(dec)   # lint: host-sync-ok the ONE per-window stats sync
+
+The reason is mandatory (``pragma-reason`` rule) so every exception is
+visible and greppable: ``grep -rn 'host-sync-ok' spark_rapids_tpu/``.
+
+``conf-docs``: every non-internal conf key registered in ``config.py``
+appears in ``docs/configs.md`` and vice versa (regenerate with
+``python tools/gen_docs.py``).
+
+``exec-contract``: every physical exec class (``*Exec`` in the exec
+modules) declares a ``CONTRACT`` in its class body — the declaration
+``analysis/contracts.py`` validates per plan.
+
+The linter is pure AST + text: no engine import, no jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# hot-path membership by path relative to the spark_rapids_tpu package
+HOT_PATH_PREFIXES = ("ops/", "exec/", "shuffle/")
+HOT_PATH_FILES = ("plan/physical.py",)
+
+# (relative module, enclosing qualname): sanctioned sync helpers — the
+# batched readback funnels every other site must go through
+HOST_SYNC_ALLOWLIST = {
+    ("exec/pipeline.py", "PipelineWindow._resolve"),
+    ("plan/physical.py", "Metrics.resolve"),
+}
+
+# modules whose *Exec classes must declare a CONTRACT
+EXEC_MODULES = (
+    "plan/physical.py", "plan/overrides.py", "plan/window_exec.py",
+    "shuffle/exchange.py", "io/scan.py", "io/write.py",
+    "parallel/mesh_exec.py",
+)
+EXEC_BASE_CLASSES = {"TpuExec"}       # abstract root: no contract of its own
+
+PRAGMA_RE = re.compile(r"#\s*lint:\s*host-sync-ok(.*)$")
+
+
+@dataclass
+class LintViolation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _is_hot(rel: str) -> bool:
+    return rel.startswith(HOT_PATH_PREFIXES) or rel in HOT_PATH_FILES
+
+
+def _pragmas(source: str) -> Dict[int, str]:
+    """line number -> pragma reason ('' when missing)."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            out[i] = m.group(1).strip()
+    return out
+
+
+class _HostSyncVisitor(ast.NodeVisitor):
+    """Collects host-sync smells with their enclosing qualname."""
+
+    def __init__(self) -> None:
+        self.hits: List[Tuple[int, str, str]] = []   # (line, qualname, msg)
+        self._stack: List[str] = []
+
+    @property
+    def _qual(self) -> str:
+        return ".".join(self._stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "asarray" and isinstance(f.value, ast.Name) and \
+                    f.value.id in ("np", "numpy", "_np"):
+                self._hit(node, "np.asarray() materializes device values "
+                                "on host (use jax.device_get via a batched "
+                                "resolve, or pragma with a reason)")
+            elif f.attr == "device_get" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "jax":
+                self._hit(node, "bare jax.device_get outside the batched-"
+                                "resolve helpers blocks a full link round "
+                                "trip")
+            elif f.attr == "block_until_ready":
+                self._hit(node, ".block_until_ready() serializes the "
+                                "stream on device completion")
+            elif f.attr == "item" and not node.args and not node.keywords:
+                self._hit(node, ".item() forces a host readback when "
+                                "applied to a device value")
+        elif isinstance(f, ast.Name) and f.id in ("float", "int", "bool") \
+                and len(node.args) == 1 and not node.keywords:
+            if self._jaxish(node.args[0]):
+                self._hit(node, f"{f.id}() over a jax expression is a "
+                                "blocking scalar readback")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _jaxish(arg: ast.AST) -> bool:
+        """The argument is syntactically a jax/jnp call (or np.asarray of
+        one) — the conservative subset the AST can prove."""
+        if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Attribute):
+            v = arg.func.value
+            if isinstance(v, ast.Name) and v.id in ("jnp", "jax"):
+                return True
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "jax":
+                return True
+            if arg.func.attr == "asarray" and isinstance(v, ast.Name) and \
+                    v.id in ("np", "numpy", "_np"):
+                return True
+        return False
+
+    def _hit(self, node: ast.AST, msg: str) -> None:
+        self.hits.append((node.lineno, self._qual, msg))
+
+
+def lint_source(source: str, rel: str, path: Optional[str] = None
+                ) -> List[LintViolation]:
+    """Lint one module's source. ``rel`` is its path relative to the
+    package root (decides hot-path membership and exec-module rules)."""
+    path = path or rel
+    out: List[LintViolation] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [LintViolation(path, e.lineno or 0, "parse", str(e))]
+    pragmas = _pragmas(source)
+
+    # pragma-reason: a host-sync-ok pragma without a justification
+    for line, reason in pragmas.items():
+        if not reason:
+            out.append(LintViolation(
+                path, line, "pragma-reason",
+                "host-sync-ok pragma missing its justification "
+                "(format: `# lint: host-sync-ok <reason>`)"))
+
+    if _is_hot(rel):
+        v = _HostSyncVisitor()
+        v.visit(tree)
+        for line, qual, msg in v.hits:
+            if (rel, qual) in HOST_SYNC_ALLOWLIST:
+                continue
+            if any(l in pragmas and pragmas[l] for l in (line, line - 1)):
+                continue
+            out.append(LintViolation(path, line, "host-sync",
+                                     f"{qual}: {msg}"))
+
+    if rel in EXEC_MODULES:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name.endswith("Exec") and \
+                    node.name not in EXEC_BASE_CLASSES:
+                has = any(
+                    isinstance(st, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "CONTRACT"
+                        for t in st.targets)
+                    for st in node.body)
+                if not has:
+                    out.append(LintViolation(
+                        path, node.lineno, "exec-contract",
+                        f"exec class {node.name} declares no CONTRACT "
+                        "(analysis/contracts.exec_contract)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conf <-> docs agreement
+# ---------------------------------------------------------------------------
+
+def _registered_conf_keys(config_source: str) -> Dict[str, bool]:
+    """key -> internal flag, parsed from config.py's builder-chain AST."""
+    tree = ast.parse(config_source)
+    keys: Dict[str, bool] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "create_with_default"):
+            continue
+        cur: ast.AST = node.func.value
+        internal = False
+        key: Optional[str] = None
+        while cur is not None:
+            if isinstance(cur, ast.Attribute):
+                cur = cur.value
+            elif isinstance(cur, ast.Call):
+                f = cur.func
+                if isinstance(f, ast.Name):          # _conf("key")
+                    if cur.args and isinstance(cur.args[0], ast.Constant):
+                        key = cur.args[0].value
+                    break
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "internal":
+                        internal = True
+                    elif f.attr == "conf" and cur.args and \
+                            isinstance(cur.args[0], ast.Constant):
+                        key = cur.args[0].value
+                        break
+                    cur = f.value
+                else:
+                    break
+            else:
+                break
+        if key:
+            keys[key] = internal
+    return keys
+
+
+def _documented_conf_keys(docs_text: str) -> List[str]:
+    out = []
+    for line in docs_text.splitlines():
+        m = re.match(r"\|\s*(spark\.[\w.]+)\s*\|", line)
+        if m:
+            out.append(m.group(1))
+    return out
+
+
+def check_conf_docs(config_source: str, docs_text: str,
+                    config_path: str = "config.py",
+                    docs_path: str = "docs/configs.md"
+                    ) -> List[LintViolation]:
+    registered = _registered_conf_keys(config_source)
+    public = {k for k, internal in registered.items() if not internal}
+    documented = set(_documented_conf_keys(docs_text))
+    out: List[LintViolation] = []
+    for k in sorted(public - documented):
+        out.append(LintViolation(
+            config_path, 0, "conf-docs",
+            f"conf key {k} is registered but missing from {docs_path} "
+            "(run: python tools/gen_docs.py)"))
+    for k in sorted(documented - public):
+        out.append(LintViolation(
+            docs_path, 0, "conf-docs",
+            f"{docs_path} documents {k} which is not registered in "
+            f"{config_path}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def run(package_dir: str, docs_dir: Optional[str] = None
+        ) -> List[LintViolation]:
+    """Lint every .py under ``package_dir`` (the spark_rapids_tpu package)
+    plus the conf/docs agreement check."""
+    out: List[LintViolation] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, package_dir).replace(os.sep, "/")
+            with open(full, "r") as f:
+                src = f.read()
+            out.extend(lint_source(src, rel, path=full))
+    config_path = os.path.join(package_dir, "config.py")
+    if docs_dir is None:
+        docs_dir = os.path.join(os.path.dirname(package_dir), "docs")
+    docs_path = os.path.join(docs_dir, "configs.md")
+    if os.path.exists(config_path) and os.path.exists(docs_path):
+        with open(config_path) as f:
+            cfg_src = f.read()
+        with open(docs_path) as f:
+            docs_text = f.read()
+        out.extend(check_conf_docs(cfg_src, docs_text,
+                                   config_path=config_path,
+                                   docs_path=docs_path))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if not a.startswith("--")]
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    package_dir = argv[0] if argv else here
+    violations = run(package_dir)
+    if as_json:
+        print(json.dumps([vars(v) for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v)
+        print(f"{len(violations)} violation(s)" if violations
+              else "lint OK")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Recompile audit: distinct compiled shapes per fused kernel.
+
+Whole-stage programs compile per (expression structure, schema, capacity)
+signature; the capacity-bucketing discipline (columnar.column.bucket)
+exists precisely so a stream of slightly-different batch sizes reuses ONE
+compiled program instead of recompiling per shape. A regression there is
+invisible in unit tests (everything still returns the right rows) but
+catastrophic on real backends where compiles cost seconds — so this audit
+counts, per kernel family, how many distinct signatures actually compiled
+versus how many calls ran, and flags kernels whose compile count tracks
+their call count (the compiling-once-per-batch-shape smell).
+
+Wired into the one funnel every fused program goes through
+(``plan/physical._fused_fn`` and per-exec ``FusedStage`` jits); the bench
+runner reports per-query deltas (``report``/``snapshot``/``delta``) next
+to the sync and semaphore metrics. Gated by
+``spark.rapids.tpu.sql.analysis.recompileAudit`` (default on — the cost
+is a dict increment per fused-program call).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+# flag a kernel once it has compiled this many times AND compiles on at
+# least half of its calls — a well-bucketed kernel stream compiles a
+# handful of shapes then hits the cache forever
+FLAG_MIN_COMPILES = 8
+
+_lock = threading.Lock()
+# name -> {keys: set, compiles: int, calls: int}. ``compiles`` counts
+# EVERY cache-miss build (a same-key recompile after the fused cache
+# evicts is real churn and must show), ``keys`` counts distinct shapes.
+_kernels: Dict[str, Dict[str, Any]] = {}
+_enabled_cache: Optional[bool] = None
+
+
+def _enabled() -> bool:
+    global _enabled_cache
+    if _enabled_cache is None:
+        try:
+            from .. import config as cfg
+            from .sync_audit import _effective_conf
+            _enabled_cache = bool(
+                _effective_conf().get(cfg.ANALYSIS_RECOMPILE_AUDIT))
+        except Exception:
+            _enabled_cache = True
+    return _enabled_cache
+
+
+def reset_cache() -> None:
+    global _enabled_cache
+    _enabled_cache = None
+
+
+def kernel_of(key: Any) -> str:
+    """Kernel family of a fused-cache signature: the top-level string
+    tags joined (``concat``, ``project``, ``agg/update/partial/dense``,
+    ...) — shapes/schemas live in nested tuples and stay out of the
+    family name."""
+    if isinstance(key, tuple):
+        tags = [p for p in key if isinstance(p, str)]
+        if tags:
+            return "/".join(tags)
+    return "anon"
+
+
+def _ent(kernel: str) -> Dict[str, Any]:
+    return _kernels.setdefault(kernel,
+                               {"keys": set(), "compiles": 0, "calls": 0})
+
+
+def note_compile(kernel: str, key: Any) -> None:
+    """Record a cache miss: a program built (new shape OR a same-key
+    rebuild after eviction — both are paid compile time)."""
+    if not _enabled():
+        return
+    with _lock:
+        ent = _ent(kernel)
+        ent["keys"].add(key)
+        ent["compiles"] += 1
+        ent["calls"] += 1
+
+
+def note_call(kernel: str) -> None:
+    """Record a cache hit (a call that reused a compiled program)."""
+    if not _enabled():
+        return
+    with _lock:
+        _ent(kernel)["calls"] += 1
+
+
+def report() -> Dict[str, Dict[str, int]]:
+    with _lock:
+        return {k: {"compiles": v["compiles"],
+                    "distinctShapes": len(v["keys"]),
+                    "calls": v["calls"]}
+                for k, v in sorted(_kernels.items())}
+
+
+def snapshot() -> Dict[str, Dict[str, int]]:
+    """Point-in-time counters for delta reporting (bench runner)."""
+    return report()
+
+
+def delta(base: Dict[str, Dict[str, int]]) -> Dict[str, Dict[str, int]]:
+    """Per-kernel counter growth since ``base`` (dropping unchanged
+    kernels)."""
+    out: Dict[str, Dict[str, int]] = {}
+    zero = {"compiles": 0, "distinctShapes": 0, "calls": 0}
+    for k, now in report().items():
+        was = base.get(k, zero)
+        d = {f: now[f] - was.get(f, 0) for f in now}
+        if any(d.values()):
+            out[k] = d
+    return out
+
+
+def flagged(counters: Optional[Dict[str, Dict[str, int]]] = None
+            ) -> Dict[str, str]:
+    """Kernels compiling once per call: many compiles AND compiling on >=
+    half their calls — missed capacity-bucket padding, or cache-eviction
+    churn (same shapes rebuilt after _FUSED_CACHE clears)."""
+    counters = report() if counters is None else counters
+    out: Dict[str, str] = {}
+    for k, c in counters.items():
+        n, calls = c["compiles"], max(c["calls"], 1)
+        if n >= FLAG_MIN_COMPILES and n * 2 >= calls:
+            out[k] = (f"{n} compiles ({c.get('distinctShapes', n)} distinct "
+                      f"shapes) over {calls} calls — compiling per batch "
+                      "shape or churning the fused cache (check capacity "
+                      "bucketing)")
+    return out
+
+
+def reset() -> None:
+    """Drop all counters (tests)."""
+    with _lock:
+        _kernels.clear()

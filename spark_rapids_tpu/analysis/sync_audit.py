@@ -1,0 +1,110 @@
+"""Runtime sync auditor: arm ``jax.transfer_guard`` around operator
+execute regions.
+
+Two complementary mechanisms guard the device-residency invariant at
+runtime (the static linter guards it at review time):
+
+* **Attributed counting** (always available, deterministic on every
+  backend): ``exec/tracing.SyncCounter`` hooks the one funnel every
+  blocking readback goes through and attributes each to its source site
+  AND to the innermost open trace span, so the bench runner reports
+  syncs-per-query broken down by span next to the semaphore wait/hold
+  split.
+
+* **Transfer-guard arming** (this module; real accelerators only — on the
+  CPU backend arrays already live in host memory, so jax never raises):
+  when ``spark.rapids.tpu.sql.analysis.syncAudit`` is ``log`` or
+  ``disallow``, every partition-drain task body runs under
+  ``jax.transfer_guard_device_to_host(mode)``. jax's guard only fires on
+  *implicit* transfers (``np.asarray``, ``float()`` on a device value);
+  explicit ``jax.device_get`` — which is exactly what the sanctioned
+  batched-resolve helpers use — stays legal even under ``disallow``. The
+  engine's contract is therefore mechanical: hot paths either keep values
+  on device or read them back through an explicit batched resolve; the
+  few deliberately-implicit host crossings (the CPU fallback engine's
+  pandas materialization) wrap themselves in
+  :func:`allowed_host_transfer`, which is the greppable runtime allowlist.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+MODES = ("off", "log", "disallow")
+
+_mode_cache: Optional[str] = None
+_armed = 0                      # count of live audited regions (any thread)
+_lock = threading.Lock()
+
+
+def _effective_conf():
+    """The active session's conf when one exists (builder-set keys must
+    reach the audit), else process defaults + env overrides."""
+    from .. import config as cfg
+    try:
+        from ..api.session import TpuSession
+        with TpuSession._lock:
+            active = TpuSession._active
+        if active is not None:
+            return active.conf
+    except Exception:
+        pass
+    return cfg.TpuConf()
+
+
+def audit_mode() -> str:
+    """Configured audit mode, cached per process (conf reads on the hot
+    path would defeat the point). The cache primes from the session
+    active at first use; switching modes mid-process needs
+    :func:`reset_cache` (session construction calls it)."""
+    global _mode_cache
+    if _mode_cache is None:
+        from .. import config as cfg
+        _mode_cache = str(
+            _effective_conf().get(cfg.ANALYSIS_SYNC_AUDIT)).lower()
+        if _mode_cache not in MODES:
+            _mode_cache = "off"
+    return _mode_cache
+
+
+def reset_cache() -> None:
+    global _mode_cache
+    _mode_cache = None
+
+
+@contextlib.contextmanager
+def audited_region():
+    """Wrap one operator execute region (a partition-drain task body).
+    No-op when the audit is off; otherwise arms the jax device->host
+    transfer guard at the configured level for this thread."""
+    mode = audit_mode()
+    if mode == "off":
+        yield
+        return
+    global _armed
+    import jax
+    with _lock:
+        _armed += 1
+    try:
+        with jax.transfer_guard_device_to_host(mode):
+            yield
+    finally:
+        with _lock:
+            _armed -= 1
+
+
+@contextlib.contextmanager
+def allowed_host_transfer(reason: str):
+    """Sanction an implicit device->host crossing inside an audited
+    region (the runtime analog of the linter's ``host-sync-ok`` pragma).
+    ``reason`` is required purely so call sites document themselves —
+    grep: ``grep -rn 'allowed_host_transfer' spark_rapids_tpu/``."""
+    assert reason, "allowed_host_transfer requires a reason"
+    if not _armed:
+        yield
+        return
+    import jax
+    with jax.transfer_guard_device_to_host("allow"):
+        yield

@@ -83,6 +83,12 @@ class TpuSession:
         TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         cat = BufferCatalog.get()
         cat.device_budget = dm.memory_budget_bytes
+        # audit caches prime from the ACTIVE session's conf at first use;
+        # a new session (possibly with different analysis.* keys) must
+        # re-prime them
+        from ..analysis import recompile, sync_audit
+        sync_audit.reset_cache()
+        recompile.reset_cache()
 
     @classmethod
     def active(cls) -> "TpuSession":

@@ -406,6 +406,32 @@ READER_THREADS = _conf("spark.rapids.tpu.sql.format.parquet.multiThreadedRead.nu
     "Background decode threads for the MULTITHREADED reader "
     "(ref: RapidsConf.scala:548)").integer_conf.create_with_default(4)
 
+ANALYSIS_VALIDATE_PLAN = _conf("spark.rapids.tpu.sql.analysis.validatePlan").doc(
+    "Plan-contract validation mode: off, warn (default; violations append "
+    "to the explain output and log once), error (reject the plan with a "
+    "diagnostic). Runs after conversion, before execution: parent/child "
+    "schema+dtype agreement, exchange distribution invariants, and "
+    "conversion-vs-tagging consistency (analysis/contracts.py; see "
+    "docs/analysis.md)").string_conf.check(
+        lambda v: str(v).lower() in ("off", "warn", "error")
+).create_with_default("warn")
+
+ANALYSIS_SYNC_AUDIT = _conf("spark.rapids.tpu.sql.analysis.syncAudit").doc(
+    "Runtime sync audit: off, log, disallow — arms jax.transfer_guard "
+    "(device->host) around partition-drain task regions so implicit host "
+    "materializations in operator hot paths are logged or rejected on "
+    "real accelerators; explicit batched resolves (jax.device_get) stay "
+    "legal (analysis/sync_audit.py)").string_conf.check(
+        lambda v: str(v).lower() in ("off", "log", "disallow")
+).create_with_default("off")
+
+ANALYSIS_RECOMPILE_AUDIT = _conf(
+    "spark.rapids.tpu.sql.analysis.recompileAudit").doc(
+    "Track distinct compiled signatures per fused kernel and flag "
+    "operators compiling once per batch shape (missed capacity-bucket "
+    "padding); the bench runner reports per-query deltas "
+    "(analysis/recompile.py)").boolean_conf.create_with_default(True)
+
 
 class TpuConf:
     """Immutable-ish view over a key->value dict with typed accessors.

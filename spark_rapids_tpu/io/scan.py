@@ -20,6 +20,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, List, Optional
 
 from .. import config as cfg
+from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..ops import expressions as ex
@@ -47,6 +48,8 @@ def _pushdown_filters(exprs: List[ex.Expression]):
 
 class TpuFileScanExec(TpuExec):
     """GpuFileSourceScanExec / GpuBatchScanExec analog."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="source")
 
     def __init__(self, plan: lp.FileScan, conf: Optional[cfg.TpuConf] = None):
         super().__init__()

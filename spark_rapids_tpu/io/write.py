@@ -14,6 +14,7 @@ import shutil
 import uuid
 from typing import Dict, List
 
+from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..plan import logical as lp
@@ -21,6 +22,9 @@ from ..plan.physical import Partition, TpuExec
 
 
 class TpuWriteFileExec(TpuExec):
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve",
+                             extras=("empty_schema",))
+
     def __init__(self, child: TpuExec, plan: lp.WriteFile):
         super().__init__(child)
         self.plan = plan

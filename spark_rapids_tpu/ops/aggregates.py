@@ -701,7 +701,7 @@ def groupby_singleword(key_col: Column, specs: Sequence[AggSpec],
     sorted_enc = jnp.sort(enc)
     prev = jnp.concatenate([sorted_enc[:1] ^ jnp.uint64(1), sorted_enc[:-1]])
     starts = (sorted_enc != prev) & (sorted_enc != _KEY_SENTINEL)
-    n_groups = int(jnp.sum(starts))            # host sync
+    n_groups = int(jnp.sum(starts))  # lint: host-sync-ok single-word group-count sync sizes the dense bucket (documented dynamic-size read)
     if n_groups == 0:
         return [], [], 0
 
@@ -785,7 +785,7 @@ def groupby_aggregate_fast(key_cols: Sequence[Column], specs: Sequence[AggSpec],
             and all(_dense_spec_supported(s) for s in specs)):
         rmin_d, decision = dense_key_stats(key_cols[0], num_rows,
                                            float_cols=float_cols)
-        stats = _np.asarray(decision)             # the ONE stats sync
+        stats = _np.asarray(decision)  # lint: host-sync-ok the ONE dense-path stats sync (span/absmax decide the kernel)
         span, absmaxes = stats[0], stats[2:]
         f32_safe = bool(all(a <= F32_SAFE_ABSMAX for a in absmaxes))
         if span + 2 <= DENSE_MAX_SLOTS and f32_safe:
@@ -810,11 +810,11 @@ def groupby_aggregate_fast(key_cols: Sequence[Column], specs: Sequence[AggSpec],
             contrib = live & c.validity
             a = jnp.where(contrib & ~jnp.isnan(c.data), jnp.abs(c.data), 0.0)
             parts.append(jnp.max(a).astype(jnp.float64))
-        arr = _np.asarray(jnp.stack(parts))       # host sync
+        arr = _np.asarray(jnp.stack(parts))  # lint: host-sync-ok n_groups + f32-range folded into one stats sync
         n_groups = int(arr[0])
         f32_safe = bool(all(a <= F32_SAFE_ABSMAX for a in arr[1:]))
     else:
-        n_groups = int(jnp.sum(starts))            # host sync
+        n_groups = int(jnp.sum(starts))  # lint: host-sync-ok eager-path group-count sync sizes the output bucket
 
     Kb = _bucket(max(n_groups, 1))
     use_mm = (allow_matmul and Kb <= MATMUL_MAX_GROUPS and

@@ -13,12 +13,21 @@ assume both sides share the result dtype.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column, Scalar
 from .expressions import (Expression, combine_validity, data_validity,
                           result_column)
+
+
+def _ns(*vals):
+    """numpy for host (scalar-fold) operands, jnp for device/tracer ones:
+    the safe-compute helpers below run on both paths without a literal
+    constant ever round-tripping the device."""
+    return np if all(isinstance(v, (np.ndarray, np.generic))
+                     for v in vals) else jnp
 
 
 class BinaryArithmetic(Expression):
@@ -64,16 +73,19 @@ class BinaryArithmetic(Expression):
         return self._compute(l, r)
 
     def _fold_scalars(self, lv: Scalar, rv: Scalar) -> Scalar:
+        # pure-numpy fold: literal operands stay host-side end to end (the
+        # compute helpers pick their namespace via _ns), so a constant
+        # expression costs zero device round trips per batch
         if lv.is_null or rv.is_null:
             return Scalar(None, self.dtype)
-        import numpy as np
-        l = jnp.asarray(lv.value, self.dtype.numpy_dtype)
-        r = jnp.asarray(rv.value, self.dtype.numpy_dtype)
+        l = np.asarray(lv.value, self.dtype.numpy_dtype)   # lint: host-sync-ok numpy view of a python literal, no device value
+        r = np.asarray(rv.value, self.dtype.numpy_dtype)   # lint: host-sync-ok numpy view of a python literal, no device value
         extra = self._extra_validity(l, r)
         if extra is not None and not bool(extra):
             return Scalar(None, self.dtype)
-        out = np.asarray(self._compute_safe(l, r))
-        return Scalar(out.item(), self.dtype)
+        with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
+            out = self._compute_safe(l, r)
+        return Scalar(np.asarray(out).item(), self.dtype)  # lint: host-sync-ok numpy result of the host fold above
 
     def __repr__(self):
         return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"
@@ -106,7 +118,8 @@ class Divide(BinaryArithmetic):
         return r != 0
 
     def _compute_safe(self, l, r):
-        safe_r = jnp.where(r != 0, r, jnp.ones((), jnp.result_type(r)))
+        xp = _ns(l, r)
+        safe_r = xp.where(r != 0, r, xp.ones((), xp.result_type(r)))
         return l / safe_r
 
 
@@ -126,13 +139,14 @@ class IntegralDivide(BinaryArithmetic):
         return r != 0
 
     def _compute_safe(self, l, r):
-        safe_r = jnp.where(r != 0, r, jnp.ones((), jnp.result_type(r)))
-        # Java integer division truncates toward zero; jnp // floors.
-        q = jnp.floor_divide(l, safe_r)
+        xp = _ns(l, r)
+        safe_r = xp.where(r != 0, r, xp.ones((), xp.result_type(r)))
+        # Java integer division truncates toward zero; // floors.
+        q = xp.floor_divide(l, safe_r)
         rem = l - q * safe_r
         neg = ((l < 0) != (safe_r < 0)) & (rem != 0)
-        return (q + jnp.where(neg, jnp.ones((), q.dtype), jnp.zeros((), q.dtype))
-                ).astype(jnp.int64)
+        return (q + xp.where(neg, xp.ones((), q.dtype), xp.zeros((), q.dtype))
+                ).astype(xp.int64)
 
 
 class Remainder(BinaryArithmetic):
@@ -148,10 +162,11 @@ class Remainder(BinaryArithmetic):
         return r != 0
 
     def _compute_safe(self, l, r):
-        one = jnp.ones((), jnp.result_type(r))
-        safe_r = jnp.where(r != 0, r, one)
-        # Java %: truncated remainder (same sign as dividend) = jnp.fmod
-        return jnp.fmod(l, safe_r)
+        xp = _ns(l, r)
+        one = xp.ones((), xp.result_type(r))
+        safe_r = xp.where(r != 0, r, one)
+        # Java %: truncated remainder (same sign as dividend) = fmod
+        return xp.fmod(l, safe_r)
 
 
 class Pmod(BinaryArithmetic):
@@ -166,10 +181,11 @@ class Pmod(BinaryArithmetic):
         return r != 0
 
     def _compute_safe(self, l, r):
-        one = jnp.ones((), jnp.result_type(r))
-        safe_r = jnp.where(r != 0, r, one)
-        m = jnp.fmod(l, safe_r)
-        return jnp.where(m != 0, jnp.fmod(m + safe_r, safe_r), m)
+        xp = _ns(l, r)
+        one = xp.ones((), xp.result_type(r))
+        safe_r = xp.where(r != 0, r, one)
+        m = xp.fmod(l, safe_r)
+        return xp.where(m != 0, xp.fmod(m + safe_r, safe_r), m)
 
 
 class UnaryMinus(Expression):

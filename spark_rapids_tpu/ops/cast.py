@@ -128,9 +128,10 @@ def _cast_scalar(v: Scalar, src: dt.DType, dst: dt.DType) -> Scalar:
         return Scalar(_parse_value(v.value, dst), dst)
     # pure numpy: scalar folding runs inside fused traces, where any jax
     # primitive bind would return a tracer and break host conversion
-    out = np.asarray(device_cast(np.asarray(v.value, src.numpy_dtype),
-                                 src, dst, xp=np))
-    return Scalar(out.item(), dst)
+    out = np.asarray(  # lint: host-sync-ok pure-numpy fold (xp=np): no device value involved
+        device_cast(np.asarray(v.value, src.numpy_dtype),  # lint: host-sync-ok numpy view of a python literal
+                    src, dst, xp=np))
+    return Scalar(out.item(), dst)  # lint: host-sync-ok numpy result of the host fold above
 
 
 # ---------------------------------------------------------------------------
@@ -209,7 +210,7 @@ def _host_cast_column(v: Column, src: dt.DType, dst: dt.DType,
         parsed = [None if x is None else _parse_value(x, dst) for x in values]
         return Column.from_pylist(parsed, dst, capacity=cap)
     # fixed-width -> string
-    valid = np.asarray(v.validity[:n])
-    data = np.asarray(v.data[:n])
+    valid = np.asarray(v.validity[:n])  # lint: host-sync-ok host string-cast path: planner routed this column through host formatting
+    data = np.asarray(v.data[:n])  # lint: host-sync-ok host string-cast path (same transition as above)
     out = [(_format_value(data[i], src) if valid[i] else None) for i in range(n)]
     return Column.from_pylist(out, dt.STRING, capacity=cap)

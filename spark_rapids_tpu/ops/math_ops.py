@@ -20,8 +20,8 @@ from .expressions import Expression, combine_validity, data_validity, result_col
 
 class UnaryMath(Expression):
     """Double -> Double elementwise op."""
-    fn: Callable = None
-    pyfn: Callable = None
+    fn: Callable = None       # jnp elementwise fn (column path)
+    pyfn: Callable = None     # numpy twin (host scalar fold; no device trip)
 
     @property
     def dtype(self) -> dt.DType:
@@ -39,13 +39,18 @@ class UnaryMath(Expression):
         if isinstance(v, Scalar):
             if v.is_null:
                 return Scalar(None, dt.FLOAT64)
-            x = jnp.asarray(float(v.value))
+            # pure-host fold: the domain lambdas are plain comparisons and
+            # pyfn is the numpy twin of fn, so a scalar input never touches
+            # the device (this path runs per batch under eager eval)
+            x = float(v.value)
             extra = self._domain_validity(x)
             if extra is not None and not bool(extra):
                 return Scalar(None, dt.FLOAT64)
             import numpy as np
-            return Scalar(float(np.asarray(type(self).fn(self._safe_input(x)))),
-                          dt.FLOAT64)
+            fn = type(self).pyfn or type(self).fn
+            with np.errstate(invalid="ignore", divide="ignore",
+                             over="ignore"):
+                return Scalar(float(fn(x)), dt.FLOAT64)
         d = v.data.astype(jnp.float64)
         extra = self._domain_validity(d)
         data = type(self).fn(self._safe_input(d))
@@ -61,7 +66,13 @@ class UnaryMath(Expression):
 
 def _unary(name: str, fn, domain: Optional[Callable] = None,
            safe: Optional[Callable] = None) -> type:
+    import numpy as np
     attrs = {"fn": staticmethod(fn)}
+    # jnp elementwise fns share their numpy twin's name (jnp.sin -> np.sin):
+    # the scalar fold uses the twin so literals never round-trip the device
+    pyfn = getattr(np, getattr(fn, "__name__", ""), None)
+    if pyfn is not None:
+        attrs["pyfn"] = staticmethod(pyfn)
     if domain is not None:
         attrs["_domain_validity"] = lambda self, d, _dom=domain: _dom(d)
     if safe is not None:

@@ -64,8 +64,7 @@ class BinaryComparison(Expression):
         if isinstance(lv, Scalar) and isinstance(rv, Scalar):
             if lv.is_null or rv.is_null:
                 return Scalar(None, dt.BOOL)
-            import numpy as np
-            return Scalar(bool(np.asarray(self._py_cmp(lv, rv))), dt.BOOL)
+            return Scalar(bool(self._py_cmp(lv, rv)), dt.BOOL)
         if in_dtype == dt.STRING:
             data = self._string_cmp(lv, rv, batch)
             lval = lv.validity if isinstance(lv, Column) else (not lv.is_null)
@@ -82,17 +81,33 @@ class BinaryComparison(Expression):
         return result_column(dt.BOOL, data, validity, batch.capacity)
 
     def _py_cmp(self, lv: Scalar, rv: Scalar):
+        """Pure-host scalar compare — Spark's NaN semantics (NaN = NaN is
+        TRUE, NaN greater than everything) inlined so a literal-literal
+        fold never touches the device (this path runs per batch)."""
+        l, r = lv.value, rv.value
         if self.left.dtype == dt.STRING:
-            l, r = lv.value, rv.value
             mapping = {"=": l == r, "<": l < r, "<=": l <= r, ">": l > r,
                        ">=": l >= r}
             return mapping[self.symbol] if self.symbol in mapping else (
                 l != r)
         if self.left.dtype.is_floating:
-            return self._cmp_float(
-                jnp.asarray(lv.value, self.left.dtype.numpy_dtype),
-                jnp.asarray(rv.value, self.left.dtype.numpy_dtype))
-        return self._cmp(jnp.asarray(lv.value), jnp.asarray(rv.value))
+            import math
+            import numpy as np
+            # round to the COLUMN dtype first (float32 literals must
+            # compare at float32, like the column path): f32->f64 widening
+            # is exact, so the python compare then matches a _cmp at npdt
+            npdt = np.dtype(self.left.dtype.numpy_dtype).type
+            l, r = float(npdt(l)), float(npdt(r))
+            ln, rn = math.isnan(l), math.isnan(r)
+            if ln or rn:
+                eq = ln and rn
+                lt = rn and not ln
+            else:
+                eq, lt = (l == r), (l < r)
+        else:
+            eq, lt = (l == r), (l < r)
+        return {"=": eq, "!=": not eq, "<": lt, "<=": lt or eq,
+                ">": not (lt or eq), ">=": not lt}[self.symbol]
 
     def __repr__(self):
         return f"({self.children[0]!r} {self.symbol} {self.children[1]!r})"

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
+from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column, bucket
@@ -72,6 +73,9 @@ class TpuMeshGroupByExec(TpuExec):
     hash-bucketed ``all_to_all`` -> merge aggregate, one XLA computation
     (mesh.distributed_groupby_fn). Output: one partition per worker with
     disjoint key ownership."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="defined",
+                             bound={"grouping": 0})
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
                  outputs: List[ex.Expression], mesh,
@@ -160,6 +164,9 @@ class TpuMeshSortExec(TpuExec):
     all_gather bounds -> all_to_all -> local sort, one XLA computation.
     Worker w's partition is the w-th key range, locally sorted."""
 
+    CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
+                             bound={"orders": 0})
+
     def __init__(self, child: TpuExec, orders: List[lp.SortOrder], mesh):
         super().__init__(child)
         self.mesh = mesh
@@ -203,6 +210,12 @@ class TpuMeshJoinExec(TpuShuffledJoinExec):
     per-worker partition pairs run the sort-merge join kernels. Inherits the
     per-pair join semantics (incl. full outer, which is correct per worker
     because co-partitioning makes key ownership disjoint)."""
+
+    # co-partitioning happens inside the fused all_to_all, not via child
+    # exchanges — so no "copartitioned" extra here
+    CONTRACT = exec_contract(schema="defined", partitioning="defined",
+                             bound={"left_keys": 0, "right_keys": 1},
+                             extras=("join_schema",))
 
     def __init__(self, left: TpuExec, right: TpuExec, how: str,
                  left_keys, right_keys, condition, mesh,

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Type
 
 from .. import config as cfg
+from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..ops import expressions as ex
 from ..ops import arithmetic as ar
@@ -452,6 +453,25 @@ class Overrides:
         node = self._insert_coalesce(self._convert(meta))
         if self.conf.get(cfg.HASH_OPTIMIZE_SORT):
             node = self._insert_hash_optimize_sorts(node)
+        # plan-contract validation (analysis/contracts.py): static checks
+        # over the converted tree, BEFORE execution. Violations append to
+        # the explain output so last_explain carries both fallback reasons
+        # and contract diagnostics; `error` mode rejects the plan.
+        from ..analysis import contracts as _contracts
+        try:
+            diag = _contracts.enforce(
+                node, meta, str(self.conf.get(cfg.ANALYSIS_VALIDATE_PLAN)))
+        except _contracts.PlanContractError as e:
+            # the rejection diagnostic still lands in last_explain so the
+            # test hook / UI shows WHY the plan was refused
+            self.last_explain = (self.last_explain + "\n" + str(e)
+                                 if self.last_explain else str(e))
+            raise
+        if diag:
+            self.last_explain = (self.last_explain + "\n" + diag
+                                 if self.last_explain else diag)
+            if mode != "NONE":
+                print(diag)
         return node
 
     def _insert_hash_optimize_sorts(self, node: ph.TpuExec) -> ph.TpuExec:
@@ -1104,6 +1124,16 @@ def _prune_scan_columns(root: lp.LogicalPlan) -> lp.LogicalPlan:
             # the pandas fn is a black box over the whole child frame(s)
             for c in p.children:
                 referenced.update(c.schema.names())
+        if isinstance(p, lp.Window):
+            # spec keys live OUTSIDE WindowExpression.children (the spec is
+            # not an expression child), so the generic collect below misses
+            # them — pruning the order/partition key off the scan would
+            # strand the window exec's bind (KeyError at conversion)
+            for _name, w in p.window_exprs:
+                for e in (list(w.spec.partition_by) +
+                          [o.child for o in w.spec.order_by]):
+                    for n in e.collect(lambda x: isinstance(x, ex.ColumnRef)):
+                        referenced.add(n.col_name)
         for e in p.expressions():
             for n in e.collect(lambda x: isinstance(x, ex.ColumnRef)):
                 referenced.add(n.col_name)
@@ -1155,6 +1185,9 @@ def _subtree_ok(meta: PlanMeta) -> bool:
 class _ReorderExec(ph.TpuExec):
     """Column reorder after a swapped right-outer join."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve",
+                             extras=("reorder_permutation",))
+
     def __init__(self, child: ph.TpuExec, schema: dt.Schema,
                  n_right: int, n_left: int):
         super().__init__(child)
@@ -1180,6 +1213,8 @@ class CpuOpBridgeExec(ph.TpuExec):
     """Runs ONE unsupported logical node on CPU over TPU-computed children
     (the GpuColumnarToRow -> CPU op -> RowToColumnar sandwich,
     GpuTransitionOverrides.scala transitions)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="single")
 
     def __init__(self, plan: lp.LogicalPlan, tpu_children: List[ph.TpuExec]):
         super().__init__(*tpu_children)

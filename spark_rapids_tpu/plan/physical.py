@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
+from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column, Scalar, bucket
@@ -168,7 +169,16 @@ class _Timer:
 # ---------------------------------------------------------------------------
 
 class TpuExec:
-    """Base physical operator (GpuExec trait analog)."""
+    """Base physical operator (GpuExec trait analog).
+
+    Every concrete subclass declares a ``CONTRACT``
+    (:func:`..analysis.contracts.exec_contract`): how its output schema
+    relates to its children and what distribution it produces. The
+    project linter enforces the declaration exists; the plan-contract
+    validator (``analysis/contracts.validate_plan``, run by the planner
+    after every conversion) enforces it holds."""
+
+    CONTRACT = None          # abstract base: concrete execs must declare
 
     def __init__(self, *children: "TpuExec"):
         self.children = list(children)
@@ -577,11 +587,15 @@ class _trace_exec:
 
 
 def _fused_fn(key: tuple, builder):
+    from ..analysis import recompile as _recompile
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         if len(_FUSED_CACHE) > 256:
             _FUSED_CACHE.clear()
         fn = _FUSED_CACHE[key] = builder()
+        _recompile.note_compile(_recompile.kernel_of(key), key)
+    else:
+        _recompile.note_call(_recompile.kernel_of(key))
     return fn
 
 
@@ -679,14 +693,26 @@ class FusedStage:
         import jax.numpy as jnp
         from ..exec.tracing import trace_span
         try:
+            from ..analysis import recompile as _recompile
             if self._fn is None:
                 ekeys = [_expr_cache_key(e) for e in self.exprs]
                 if any(k is None for k in ekeys):
                     self._fn = self._build()      # unkeyable: per-exec jit
+                    self._kernel = f"fused_{self.mode}_unkeyable"
+                    _recompile.note_compile(
+                        self._kernel, ("unkeyable", self.mode, id(self)))
                 else:
                     key = (self.mode, _schema_sig(self.in_schema),
                            tuple(ekeys))
+                    self._kernel = _recompile.kernel_of(key)
+                    # _fused_fn accounts this first call (compile or hit)
                     self._fn = _fused_fn(key, self._build)
+            else:
+                # later batches bypass the cache consult: count the call
+                # here or `calls` would track stage INSTANCES, not
+                # executions, and flagged()'s compile/call ratio would
+                # fire spuriously for fused project/filter families
+                _recompile.note_call(self._kernel)
             with trace_span(f"fused_{self.mode}"):
                 outs = self._fn(_dev_count(batch),
                                 *batch.flat_arrays())
@@ -735,6 +761,9 @@ def _dense_sig_supported(op: str, t) -> bool:
 
 class TpuLocalScanExec(TpuExec):
     """In-memory arrow table scan -> device batches (HostColumnarToGpu analog)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="source")
+
 
     def __init__(self, table, schema: dt.Schema, batch_rows: int = 1 << 20,
                  num_partitions: int = 1, base_data=None):
@@ -892,6 +921,9 @@ class TpuCachedScanExec(TpuExec):
     re-promoted) columns serve directly, no host conversion or upload
     (GpuInMemoryTableScanExec, reference spark310 shim)."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="single")
+
+
     def __init__(self, plan):
         super().__init__()
         self.plan = plan
@@ -925,6 +957,9 @@ class TpuCachedScanExec(TpuExec):
 
 class TpuRangeExec(TpuExec):
     """range() generated on device (GpuRangeExec, basicPhysicalOperators.scala:187)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="source")
+
 
     def __init__(self, start: int, end: int, step: int, num_partitions: int = 1,
                  batch_rows: int = 1 << 20):
@@ -972,6 +1007,10 @@ class TpuRangeExec(TpuExec):
 class TpuProjectExec(TpuExec):
     """Columnar projection (GpuProjectExec, basicPhysicalOperators.scala:64)."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve",
+                             bound={"exprs": 0})
+
+
     def __init__(self, child: TpuExec, exprs: List[ex.Expression]):
         super().__init__(child)
         self.exprs = [bind_refs(e, child.schema) for e in exprs]
@@ -1009,6 +1048,10 @@ class TpuFilterExec(TpuExec):
     """Columnar filter via compaction (GpuFilterExec + GpuFilter helper,
     basicPhysicalOperators.scala:98-132). Device count read at the batch
     boundary per the dynamic-size protocol."""
+
+    CONTRACT = exec_contract(schema="passthrough", partitioning="preserve",
+                             bound={"condition": 0})
+
 
     def __init__(self, child: TpuExec, condition: ex.Expression):
         super().__init__(child)
@@ -1061,6 +1104,9 @@ class TpuFilterExec(TpuExec):
 class TpuCoalesceBatchesExec(TpuExec):
     """Concatenate small batches up to a goal (GpuCoalesceBatches). goal:
     'single' (RequireSingleBatch) or target row count."""
+
+    CONTRACT = exec_contract(schema="passthrough", partitioning="preserve")
+
 
     def __init__(self, child: TpuExec, goal: Any = "single",
                  target_rows: int = 1 << 22):
@@ -1127,6 +1173,9 @@ class TpuHashAggregateExec(TpuExec):
     (merge partials + result projection). partial+final compose across a
     hash exchange exactly like the reference's two-phase planning.
     """
+
+    CONTRACT = exec_contract(schema="defined", partitioning="defined",
+                             extras=("agg_distribution",))
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
                  aggregate_exprs: List[ex.Expression], mode: str = "complete",
@@ -1702,7 +1751,7 @@ class TpuHashAggregateExec(TpuExec):
         build_eval = self._build_eval_fn(phase)
         pschema = self._partial_schema()
         if stats is None:
-            stats = np.asarray(dec)
+            stats = np.asarray(dec)  # lint: host-sync-ok window-degraded re-read of ONE batch's stats scalar
         span, absmaxes = stats[0], stats[2:]
         f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX for a in absmaxes))
         if span + 2 > agg_k.DENSE_MAX_SLOTS:
@@ -1739,7 +1788,7 @@ class TpuHashAggregateExec(TpuExec):
         build_eval = self._build_eval_fn(phase)
         pschema = self._partial_schema()
         if stats is None:
-            stats = np.asarray(dec)
+            stats = np.asarray(dec)  # lint: host-sync-ok window-degraded re-read of ONE batch's stats scalar
         n_groups = int(stats[0])
         f32_safe = bool(all(a <= agg_k.F32_SAFE_ABSMAX for a in stats[1:]))
         Kb = _bucket(max(n_groups, 1))
@@ -1982,6 +2031,9 @@ class TpuSortExec(TpuExec):
     """Device sort (GpuSortExec: cudf orderBy analog). Global sort concatenates
     the partition's batches (RequireSingleBatch when global, GpuSortExec.scala)."""
 
+    CONTRACT = exec_contract(schema="passthrough", partitioning="preserve",
+                             bound={"orders": 0})
+
     def __init__(self, child: TpuExec, orders: List[lp.SortOrder],
                  is_global: bool = True):
         super().__init__(child)
@@ -2019,6 +2071,8 @@ class TpuSortExec(TpuExec):
 
 class TpuLimitExec(TpuExec):
     """Local/global limit (limit.scala)."""
+
+    CONTRACT = exec_contract(schema="passthrough", partitioning="defined")
 
     def __init__(self, child: TpuExec, n: int, is_global: bool = True):
         super().__init__(child)
@@ -2068,6 +2122,8 @@ class TpuLimitExec(TpuExec):
 class TpuUnionExec(TpuExec):
     """Union all (GpuUnionExec)."""
 
+    CONTRACT = exec_contract(schema="union", partitioning="defined")
+
     @property
     def schema(self):
         return self.children[0].schema
@@ -2091,6 +2147,9 @@ class TpuUnionExec(TpuExec):
 class TpuExpandExec(TpuExec):
     """Grouping-sets expand (GpuExpandExec.scala): one output batch per
     projection list, unioned."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve",
+                             bound={"projections": 0})
 
     def __init__(self, child: TpuExec, projections: List[List[ex.Expression]],
                  output_names: List[str]):
@@ -2123,6 +2182,8 @@ class TpuMapInPandasExec(TpuExec):
     cross to pandas through Arrow, the user fn maps an iterator of frames,
     results re-enter the device columnar world. Input batches are re-aligned
     to a steady size first (RebatchingRoundoffIterator analog)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve")
 
     def __init__(self, child: TpuExec, plan: "lp.MapInPandas",
                  target_rows: int = 1 << 16):
@@ -2184,6 +2245,9 @@ class TpuFlatMapGroupsInPandasExec(TpuExec):
     on the keys first when the child is multi-partition, so every group's
     rows are co-located (requiredChildDistribution = clustered(keys))."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve",
+                             bound={"grouping": 0})
+
     def __init__(self, child: TpuExec, plan: "lp.FlatMapGroupsInPandas"):
         super().__init__(child)
         self.plan = plan
@@ -2233,6 +2297,8 @@ class TpuFlatMapCoGroupsInPandasExec(TpuExec):
     """cogroup().applyInPandas (GpuFlatMapCoGroupsInPandasExec): both
     sides drain to pandas, group frames pair up per key (union of key
     sets; a missing side passes an empty frame), fn maps each pair."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="defined")
 
     def __init__(self, left: TpuExec, right: TpuExec,
                  plan: "lp.FlatMapCoGroupsInPandas"):
@@ -2302,6 +2368,9 @@ class TpuAggregateInPandasExec(TpuExec):
     198 LoC in the reference): fn(Series...) -> scalar once per
     (group, udf); output = key columns + one column per udf."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve",
+                             bound={"grouping": 0})
+
     def __init__(self, child: TpuExec, plan: "lp.AggregateInPandas"):
         super().__init__(child)
         self.plan = plan
@@ -2365,6 +2434,8 @@ class TpuGenerateExec(TpuExec):
     ``Explode(StringSplit(s, d))`` fuses split+explode into one kernel —
     the intermediate array<string> never materializes."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="preserve")
+
     def __init__(self, child: TpuExec, plan: lp.Generate):
         super().__init__(child)
         from ..ops import arrays as ar_ops
@@ -2399,7 +2470,7 @@ class TpuGenerateExec(TpuExec):
                     pre = ar_ops.split_part_counts(arr,
                                                    ord(self.split_delim))
                     import jax.numpy as jnp
-                    total = int(jnp.sum(jnp.where(live, pre[1], 0)))
+                    total = int(jnp.sum(jnp.where(live, pre[1], 0)))  # lint: host-sync-ok generate output sizing: the dynamic-size protocol's batch-boundary read
                     out_cap = bucket(max(total, 1))
                     others, elem, pos_col, count = ar_ops.split_explode(
                         arr, ord(self.split_delim), batch.columns, live,
@@ -2435,6 +2506,10 @@ class TpuSortMergeJoinExec(TpuExec):
     side joined per batch (GpuShuffledHashJoinExec shape, but sort-merge
     kernels per DESIGN.md §3; build-side-single-batch mirrors
     GpuHashJoin.scala:193-249's stream loop)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="defined",
+                             bound={"left_keys": 0, "right_keys": 1},
+                             extras=("join_schema",))
 
     def __init__(self, left: TpuExec, right: TpuExec, how: str,
                  left_keys: List[ex.Expression], right_keys: List[ex.Expression],
@@ -2590,7 +2665,7 @@ class TpuSortMergeJoinExec(TpuExec):
                 if total is None:
                     # window-degraded entry (batched readback failed):
                     # re-read this batch's scalar alone
-                    total = jax.device_get(size_dev)
+                    total = jax.device_get(size_dev)  # lint: host-sync-ok window-degraded re-read of ONE batch's sizing scalar
                 out_cap = bucket(max(int(total), 1))
             s_out, b_out, cnt = join_k.join_gather(
                 m, batch.columns, build.columns, out_cap, how,
@@ -2636,6 +2711,10 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
     form, the build side is never materialized whole: one build partition at
     a time. Full outer is correct per partition pair because co-partitioning
     makes key ownership disjoint."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="defined",
+                             bound={"left_keys": 0, "right_keys": 1},
+                             extras=("join_schema", "copartitioned"))
 
     # runtime AQE join switch: set by the planner to the broadcast-join
     # byte threshold when adaptive execution is on (None = off)
@@ -2830,6 +2909,8 @@ class _SharedBuild:
 class TpuCrossJoinExec(TpuExec):
     """Cartesian product (GpuCartesianProductExec)."""
 
+    CONTRACT = exec_contract(schema="defined", partitioning="defined")
+
     def __init__(self, left: TpuExec, right: TpuExec,
                  condition: Optional[ex.Expression] = None):
         super().__init__(left, right)
@@ -2875,6 +2956,8 @@ class TpuCrossJoinExec(TpuExec):
 class CpuFallbackExec(TpuExec):
     """Executes a logical subtree on the CPU engine (the 'stays on CPU' side
     of a mixed plan; transition = GpuRowToColumnarExec analog on output)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="single")
 
     def __init__(self, plan: lp.LogicalPlan):
         super().__init__()

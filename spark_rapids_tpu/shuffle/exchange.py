@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..exec.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY, BufferCatalog,
@@ -106,6 +107,8 @@ class TpuShuffleExchangeExec(TpuExec):
     OBSERVED map-side sizes, the AQE + GpuCustomShuffleReaderExec behavior
     (GpuOverrides.scala:1920). Join exchanges stay fixed: both sides must
     keep identical partitioning."""
+
+    CONTRACT = exec_contract(schema="passthrough", partitioning="defined")
 
     def __init__(self, child: TpuExec, num_partitions: int,
                  by: Optional[List[ex.Expression]] = None,
@@ -367,6 +370,9 @@ class TpuShuffleExchangeExec(TpuExec):
 class TpuHashExchangeExec(TpuShuffleExchangeExec):
     """Hash exchange for aggregate/join key distribution (partial->final)."""
 
+    CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
+                             bound={"by": 0})
+
     def __init__(self, child: TpuExec, num_partitions: int,
                  keys: List[ex.Expression], adaptive_ok: bool = False,
                  adaptive_min_bytes: Optional[int] = None):
@@ -386,6 +392,9 @@ class TpuRangeExchangeExec(TpuExec):
     bounds, then split — the reference samples with a driver-side reservoir;
     here the sample is a per-batch random gather (~sample_target rows total).
     """
+
+    CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
+                             bound={"orders": 0})
 
     SAMPLE_TARGET_PER_PARTITION = 100
 
@@ -460,6 +469,8 @@ class TpuBroadcastExchangeExec(TpuExec):
     lazy device materialization on executors; standalone, the 'broadcast'
     is one registered spillable buffer re-acquired per stream partition).
     """
+
+    CONTRACT = exec_contract(schema="passthrough", partitioning="single")
 
     def __init__(self, child: TpuExec):
         super().__init__(child)

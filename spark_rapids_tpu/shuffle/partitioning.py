@@ -44,9 +44,11 @@ class TpuPartitioner:
         pids = jnp.where(live, pids, self.num_partitions)  # padding last
         order = jnp.argsort(pids, stable=True)
         sorted_cols = [K.gather_column(c, order) for c in batch.columns]
-        counts = np.asarray(jnp.bincount(
-            jnp.clip(pids, 0, self.num_partitions),
-            length=self.num_partitions + 1))[:self.num_partitions]
+        from ..analysis.sync_audit import allowed_host_transfer
+        with allowed_host_transfer("map-side split sizing"):
+            counts = np.asarray(jnp.bincount(  # lint: host-sync-ok map-side split sizing: one readback sizes every slice of this batch
+                jnp.clip(pids, 0, self.num_partitions),
+                length=self.num_partitions + 1))[:self.num_partitions]
         out: List[ColumnarBatch] = []
         offset = 0
         for p in range(self.num_partitions):

@@ -93,7 +93,9 @@ class ShuffleStore:
 
     def register_batch(self, shuffle_id: int, reduce_id: int,
                        batch: ColumnarBatch) -> int:
-        arrays = [np.asarray(a) for c in batch.columns for a in c.arrays()]
+        from ..analysis.sync_audit import allowed_host_transfer
+        with allowed_host_transfer("wire serialization"):
+            arrays = [np.asarray(a) for c in batch.columns for a in c.arrays()]  # lint: host-sync-ok wire serialization: the shuffle payload must cross to host
         descs = [ArrayDesc(str(a.dtype), a.shape, a.nbytes) for a in arrays]
         with self._mu:
             bid = self._next_id

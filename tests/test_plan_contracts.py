@@ -1,0 +1,178 @@
+"""Plan-contract validator (analysis/contracts.py): clean real plans
+validate with zero violations in every mode; seeded breakages are caught
+in warn mode (explain-integrated diagnostic) and rejected in error mode.
+"""
+
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as cfg
+from spark_rapids_tpu.analysis import contracts
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.plan import physical as ph
+from spark_rapids_tpu.plan.overrides import Overrides
+
+
+@pytest.fixture()
+def session():
+    return TpuSession.builder.getOrCreate()
+
+
+@pytest.fixture()
+def df(session):
+    return session.createDataFrame(pd.DataFrame({
+        "k": [1, 2, 1, 3, 2, 2], "v": [1., 2., 3., 4., 5., 6.],
+        "w": list("abcdef")}))
+
+
+def _exec_plan(session, frame, **conf):
+    ov = Overrides(session.conf.with_overrides(
+        {"spark.rapids.tpu.sql.analysis.validatePlan": "error", **conf}))
+    return ov, ov.apply(frame._analyzed())
+
+
+def _find(node, klass):
+    if isinstance(node, klass):
+        return node
+    for c in node.children:
+        found = _find(c, klass)
+        if found is not None:
+            return found
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Clean plans: zero violations, even in error mode
+# ---------------------------------------------------------------------------
+
+def test_real_plans_validate_clean(session, df):
+    df2 = session.createDataFrame(pd.DataFrame(
+        {"k": [1, 2, 4], "u": [10., 20., 30.]}))
+    shapes = [
+        df.filter(F.col("v") > 1).select((F.col("v") * 2).alias("v2")),
+        df.join(df2, on="k").groupBy("k").agg(F.sum("v").alias("sv")),
+        df.orderBy("v").limit(3),
+        df.select("k", "v").union(df.select("k", "v")).distinct(),
+        df.repartition(3, "k").groupBy("k").agg(F.count("v").alias("c")),
+    ]
+    for frame in shapes:
+        _ov, node = _exec_plan(session, frame)       # error mode: no raise
+        assert contracts.validate_plan(node) == []
+
+
+def test_every_converted_exec_declares_contract(session, df):
+    _ov, node = _exec_plan(session, df.groupBy("k").agg(
+        F.avg("v").alias("a")))
+
+    def walk(n):
+        assert type(n).CONTRACT is not None, type(n).__name__
+        for c in n.children:
+            walk(c)
+    walk(node)
+
+
+# ---------------------------------------------------------------------------
+# Seeded breakages
+# ---------------------------------------------------------------------------
+
+def _corrupt_filter_schema(node):
+    """Flip the filter's declared output dtypes (a passthrough exec lying
+    about its schema — exactly the drift the validator exists to catch).
+    The patched hook recurses with the conversion walk, so corrupt only
+    when (and once) a filter is actually in this subtree."""
+    filt = _find(node, ph.TpuFilterExec)
+    if filt is not None and not getattr(filt, "_corrupted", False):
+        filt._corrupted = True
+        filt._schema = dt.Schema([dt.Field(f.name, dt.INT64, f.nullable)
+                                  for f in filt._schema])
+    return node
+
+
+def test_seeded_schema_mismatch_warn_mode(session, df, monkeypatch):
+    frame = df.filter(F.col("v") > 1).select("v")
+    orig = Overrides._insert_coalesce
+    monkeypatch.setattr(Overrides, "_insert_coalesce",
+                        lambda self, n: _corrupt_filter_schema(orig(self, n)))
+    ov = Overrides(session.conf)                      # default mode: warn
+    node = ov.apply(frame._analyzed())                # must NOT raise
+    assert "contract" in ov.last_explain
+    assert "TpuFilterExec" in ov.last_explain
+    assert contracts.validate_plan(node) != []
+
+
+def test_seeded_schema_mismatch_error_mode(session, df, monkeypatch):
+    frame = df.filter(F.col("v") > 1).select("v")
+    orig = Overrides._insert_coalesce
+    monkeypatch.setattr(Overrides, "_insert_coalesce",
+                        lambda self, n: _corrupt_filter_schema(orig(self, n)))
+    ov = Overrides(session.conf.with_overrides(
+        {"spark.rapids.tpu.sql.analysis.validatePlan": "error"}))
+    with pytest.raises(contracts.PlanContractError) as ei:
+        ov.apply(frame._analyzed())
+    assert "TpuFilterExec" in str(ei.value)
+    # the rejection diagnostic is explain-integrated
+    assert "contract" in ov.last_explain
+
+
+def test_off_mode_skips_validation(session, df, monkeypatch):
+    frame = df.filter(F.col("v") > 1).select("v")
+    orig = Overrides._insert_coalesce
+    monkeypatch.setattr(Overrides, "_insert_coalesce",
+                        lambda self, n: _corrupt_filter_schema(orig(self, n)))
+    ov = Overrides(session.conf.with_overrides(
+        {"spark.rapids.tpu.sql.analysis.validatePlan": "off"}))
+    ov.apply(frame._analyzed())                       # no raise, no diag
+    assert "contract" not in ov.last_explain
+
+
+def test_bound_reference_drift_caught(session, df):
+    _ov, node = _exec_plan(session, df.select((F.col("v") + 1).alias("x")))
+    proj = _find(node, ph.TpuProjectExec)
+    from spark_rapids_tpu.ops import expressions as ex
+    refs = [r for e in proj.exprs
+            for r in e.collect(lambda x: isinstance(x, ex.BoundReference))]
+    assert refs
+    refs[0].ordinal = 99                              # stale rebind
+    violations = contracts.validate_plan(node)
+    assert any("ordinal 99" in v.message for v in violations)
+
+
+def test_missing_contract_detected(session, df):
+    class TpuNoContractExec(ph.TpuExec):              # no CONTRACT on purpose
+        @property
+        def schema(self):
+            return self.children[0].schema
+
+        def execute(self):
+            return self.children[0].execute()
+
+    _ov, node = _exec_plan(session, df.select("v"))
+    wrapped = TpuNoContractExec(node)
+    violations = contracts.validate_plan(wrapped)
+    assert any("no CONTRACT" in v.message for v in violations)
+
+
+def test_distribution_invariant_final_agg(session, df):
+    """A per-partition final merge demands the hash exchange below it."""
+    _ov, node = _exec_plan(
+        session, df.repartition(3, "k").groupBy("k").agg(
+            F.sum("v").alias("s")))
+    agg = _find(node, ph.TpuHashAggregateExec)
+    assert agg is not None and agg.per_partition_final
+    # sever the distribution: splice the exchange out from under the merge
+    agg.children = [agg.children[0].children[0]]
+    violations = contracts.validate_plan(node)
+    assert any("non-exchange child" in v.message for v in violations)
+
+
+def test_fallback_must_match_tagging_promise(session, df):
+    from spark_rapids_tpu.plan.overrides import PlanMeta
+    plan = df.select("v")._analyzed()
+    meta = PlanMeta(plan, session.conf)
+    meta.tag()
+    assert meta.can_replace
+    fallback = ph.CpuFallbackExec(plan)               # contradicts the promise
+    violations = contracts.validate_plan(fallback, meta)
+    assert any("contradicts the promise" in v.message for v in violations)

@@ -1,0 +1,150 @@
+"""Static-analysis gates (ISSUE 3): the linter runs clean over the repo,
+every lint rule trips on a deliberately-broken fixture, api_validation's
+registry diff is enforced, and the generated docs can never go stale.
+
+These tests are pure host-side (AST + text + subprocess); no jax device
+work, so they are cheap enough for tier-1.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "spark_rapids_tpu")
+
+from spark_rapids_tpu.analysis import lint  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# The repo itself is clean (the tier-1 enforcement of `python -m tools.lint`)
+# ---------------------------------------------------------------------------
+
+def test_lint_clean_over_repo():
+    violations = lint.run(PKG)
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_lint_cli_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint"], cwd=ROOT,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Every rule trips on a broken fixture (and the pragma silences it)
+# ---------------------------------------------------------------------------
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+def test_rule_host_sync_np_asarray():
+    src = "import numpy as np\n\ndef f(x):\n    return np.asarray(x)\n"
+    v = lint.lint_source(src, "ops/fixture.py")
+    assert _rules(v) == {"host-sync"} and len(v) == 1
+
+
+def test_rule_host_sync_device_get_and_block_until_ready():
+    src = ("import jax\n\ndef f(x):\n"
+           "    jax.device_get(x)\n"
+           "    return x.block_until_ready()\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert len(v) == 2 and _rules(v) == {"host-sync"}
+
+
+def test_rule_host_sync_scalar_readbacks():
+    src = ("import jax.numpy as jnp\n\ndef f(x):\n"
+           "    a = int(jnp.sum(x))\n"
+           "    b = float(jnp.max(x))\n"
+           "    c = x.item()\n"
+           "    return a, b, c\n")
+    v = lint.lint_source(src, "plan/physical.py")
+    assert len(v) == 3 and _rules(v) == {"host-sync"}
+
+
+def test_rule_host_sync_only_in_hot_modules():
+    src = "import numpy as np\n\ndef f(x):\n    return np.asarray(x)\n"
+    assert lint.lint_source(src, "columnar/fixture.py") == []
+    assert lint.lint_source(src, "api/fixture.py") == []
+
+
+def test_pragma_silences_and_requires_reason():
+    ok = ("import numpy as np\n\ndef f(x):\n"
+          "    return np.asarray(x)  "
+          "# lint: host-sync-ok the one documented sizing sync\n")
+    assert lint.lint_source(ok, "ops/fixture.py") == []
+    bare = ("import numpy as np\n\ndef f(x):\n"
+            "    return np.asarray(x)  # lint: host-sync-ok\n")
+    v = lint.lint_source(bare, "ops/fixture.py")
+    # a reason-less pragma does NOT silence the sync and is itself flagged
+    assert _rules(v) == {"host-sync", "pragma-reason"}
+
+
+def test_rule_allowlist_helpers_exempt():
+    src = ("import jax\n\nclass PipelineWindow:\n"
+           "    def _resolve(self, flat):\n"
+           "        return jax.device_get(flat)\n")
+    assert lint.lint_source(src, "exec/pipeline.py") == []
+
+
+def test_rule_exec_contract_missing():
+    src = ("class TpuFooExec(TpuExec):\n    pass\n\n"
+           "class TpuBarExec(TpuExec):\n    CONTRACT = object()\n")
+    v = lint.lint_source(src, "plan/physical.py")
+    assert len(v) == 1 and v[0].rule == "exec-contract" \
+        and "TpuFooExec" in v[0].message
+
+
+def test_rule_conf_docs_drift_both_directions():
+    config_src = (
+        'X = _conf("spark.rapids.tpu.sql.foo").doc("d")'
+        '.boolean_conf.create_with_default(True)\n'
+        'Y = _conf("spark.rapids.tpu.sql.hidden").doc("d").internal()'
+        '.boolean_conf.create_with_default(False)\n')
+    docs = ("| Name | Description | Default |\n|---|---|---|\n"
+            "| spark.rapids.tpu.sql.stale | gone | 1 |\n")
+    v = lint.check_conf_docs(config_src, docs)
+    msgs = "\n".join(x.message for x in v)
+    assert len(v) == 2
+    assert "spark.rapids.tpu.sql.foo" in msgs          # registered, undocumented
+    assert "spark.rapids.tpu.sql.stale" in msgs        # documented, unregistered
+    assert "hidden" not in msgs                        # internal keys exempt
+
+
+def test_conf_docs_in_sync_now():
+    with open(os.path.join(PKG, "config.py")) as f:
+        cfg_src = f.read()
+    with open(os.path.join(ROOT, "docs", "configs.md")) as f:
+        docs = f.read()
+    assert lint.check_conf_docs(cfg_src, docs) == []
+
+
+# ---------------------------------------------------------------------------
+# api_validation enforced in tier-1 (registry drift must fail loudly)
+# ---------------------------------------------------------------------------
+
+def test_api_validation_reports_no_problems():
+    from tools.api_validation import validate
+    report = validate()
+    assert report["ok"], report["problems"]
+    assert report["n_expressions"] > 50
+    assert report["n_execs"] > 10
+
+
+# ---------------------------------------------------------------------------
+# Doc-drift gate: generated docs byte-identical to a fresh regeneration.
+# Fresh subprocess: per-operator conf keys registered dynamically by earlier
+# tests in THIS process must not leak into the regenerated docs.
+# ---------------------------------------------------------------------------
+
+def test_generated_docs_not_stale():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_docs.py"),
+         "--check"],
+        cwd=ROOT, capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr

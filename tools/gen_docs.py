@@ -2,7 +2,12 @@
 registries (the reference generates docs/configs.md from RapidsConf.confHelp,
 RapidsConf.scala:133-168, and docs/supported_ops.md from its rule registry).
 
-Run: python tools/gen_docs.py
+Run: python tools/gen_docs.py            # rewrite the docs in place
+     python tools/gen_docs.py --check    # exit 1 if the docs are stale
+
+``--check`` is the doc-drift gate tier-1 runs (tests/test_static_analysis.py
+invokes it in a FRESH subprocess so dynamically-registered per-operator conf
+keys from earlier queries cannot leak into the comparison).
 """
 
 import os
@@ -12,12 +17,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def main() -> None:
+def generate() -> dict:
+    """Build every generated doc as {relative path: content}."""
     from spark_rapids_tpu.config import REGISTRY
     from spark_rapids_tpu.plan.overrides import _EXPR_RULES, PlanMeta
 
-    with open(os.path.join(ROOT, "docs", "configs.md"), "w") as f:
-        f.write(REGISTRY.help_text())
+    out = {"docs/configs.md": REGISTRY.help_text()}
 
     lines = [
         "# Supported operators and expressions",
@@ -74,10 +79,33 @@ def main() -> None:
         "complex types run on the CPU engine only (planner-tagged off "
         "the device).",
     ]
-    with open(os.path.join(ROOT, "docs", "supported_ops.md"), "w") as f:
-        f.write("\n".join(lines) + "\n")
-    print("regenerated docs/configs.md and docs/supported_ops.md")
+    out["docs/supported_ops.md"] = "\n".join(lines) + "\n"
+    return out
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    docs = generate()
+    stale = []
+    for rel, content in docs.items():
+        path = os.path.join(ROOT, rel)
+        if check:
+            with open(path) as f:
+                if f.read() != content:
+                    stale.append(rel)
+            continue
+        with open(path, "w") as f:
+            f.write(content)
+    if check:
+        if stale:
+            print("STALE generated docs: " + ", ".join(stale) +
+                  " (run: python tools/gen_docs.py)")
+            return 1
+        print("generated docs up to date")
+        return 0
+    print("regenerated " + " and ".join(docs))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

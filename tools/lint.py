@@ -1,0 +1,17 @@
+"""Project linter entry point: ``python -m tools.lint [package_dir] [--json]``.
+
+Thin wrapper over :mod:`spark_rapids_tpu.analysis.lint` (the AST rules live
+there so the analyzer's own tests import them directly); exits non-zero on
+any violation. See docs/analysis.md for the rules and the pragma format.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from spark_rapids_tpu.analysis.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
