@@ -33,7 +33,10 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
     session = TpuSession.builder.config(
         "spark.rapids.tpu.sql.explain", "NONE").config(
         "spark.rapids.tpu.sql.concurrentTpuTasks",
-        concurrent_tasks).getOrCreate()
+        concurrent_tasks).config(
+        # lock-order graph + per-lock wait/hold attribution on for bench
+        # runs (the documented tests/bench default for analysis.lockdep)
+        "spark.rapids.tpu.sql.analysis.lockdep", "record").getOrCreate()
 
     if suite == "tpcds":
         from . import tpcds_queries
@@ -56,12 +59,13 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
     names = query_names or list(queries)
     for name in names:
         from spark_rapids_tpu.exec.device import TpuSemaphore
-        from spark_rapids_tpu.analysis import recompile
+        from spark_rapids_tpu.analysis import lockdep, recompile
         qfn = queries[name]
         timings = []
         rows = 0
         sem0 = TpuSemaphore.get().stats()
         rc0 = recompile.snapshot()
+        lk0 = lockdep.stats()
         for it in range(iterations):
             t0 = time.perf_counter()
             df = qfn(tables)
@@ -89,6 +93,13 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         flags = recompile.flagged(entry["recompiles"])
         if flags:
             entry["recompileFlags"] = flags
+        # per-lock wait/hold deltas attributed to trace spans, next to
+        # the semaphore wait/hold split (analysis/lockdep.py): which
+        # lock a query's threads actually contended, and in which
+        # named execute region
+        locks = _lock_delta(lk0, lockdep.stats())
+        if locks:
+            entry["locks"] = locks
         try:
             m = session.last_query_metrics()
             entry["planTimeS"] = m.get("planTimeS")
@@ -102,10 +113,50 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         if verify:
             entry["verified"] = _verify(session, qfn(tables))
         report["queries"][name] = entry
+    # run-level lockdep findings: order-inversion cycles (with both
+    # acquisition stacks) and lock-held-across-transfer events
+    from spark_rapids_tpu.analysis import lockdep
+    lk = lockdep.report()
+    if lk["cycles"] or lk["heldAcrossTransfer"]:
+        report["lockdep"] = {
+            "cycles": lk["cycles"],
+            "heldAcrossTransfer": [
+                {"locks": t["locks"], "transfer": t["transfer"]}
+                for t in lk["heldAcrossTransfer"]],
+        }
     if output:
         with open(output, "w") as f:
             json.dump(report, f, indent=2)
     return report
+
+
+def _lock_delta(before: Dict, after: Dict) -> Dict:
+    """Per-lock growth of wait/hold/acquires (and per-span attribution)
+    between two lockdep.stats() snapshots, dropping untouched locks."""
+    out: Dict = {}
+    for name, now in after.items():
+        was = before.get(name, {"waitS": 0.0, "holdS": 0.0, "acquires": 0,
+                                "spans": {}})
+        d = {"waitS": round(now["waitS"] - was["waitS"], 4),
+             "holdS": round(now["holdS"] - was["holdS"], 4),
+             "acquires": now["acquires"] - was["acquires"]}
+        # acquires counts at acquire but holdS accrues at release, so a
+        # lock taken before the window and released inside it shows
+        # acquires == 0 with nonzero holdS — exactly the long-hold stall
+        # the metric exists to expose
+        if not (d["acquires"] or d["waitS"] or d["holdS"]):
+            continue
+        spans = {}
+        for s, v in now["spans"].items():
+            w = was["spans"].get(s, {"waitS": 0.0, "holdS": 0.0})
+            ds = {"waitS": round(v["waitS"] - w["waitS"], 4),
+                  "holdS": round(v["holdS"] - w["holdS"], 4)}
+            if ds["waitS"] or ds["holdS"]:
+                spans[s] = ds
+        if spans:
+            d["spans"] = spans
+        out[name] = d
+    return out
 
 
 def _verify(session, df, epsilon: float = 1e-4) -> bool:

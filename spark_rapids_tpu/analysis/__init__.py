@@ -1,6 +1,6 @@
 """Static analysis passes over the engine's own invariants.
 
-Four cooperating passes (the ApiValidation.scala / assertIsOnTheGpu shape
+Six cooperating passes (the ApiValidation.scala / assertIsOnTheGpu shape
 of tooling, turned on the invariants this port's hot paths depend on):
 
 * :mod:`.lint` — AST project linter (``python -m tools.lint``): no implicit
@@ -16,6 +16,19 @@ of tooling, turned on the invariants this port's hot paths depend on):
 * :mod:`.recompile` — recompile audit: distinct compiled shapes per fused
   kernel, flagging operators that compile once per batch shape (missed
   capacity-bucket padding).
+* :mod:`.concurrency` — static concurrency linter over the
+  thread-reachable modules: every lock on the lockdep registry
+  (``raw-lock``), shared-state mutation under its owner's lock
+  (``unguarded-state``), no blocking IO/readback/second-acquire inside a
+  ``with <lock>:`` body (``lock-blocking``), the ``_instance``/``_lock``
+  singleton pattern fully guarded (``singleton-guard``).
+* :mod:`.lockdep` — runtime lock-order tracking: named-lock wrappers the
+  engine's locks live on, a global acquisition-order graph with
+  both-stack cycle reports (``record``) or raises (``enforce``),
+  per-lock wait/hold stats attributed to trace spans, and
+  held-across-host-transfer detection via ``sync_audit``.
+
+docs/analysis.md documents all of them.
 
 None of these import jax at module import time; the engine stays importable
 in analysis-only contexts (the linter runs on a bare checkout).

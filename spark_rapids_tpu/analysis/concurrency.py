@@ -1,0 +1,588 @@
+"""Static concurrency linter: the lexical half of the engine's locking
+discipline (``analysis/lockdep.py`` is the runtime half).
+
+Scope — the thread-reachable modules: ``exec/``, ``shuffle/``,
+``analysis/``, ``config.py``, ``api/session.py``. These are the modules
+whose code runs on partition-drain pool threads, shuffle accept/handler
+threads, or is process-singleton state those threads share. Pure AST +
+text; no engine import.
+
+Rules (all wired into ``python -m tools.lint``, tier-1-enforced):
+
+``raw-lock``
+    A ``threading.Lock/RLock/Semaphore/BoundedSemaphore/Condition()``
+    creation in a scoped module. Engine locks must be created through
+    ``lockdep.named_lock``/``named_rlock`` so the runtime order graph and
+    wait/hold attribution see them. (``threading.local`` and
+    ``threading.Event`` are exempt — confinement and signalling, not
+    mutual exclusion; ``analysis/lockdep.py`` itself is exempt: its
+    internal leaf lock cannot be self-instrumented.)
+
+``unguarded-state``
+    Mutation of shared state outside a recognized ``with <lock>:`` guard.
+    The discipline is ownership-scoped to stay decidable: a CLASS that
+    owns a lock must mutate its instance/class attributes under it; a
+    MODULE that owns a module-level lock must mutate its ``global``s
+    under it. Lock-free classes are presumed thread-confined — giving a
+    class shared state means giving it a (named) lock, which arms this
+    rule. Exemptions: ``__init__``/``__new__`` bodies (construction is
+    single-threaded), helpers named ``*_locked`` (the called-with-lock-
+    held convention, e.g. ``_spill_device_to_locked``), attributes that
+    hold ``threading.local()`` values, and targets reached *through* a
+    thread-local attribute.
+
+``lock-blocking``
+    A call that can block — another lock/semaphore ``acquire`` or nested
+    ``with <lock>:``, socket send/recv/accept/connect, file IO
+    (``open``/``np.load``/``np.savez*``), ``subprocess``, ``time.sleep``,
+    an ``allowed_host_transfer`` crossing, or a device readback
+    (``np.asarray``, ``jax.device_get``, ``.block_until_ready()``,
+    ``.item()``, ``float/int/bool`` over a jnp call) — lexically inside a
+    ``with <lock>:`` body. Holding a mutex across a link round trip or a
+    disk write serializes every peer thread behind IO.
+
+``singleton-guard``
+    For classes using the ``_instance``/``_lock`` singleton pattern:
+    every read and write of ``_instance`` must sit inside a recognized
+    ``with <lock>:`` guard.
+
+Suppression mirrors the linter's host-sync pragma — one pragma per rule,
+reason mandatory, on the flagged line (or the line above)::
+
+    self._cache = v  # lint: unguarded-ok <why this is safe>
+    save(path, *a)   # lint: lock-blocking-ok <why the hold is required>
+    self._sem = threading.Semaphore(n)  # lint: raw-lock-ok <why raw>
+    cls._instance    # lint: singleton-guard-ok <why unguarded>
+
+Reason-less pragmas are themselves flagged (``pragma-reason``) and do
+not suppress. Registry: ``python -m tools.lint --locks`` dumps every
+lock creation site with its canonical name; duplicate lockdep names
+across the package are flagged (``lock-name-dup``) because the runtime
+order graph keys on them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .lint import LintViolation
+
+SCOPE_PREFIXES = ("exec/", "shuffle/", "analysis/")
+SCOPE_FILES = ("config.py", "api/session.py")
+# the instrumentation layer's own internals cannot be self-instrumented
+RAW_LOCK_EXEMPT = ("analysis/lockdep.py",)
+
+RAW_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
+                  "Condition"}
+NAMED_LOCK_CTORS = {"named_lock", "named_rlock", "NamedLock", "NamedRLock"}
+
+PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*(raw-lock|unguarded|lock-blocking|singleton-guard)"
+    r"-ok(.*)$")
+
+# fallback guard recognition for locks the registry pass didn't see
+# (e.g. a lock attribute assigned in another module)
+GUARD_NAME_RE = re.compile(r"^_?[a-z0-9_]*(lock|mu|mutex)$")
+
+CONSTRUCTORS = ("__init__", "__new__")
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in SCOPE_FILES
+
+
+@dataclass
+class LockSite:
+    """One lock creation site (the rule-(a) registry entry)."""
+    path: str
+    rel: str
+    line: int
+    kind: str             # threading ctor or named_lock/named_rlock
+    attr: str             # terminal attribute/variable name bound
+    canonical: str        # module-qualified name, or the declared
+                          # lockdep name for named locks
+
+
+def _terminal_name(target: ast.AST) -> Optional[str]:
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def _pragmas(source: str) -> Dict[int, Tuple[str, str]]:
+    """line -> (rule, reason)."""
+    out: Dict[int, Tuple[str, str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            rule = ("unguarded-state" if m.group(1) == "unguarded"
+                    else m.group(1))
+            out[i] = (rule, m.group(2).strip())
+    return out
+
+
+def _lock_ctor(value: ast.AST) -> Optional[str]:
+    """'threading.X' / named-lock kind when ``value`` creates a lock."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Attribute) and f.attr in RAW_LOCK_CTORS and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return f"threading.{f.attr}"
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name in NAMED_LOCK_CTORS:
+        return name
+    return None
+
+
+def _is_local_ctor(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call) and
+            isinstance(value.func, ast.Attribute) and
+            value.func.attr == "local" and
+            isinstance(value.func.value, ast.Name) and
+            value.func.value.id == "threading")
+
+
+class _Analyzer(ast.NodeVisitor):
+    """Single-pass visitor emitting the unguarded-state / lock-blocking /
+    singleton-guard hits over one module, after a pre-scan that decides
+    lock ownership (which classes/modules own locks, which attributes
+    are thread-local)."""
+
+    def __init__(self, rel: str, tree: ast.Module) -> None:
+        self.rel = rel
+        self.hits: List[Tuple[int, str, str]] = []   # (line, rule, msg)
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+        self._global_stack: List[Set[str]] = []
+        self._with_locks: List[str] = []     # guard names currently open
+        # -- pre-scan results --
+        self.lock_attrs: Set[str] = set()            # all lock-bound names
+        self.localish: Set[str] = set()              # threading.local attrs
+        self.module_locks: Set[str] = set()          # module-level lock vars
+        self.class_locks: Dict[str, Set[str]] = {}   # class -> lock attrs
+        self.singletons: Set[str] = set()            # classes w/ _instance+_lock
+        self._prescan(tree)
+
+    # -- pre-scan ------------------------------------------------------------
+
+    def _prescan(self, tree: ast.Module) -> None:
+        ctx_of: Dict[ast.AST, Tuple[Optional[str], bool]] = {}
+
+        def walk(node, cls, in_func):
+            for child in ast.iter_child_nodes(node):
+                c, f = cls, in_func
+                if isinstance(child, ast.ClassDef):
+                    c = child.name
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    f = True
+                ctx_of[child] = (c, f)
+                walk(child, c, f)
+        walk(tree, None, False)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            cls, in_func = ctx_of.get(node, (None, False))
+            kind = _lock_ctor(node.value)
+            for t in node.targets:
+                name = _terminal_name(t)
+                if name is None:
+                    continue
+                if kind is not None:
+                    self.lock_attrs.add(name)
+                    if isinstance(t, ast.Attribute) and cls is not None:
+                        self.class_locks.setdefault(cls, set()).add(name)
+                    elif isinstance(t, ast.Name):
+                        if cls is not None:
+                            self.class_locks.setdefault(cls,
+                                                        set()).add(name)
+                        elif not in_func:
+                            self.module_locks.add(name)
+                if _is_local_ctor(node.value):
+                    self.localish.add(name)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                body_names = {
+                    _terminal_name(t)
+                    for st in node.body if isinstance(st, ast.Assign)
+                    for t in st.targets}
+                body_names |= {
+                    st.target.id for st in node.body
+                    if isinstance(st, ast.AnnAssign) and
+                    isinstance(st.target, ast.Name)}
+                if "_instance" in body_names and "_lock" in body_names:
+                    self.singletons.add(node.name)
+
+    # -- context helpers -----------------------------------------------------
+
+    @property
+    def _cls(self) -> Optional[str]:
+        return self._class_stack[-1] if self._class_stack else None
+
+    @property
+    def _func(self) -> Optional[str]:
+        return self._func_stack[-1] if self._func_stack else None
+
+    def _is_guard(self, expr: ast.AST) -> Optional[str]:
+        """The guard name when ``expr`` is a recognized lock object."""
+        name = _terminal_name(expr)
+        if name is None:
+            return None
+        if name in self.lock_attrs or GUARD_NAME_RE.match(name):
+            return name
+        return None
+
+    def _exempt_func(self) -> bool:
+        f = self._func
+        return f in CONSTRUCTORS or (f is not None and
+                                     f.endswith("_locked"))
+
+    def _hit(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.hits.append((node.lineno, rule, msg))
+
+    # -- traversal -----------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node.name)
+        self._global_stack.append(set())
+        outer_with = self._with_locks
+        self._with_locks = []        # a def body runs later, not under the
+        self.generic_visit(node)     # lexically-enclosing with
+        self._with_locks = outer_with
+        self._global_stack.pop()
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Global(self, node: ast.Global) -> None:
+        if self._global_stack:
+            self._global_stack[-1].update(node.names)
+
+    def visit_With(self, node: ast.With) -> None:
+        guards = []
+        for item in node.items:
+            g = self._is_guard(item.context_expr)
+            if g is not None:
+                guards.append(g)
+                if self._with_locks:
+                    self._hit(
+                        node, "lock-blocking",
+                        f"nested acquisition of {g} while holding "
+                        f"{self._with_locks[-1]}: a second lock under a "
+                        "held lock is a blocking wait and an order-graph "
+                        "edge — document the order (pragma) or "
+                        "restructure")
+        for item in node.items:              # exprs evaluate pre-acquire,
+            self.visit(item.context_expr)    # under only the OUTER locks
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self._with_locks.extend(guards)
+        for st in node.body:
+            self.visit(st)
+        if guards:
+            del self._with_locks[len(self._with_locks) - len(guards):]
+
+    # -- mutations (unguarded-state) ----------------------------------------
+
+    def _owning_class_locks(self) -> Set[str]:
+        cls = self._cls
+        return self.class_locks.get(cls, set()) if cls else set()
+
+    def _through_local(self, target: ast.AST) -> bool:
+        """Target chain passes through a threading.local attribute
+        (self._held.value = ...) — thread-confined by construction."""
+        node = target
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+            if isinstance(node, ast.Attribute) and node.attr in self.localish:
+                return True
+            if isinstance(node, ast.Name) and node.id in self.localish:
+                return True
+        return False
+
+    def _base_attr(self, target: ast.AST) -> Optional[str]:
+        """For self.X / cls.X / <ClassName>.X targets (possibly behind a
+        subscript: self._buffers[k] = v), the attribute name X."""
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if not isinstance(node, ast.Attribute):
+            return None
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in (
+                "self", "cls", self._cls):
+            return node.attr
+        return None
+
+    def _check_mutation(self, node: ast.AST, targets: List[ast.AST],
+                        value: Optional[ast.AST]) -> None:
+        if self._exempt_func() or self._with_locks:
+            return
+        declared_global = self._global_stack[-1] if self._global_stack \
+            else set()
+        for t in targets:
+            if self._through_local(t):
+                continue
+            if isinstance(t, ast.Name) and t.id in declared_global:
+                if not self.module_locks or t.id in self.lock_attrs:
+                    continue
+                if value is not None and _is_local_ctor(value):
+                    continue
+                locks = ", ".join(sorted(self.module_locks))
+                self._hit(
+                    node, "unguarded-state",
+                    f"global {t.id} mutated outside `with <lock>:` but "
+                    f"the module owns a lock ({locks}) — guard the write "
+                    "or pragma `# lint: unguarded-ok <reason>`")
+                continue
+            attr = self._base_attr(t)
+            if attr is not None:
+                if not self._owning_class_locks():
+                    continue
+                if attr in self.lock_attrs or attr in self.localish:
+                    continue
+                if value is not None and _is_local_ctor(value):
+                    continue
+                locks = ", ".join(sorted(self._owning_class_locks()))
+                self._hit(
+                    node, "unguarded-state",
+                    f"{self._cls}.{attr} mutated outside `with <lock>:` "
+                    f"but {self._cls} owns a lock ({locks}) — guard the "
+                    "mutation, move it into a *_locked helper, or pragma "
+                    "`# lint: unguarded-ok <reason>`")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_mutation(node, node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_mutation(node, [node.target], None)
+        self.generic_visit(node)
+
+    # -- blocking calls under a lock + singleton guard ----------------------
+
+    _SOCKET_VERBS = {"send", "sendall", "recv", "accept", "connect"}
+    _SUBPROCESS = {"run", "check_call", "check_output", "Popen", "call"}
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            a, v = f.attr, f.value
+            if a == "acquire":
+                return "lock/semaphore acquire"
+            if a in self._SOCKET_VERBS:
+                return f"socket .{a}()"
+            if isinstance(v, ast.Name) and v.id in ("np", "numpy", "_np"):
+                if a in ("load", "savez", "savez_compressed", "save"):
+                    return f"np.{a} disk IO"
+                if a == "asarray":
+                    return "np.asarray device readback"
+            if isinstance(v, ast.Name) and v.id == "subprocess" and \
+                    a in self._SUBPROCESS:
+                return f"subprocess.{a}"
+            if isinstance(v, ast.Name) and v.id == "time" and a == "sleep":
+                return "time.sleep"
+            if a == "device_get" and isinstance(v, ast.Name) and \
+                    v.id == "jax":
+                return "jax.device_get readback"
+            if a == "block_until_ready":
+                return ".block_until_ready() device barrier"
+            if a == "item" and not node.args and not node.keywords:
+                return ".item() scalar readback"
+        elif isinstance(f, ast.Name):
+            if f.id == "open":
+                return "open() file IO"
+            if f.id == "allowed_host_transfer":
+                return "allowed_host_transfer crossing"
+            if f.id in ("float", "int", "bool") and len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call) and \
+                        isinstance(arg.func, ast.Attribute) and \
+                        isinstance(arg.func.value, ast.Name) and \
+                        arg.func.value.id in ("jnp", "jax"):
+                    return f"{f.id}() scalar readback over a jax call"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._with_locks:
+            reason = self._blocking_reason(node)
+            if reason is not None:
+                self._hit(
+                    node, "lock-blocking",
+                    f"{reason} inside `with {self._with_locks[-1]}:` — "
+                    "snapshot state under the lock, do the blocking work "
+                    "unlocked, re-take to publish (or pragma "
+                    "`# lint: lock-blocking-ok <reason>`)")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "_instance" and self.singletons and \
+                isinstance(node.value, ast.Name):
+            vid = node.value.id
+            targets_singleton = vid in self.singletons or (
+                vid in ("cls", self._cls) and self._cls in self.singletons)
+            if targets_singleton and not any(
+                    g == "_lock" or g.endswith("_lock")
+                    for g in self._with_locks):
+                self._hit(
+                    node, "singleton-guard",
+                    f"{vid}._instance accessed outside `with <cls>._lock:`"
+                    " — the singleton pattern needs BOTH reads and writes "
+                    "under the class lock (or pragma "
+                    "`# lint: singleton-guard-ok <reason>`)")
+        self.generic_visit(node)
+
+    # -- registry ------------------------------------------------------------
+
+    def collect_sites(self, tree: ast.Module, path: str) -> None:
+        qual_of: Dict[ast.AST, str] = {}
+
+        def walk(node, qual):
+            for child in ast.iter_child_nodes(node):
+                q = qual
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                qual_of[child] = q
+                walk(child, q)
+        walk(tree, "")
+
+        self.sites: List[LockSite] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _lock_ctor(node.value)
+            if kind is None:
+                continue
+            for t in node.targets:
+                attr = _terminal_name(t)
+                if attr is None:
+                    continue
+                if kind in NAMED_LOCK_CTORS:
+                    call = node.value
+                    canonical = (call.args[0].value
+                                 if call.args and
+                                 isinstance(call.args[0], ast.Constant)
+                                 else f"{self.rel}:{attr}")
+                else:
+                    qual = qual_of.get(node, "")
+                    # creation inside __init__ belongs to the class
+                    qual = re.sub(r"\.__init__$", "", qual)
+                    canonical = f"{self.rel}:{qual + '.' if qual else ''}" \
+                                f"{attr}"
+                self.sites.append(LockSite(
+                    path=path, rel=self.rel, line=node.lineno, kind=kind,
+                    attr=attr, canonical=canonical))
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, rel: str, path: Optional[str] = None
+                ) -> List[LintViolation]:
+    """Concurrency rules over one module (``rel`` relative to the
+    package root). Returns [] for out-of-scope modules."""
+    path = path or rel
+    if not in_scope(rel):
+        return []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []                       # lint.py already reports parse errors
+    pragmas = _pragmas(source)
+    out: List[LintViolation] = []
+
+    for line, (rule, reason) in pragmas.items():
+        if not reason:
+            tag = "unguarded" if rule == "unguarded-state" else rule
+            out.append(LintViolation(
+                path, line, "pragma-reason",
+                f"{tag}-ok pragma missing its justification "
+                f"(format: `# lint: {tag}-ok <reason>`)"))
+
+    a = _Analyzer(rel, tree)
+    a.visit(tree)
+
+    if rel not in RAW_LOCK_EXEMPT:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                kind = _lock_ctor(node)
+                if kind is not None and kind.startswith("threading."):
+                    a.hits.append((
+                        node.lineno, "raw-lock",
+                        f"{kind}() bypasses the lockdep registry — create "
+                        "engine locks via analysis.lockdep.named_lock/"
+                        "named_rlock so order tracking and wait/hold "
+                        "attribution see them (or pragma "
+                        "`# lint: raw-lock-ok <reason>`)"))
+
+    for line, rule, msg in sorted(a.hits):
+        suppressed = any(
+            ln in pragmas and pragmas[ln][0] == rule and pragmas[ln][1]
+            for ln in (line, line - 1))
+        if not suppressed:
+            out.append(LintViolation(path, line, rule, msg))
+    return out
+
+
+def lock_registry(package_dir: str) -> List[LockSite]:
+    """Every lock/semaphore/condition creation site in the scoped
+    modules, with canonical names (rule (a): the registry other rules
+    and the runtime share)."""
+    sites: List[LockSite] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, package_dir).replace(os.sep, "/")
+            if not in_scope(rel):
+                continue
+            with open(full, "r") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            a = _Analyzer(rel, tree)
+            a.collect_sites(tree, full)
+            sites.extend(a.sites)
+    return sites
+
+
+def check_registry(sites: List[LockSite]) -> List[LintViolation]:
+    """Cross-module registry checks: duplicate lockdep names (the
+    runtime order graph keys on them, so two locks sharing a name would
+    alias their edges)."""
+    out: List[LintViolation] = []
+    seen: Dict[str, LockSite] = {}
+    for s in sites:
+        if s.kind not in NAMED_LOCK_CTORS:
+            continue
+        prev = seen.get(s.canonical)
+        if prev is not None and (prev.rel, prev.line) != (s.rel, s.line):
+            out.append(LintViolation(
+                s.path, s.line, "lock-name-dup",
+                f"lockdep name {s.canonical!r} already registered at "
+                f"{prev.rel}:{prev.line} — runtime order edges would "
+                "alias; pick a unique canonical name"))
+        else:
+            seen[s.canonical] = s
+    return out

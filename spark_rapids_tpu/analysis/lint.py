@@ -204,6 +204,12 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
                         path, node.lineno, "exec-contract",
                         f"exec class {node.name} declares no CONTRACT "
                         "(analysis/contracts.exec_contract)"))
+
+    # concurrency rules (raw-lock / unguarded-state / lock-blocking /
+    # singleton-guard) over the thread-reachable modules — lazy import:
+    # concurrency.py imports LintViolation from here
+    from . import concurrency
+    out.extend(concurrency.lint_source(source, rel, path=path))
     return out
 
 
@@ -310,15 +316,28 @@ def run(package_dir: str, docs_dir: Optional[str] = None
         out.extend(check_conf_docs(cfg_src, docs_text,
                                    config_path=config_path,
                                    docs_path=docs_path))
+    # cross-module concurrency check: duplicate lockdep names alias
+    # runtime order edges
+    from . import concurrency
+    out.extend(concurrency.check_registry(
+        concurrency.lock_registry(package_dir)))
     return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
+    show_locks = "--locks" in argv
     argv = [a for a in argv if not a.startswith("--")]
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     package_dir = argv[0] if argv else here
+    if show_locks:
+        from . import concurrency
+        sites = concurrency.lock_registry(package_dir)
+        for s in sites:
+            print(f"{s.rel}:{s.line}: {s.canonical} ({s.kind})")
+        print(f"{len(sites)} lock site(s)")
+        return 0
     violations = run(package_dir)
     if as_json:
         print(json.dumps([vars(v) for v in violations], indent=2))

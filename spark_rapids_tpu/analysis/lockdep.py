@@ -1,0 +1,428 @@
+"""Runtime lock-order tracking (lockdep) for the threaded engine.
+
+The engine is concurrency-first: partition drains run on a
+``ThreadPoolExecutor`` (exec/tasks.py), the shuffle transport spawns
+accept/handler threads, and the spill catalog / device manager / conf
+registry are process singletons coordinating those threads through locks.
+``analysis/concurrency.py`` checks the *lexical* discipline at lint time;
+this module checks the *dynamic* discipline at run time — which locks are
+actually taken while which others are held, in what order, and for how
+long.
+
+Every engine lock is created through :func:`named_lock` /
+:func:`named_rlock` instead of ``threading.Lock()`` (the static
+``raw-lock`` rule enforces this), which re-homes it onto a process-wide
+registry. When armed (``spark.rapids.tpu.sql.analysis.lockdep`` =
+``record`` | ``enforce``; default ``off``), each acquisition
+
+* records the edge ``held -> acquired`` into a global lock-order graph,
+  capturing BOTH acquisition stacks the first time an edge is seen, so an
+  order-inversion report names the two code paths that disagree;
+* detects order-inversion cycles (``A`` taken under ``B`` somewhere after
+  ``B`` was taken under ``A`` elsewhere — a potential deadlock even if it
+  never deadlocked in this run): logged once per cycle in ``record``,
+  raised as :class:`LockOrderInversionError` in ``enforce`` (the wrapped
+  lock is released first so the raise cannot itself leak a held lock);
+* accumulates per-lock wait/hold seconds attributed to the innermost open
+  trace span (the same attribution ``SyncCounter`` uses for readbacks),
+  surfaced per query by ``benchmarks/runner.py`` next to the semaphore
+  wait/hold split;
+* flags host transfers performed while holding any registry lock:
+  ``sync_audit.allowed_host_transfer`` calls :func:`note_host_transfer`,
+  so a spill/wire crossing that sneaks under a lock is recorded
+  (``record``) or raised (``enforce``) unless the holding code path
+  sanctioned it with :func:`allowed_while_locked`.
+
+Mode is primed EAGERLY (session bootstrap calls :func:`refresh_mode`
+with the session conf; tests call it directly) rather than lazily at
+first acquire — a lazy read would recurse through the very conf-registry
+lock it is instrumenting. Unprimed processes run with lockdep off and
+the wrappers degrade to one mode check per acquire.
+
+When ``off``, a named lock is a plain lock plus one string-compare per
+acquire; ``record`` adds two perf_counter reads, a thread-local list
+push/pop, and (only on a never-seen graph edge) one stack capture.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+MODES = ("off", "record", "enforce")
+
+log = logging.getLogger("spark_rapids_tpu.lockdep")
+
+_MAX_FINDINGS = 200          # cap on stored transfer findings (record mode)
+_STACK_LIMIT = 18            # frames captured per acquisition stack
+
+
+class LockOrderInversionError(RuntimeError):
+    """Two code paths acquire the same two locks in opposite orders — a
+    potential deadlock. The message carries both acquisition stacks."""
+
+
+class LockHeldAcrossTransferError(RuntimeError):
+    """A host transfer ran while this thread held a registry lock, and no
+    enclosing :func:`allowed_while_locked` sanctioned it."""
+
+
+class _State:
+    """Global lockdep state. The internal ``_mu`` is a RAW lock by design
+    (it is the instrumentation's own leaf lock: nothing blocking ever
+    runs under it, and wrapping it would recurse)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # (held_name, acquired_name) -> {"count": int, "stack": str}
+        self.edges: Dict[Tuple[str, str], Dict] = {}
+        self.succ: Dict[str, set] = {}          # name -> set of successors
+        self.stats: Dict[str, Dict] = {}        # name -> wait/hold/spans
+        self.cycles: List[Dict] = []            # inversion reports
+        self.transfers: List[Dict] = []         # held-across-transfer finds
+        self.registered: Dict[str, int] = {}    # name -> creation count
+        self._reported: set = set()             # cycle pairs already logged
+
+
+_state = _State()
+_mode = "off"
+_tls = threading.local()     # .held: List[[name, t_acq, reentrant, span, id]]
+                             # .allow: int (allowed_while_locked depth)
+
+
+# ---------------------------------------------------------------------------
+# Mode management (eager priming — see module docstring)
+# ---------------------------------------------------------------------------
+
+def lockdep_mode() -> str:
+    return _mode
+
+
+def refresh_mode(conf=None) -> str:
+    """Prime the mode from ``conf`` (a TpuConf or a literal mode string),
+    else from the active session's conf, else process defaults + env.
+    Called by session bootstrap; safe to call any time."""
+    global _mode
+    if isinstance(conf, str):
+        _mode = conf if conf in MODES else "off"
+        return _mode
+    try:
+        from .. import config as cfg
+        if conf is None:
+            try:
+                from ..api.session import TpuSession
+                # deliberate lock-free read: taking the session lock here
+                # would recurse into the instrumentation being configured
+                conf = TpuSession._active.conf  # type: ignore[union-attr]
+            except Exception:
+                conf = None
+        if conf is None:
+            conf = cfg.TpuConf()
+        mode = str(conf.get(cfg.ANALYSIS_LOCKDEP)).lower()
+        _mode = mode if mode in MODES else "off"
+    except Exception:
+        _mode = "off"
+    return _mode
+
+
+def reset_state() -> None:
+    """Drop the order graph, stats, and findings (tests)."""
+    global _state
+    _state = _State()
+
+
+# ---------------------------------------------------------------------------
+# Named lock wrappers
+# ---------------------------------------------------------------------------
+
+def _held() -> List[list]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _current_span() -> Optional[str]:
+    try:
+        from ..exec.tracing import SpanRecorder
+        rec = SpanRecorder.active
+        return rec.current_span() if rec is not None else None
+    except Exception:
+        return None
+
+
+def _stack() -> str:
+    return "".join(traceback.format_stack(limit=_STACK_LIMIT)[:-2])
+
+
+def _stat(name: str) -> Dict:
+    return _state.stats.setdefault(
+        name, {"waitS": 0.0, "holdS": 0.0, "acquires": 0, "spans": {}})
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS over the order graph; a path src -> ... -> dst."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _state.succ.get(node, ()):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquired(name: str, held: List[list]) -> None:
+    """Record order edges held->name; detect inversion cycles. Raises in
+    enforce mode (caller releases the raw lock first)."""
+    # NOTE: a held lock with the SAME name but a different object (two
+    # instances of one lock class, e.g. two SpillableBuffer._lock) is NOT
+    # filtered out: it records the self-edge name -> name, which closes a
+    # cycle immediately — same-class nesting is indistinguishable from an
+    # ABBA deadlock when instances share a canonical name, so (kernel-
+    # lockdep style) it is reported unless the design removes the nesting.
+    held_names = [e[0] for e in held if not e[2]]
+    if not held_names:
+        return
+    stack_now = None
+    raise_report = None
+    with _state._mu:
+        for h in dict.fromkeys(held_names):        # de-dup, keep order
+            edge = (h, name)
+            ent = _state.edges.get(edge)
+            if ent is None:
+                if stack_now is None:
+                    stack_now = _stack()
+                ent = _state.edges[edge] = {"count": 0, "stack": stack_now}
+                _state.succ.setdefault(h, set()).add(name)
+                # a NEW edge is the only thing that can close a cycle
+                path = _find_path(name, h)
+                if path is not None:
+                    pair = frozenset((h, name))
+                    report = {
+                        "cycle": [h] + path,       # h -> name -> ... -> h
+                        "edge": f"{h} -> {name}",
+                        "edgeStack": stack_now,
+                        "reverse": " -> ".join(path),
+                        "reverseStacks": {
+                            f"{a} -> {b}":
+                                _state.edges.get((a, b), {}).get("stack", "")
+                            for a, b in zip(path, path[1:])},
+                    }
+                    _state.cycles.append(report)
+                    if pair not in _state._reported:
+                        _state._reported.add(pair)
+                        if _mode == "enforce":
+                            raise_report = report
+                        else:
+                            log.warning(
+                                "lock-order inversion: %s while the reverse "
+                                "order %s was recorded\n-- this acquisition:"
+                                "\n%s-- first reverse acquisition:\n%s",
+                                report["edge"], report["reverse"],
+                                report["edgeStack"],
+                                next(iter(report["reverseStacks"].values()),
+                                     ""))
+            ent["count"] += 1
+    if raise_report is not None:
+        rev = next(iter(raise_report["reverseStacks"].values()), "")
+        raise LockOrderInversionError(
+            f"lock-order inversion: acquiring {name} while holding "
+            f"{held_names} contradicts the recorded order "
+            f"{raise_report['reverse']}\n-- this acquisition:\n"
+            f"{raise_report['edgeStack']}-- first reverse acquisition:\n"
+            f"{rev}")
+
+
+class NamedLock:
+    """``threading.Lock`` re-homed onto the lockdep registry."""
+
+    _factory = staticmethod(threading.Lock)
+    reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._raw = self._factory()
+        with _state._mu:
+            _state.registered[name] = _state.registered.get(name, 0) + 1
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _mode == "off":
+            return self._raw.acquire(blocking, timeout)
+        held = _held()
+        # re-entrancy is judged by lock OBJECT, not name: two instances of
+        # a shared-name lock class nested in one thread are a real order
+        # edge (and a same-class nesting finding), not a re-entry
+        my_id = id(self)
+        reentrant = self.reentrant and any(
+            e[4] == my_id for e in held)
+        t0 = time.perf_counter()
+        ok = self._raw.acquire(blocking, timeout)
+        if not ok:
+            return False
+        now = time.perf_counter()
+        if not reentrant:
+            try:
+                _note_acquired(self.name, held)
+            except LockOrderInversionError:
+                # never leak a held lock out of a refused acquisition
+                self._raw.release()
+                raise
+        span = _current_span()
+        held.append([self.name, now, reentrant, span, my_id])
+        if not reentrant:
+            with _state._mu:
+                st = _stat(self.name)
+                st["waitS"] += now - t0
+                st["acquires"] += 1
+                if span:
+                    sp = st["spans"].setdefault(
+                        span, {"waitS": 0.0, "holdS": 0.0})
+                    sp["waitS"] += now - t0
+        return True
+
+    def release(self) -> None:
+        held = getattr(_tls, "held", None)
+        entry = None
+        if held:
+            my_id = id(self)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][4] == my_id:
+                    entry = held.pop(i)
+                    break
+        self._raw.release()
+        if entry is not None and not entry[2]:
+            held_for = time.perf_counter() - entry[1]
+            with _state._mu:
+                st = _stat(self.name)
+                st["holdS"] += held_for
+                if entry[3]:
+                    sp = st["spans"].setdefault(
+                        entry[3], {"waitS": 0.0, "holdS": 0.0})
+                    sp["holdS"] += held_for
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class NamedRLock(NamedLock):
+    """``threading.RLock`` on the registry: re-entrant acquisitions are
+    tracked (so release stays symmetric) but contribute no order edges
+    and no double-counted hold time."""
+
+    _factory = staticmethod(threading.RLock)
+    reentrant = True
+
+    def locked(self) -> bool:          # RLock has no .locked(); best effort
+        acquired = self._raw.acquire(blocking=False)
+        if acquired:
+            self._raw.release()
+        return not acquired
+
+
+def named_lock(name: str) -> NamedLock:
+    return NamedLock(name)
+
+
+def named_rlock(name: str) -> NamedRLock:
+    return NamedRLock(name)
+
+
+# ---------------------------------------------------------------------------
+# Host-transfer integration (sync_audit calls in here)
+# ---------------------------------------------------------------------------
+
+def held_locks() -> List[str]:
+    """Names of registry locks this thread currently holds (outermost
+    first, re-entrant acquisitions collapsed)."""
+    return list(dict.fromkeys(
+        e[0] for e in getattr(_tls, "held", ()) if not e[2]))
+
+
+@contextmanager
+def allowed_while_locked(reason: str):
+    """Sanction host transfers under a held registry lock for this block
+    (the synchronous-spill path: the admission lock MUST serialize tier
+    moves, so the readback under it is the design, not an accident).
+    ``reason`` is mandatory so every sanction documents itself — grep:
+    ``grep -rn 'allowed_while_locked' spark_rapids_tpu/``."""
+    assert reason, "allowed_while_locked requires a reason"
+    _tls.allow = getattr(_tls, "allow", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.allow -= 1
+
+
+def note_host_transfer(reason: str) -> None:
+    """Called by ``sync_audit.allowed_host_transfer`` at every sanctioned
+    host crossing: records (or, in enforce, raises on) crossings made
+    while this thread holds a registry lock without an enclosing
+    :func:`allowed_while_locked`."""
+    if _mode == "off":
+        return
+    if getattr(_tls, "allow", 0):
+        return
+    held = held_locks()
+    if not held:
+        return
+    finding = {"locks": held, "transfer": reason, "stack": _stack()}
+    if _mode == "enforce":
+        raise LockHeldAcrossTransferError(
+            f"host transfer ({reason}) while holding {held} — narrow the "
+            "critical section or sanction it with "
+            f"lockdep.allowed_while_locked(<reason>)\n{finding['stack']}")
+    with _state._mu:
+        if len(_state.transfers) < _MAX_FINDINGS:
+            _state.transfers.append(finding)
+
+
+# ---------------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------------
+
+def stats() -> Dict[str, Dict]:
+    """Per-lock cumulative wait/hold seconds, acquire counts, and the
+    per-span attribution (bench runner reads deltas of this)."""
+    with _state._mu:
+        out = {}
+        for name, st in sorted(_state.stats.items()):
+            out[name] = {
+                "waitS": round(st["waitS"], 4),
+                "holdS": round(st["holdS"], 4),
+                "acquires": st["acquires"],
+                "spans": {s: {"waitS": round(v["waitS"], 4),
+                              "holdS": round(v["holdS"], 4)}
+                          for s, v in sorted(st["spans"].items())},
+            }
+        return out
+
+
+def report() -> Dict:
+    """Full lockdep report: mode, per-lock stats, the order graph, every
+    inversion (with both stacks), and held-across-transfer findings."""
+    with _state._mu:
+        edges = [{"edge": f"{a} -> {b}", "count": e["count"]}
+                 for (a, b), e in sorted(_state.edges.items())]
+        cycles = list(_state.cycles)
+        transfers = list(_state.transfers)
+    return {"mode": _mode, "locks": stats(), "edges": edges,
+            "cycles": cycles, "heldAcrossTransfer": transfers}

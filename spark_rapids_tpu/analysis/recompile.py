@@ -20,15 +20,16 @@ is a dict increment per fused-program call).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
+
+from .lockdep import named_lock
 
 # flag a kernel once it has compiled this many times AND compiles on at
 # least half of its calls — a well-bucketed kernel stream compiles a
 # handful of shapes then hits the cache forever
 FLAG_MIN_COMPILES = 8
 
-_lock = threading.Lock()
+_lock = named_lock("analysis.recompile._lock")
 # name -> {keys: set, compiles: int, calls: int}. ``compiles`` counts
 # EVERY cache-miss build (a same-key recompile after the fused cache
 # evicts is real churn and must show), ``keys`` counts distinct shapes.
@@ -42,16 +43,19 @@ def _enabled() -> bool:
         try:
             from .. import config as cfg
             from .sync_audit import _effective_conf
-            _enabled_cache = bool(
+            enabled = bool(
                 _effective_conf().get(cfg.ANALYSIS_RECOMPILE_AUDIT))
         except Exception:
-            _enabled_cache = True
+            enabled = True
+        with _lock:
+            _enabled_cache = enabled
     return _enabled_cache
 
 
 def reset_cache() -> None:
     global _enabled_cache
-    _enabled_cache = None
+    with _lock:
+        _enabled_cache = None
 
 
 def kernel_of(key: Any) -> str:

@@ -29,14 +29,15 @@ runtime (the static linter guards it at review time):
 from __future__ import annotations
 
 import contextlib
-import threading
 from typing import Optional
+
+from .lockdep import named_lock
 
 MODES = ("off", "log", "disallow")
 
 _mode_cache: Optional[str] = None
 _armed = 0                      # count of live audited regions (any thread)
-_lock = threading.Lock()
+_lock = named_lock("analysis.sync_audit._lock")
 
 
 def _effective_conf():
@@ -62,16 +63,18 @@ def audit_mode() -> str:
     global _mode_cache
     if _mode_cache is None:
         from .. import config as cfg
-        _mode_cache = str(
-            _effective_conf().get(cfg.ANALYSIS_SYNC_AUDIT)).lower()
-        if _mode_cache not in MODES:
-            _mode_cache = "off"
+        mode = str(_effective_conf().get(cfg.ANALYSIS_SYNC_AUDIT)).lower()
+        if mode not in MODES:
+            mode = "off"
+        with _lock:
+            _mode_cache = mode
     return _mode_cache
 
 
 def reset_cache() -> None:
     global _mode_cache
-    _mode_cache = None
+    with _lock:
+        _mode_cache = None
 
 
 @contextlib.contextmanager
@@ -102,6 +105,12 @@ def allowed_host_transfer(reason: str):
     ``reason`` is required purely so call sites document themselves —
     grep: ``grep -rn 'allowed_host_transfer' spark_rapids_tpu/``."""
     assert reason, "allowed_host_transfer requires a reason"
+    # lockdep integration: a sanctioned host crossing made while this
+    # thread holds a registry lock is a blocking-under-lock hazard —
+    # recorded (record) or raised (enforce) unless the holding path
+    # wrapped itself in lockdep.allowed_while_locked(<reason>)
+    from . import lockdep
+    lockdep.note_host_transfer(reason)
     if not _armed:
         yield
         return

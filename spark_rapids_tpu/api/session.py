@@ -11,6 +11,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from .. import config as cfg
+from ..analysis.lockdep import named_lock
 from ..columnar import dtypes as dt
 from ..plan import logical as lp
 from .dataframe import DataFrame
@@ -63,7 +64,7 @@ class TpuSession:
     builder = _BuilderAccessor()
 
     _active: Optional["TpuSession"] = None
-    _lock = threading.Lock()
+    _lock = named_lock("api.session.TpuSession._lock")
 
     def __init__(self, conf: Optional[cfg.TpuConf] = None):
         self.conf = conf or cfg.TpuConf()
@@ -86,9 +87,12 @@ class TpuSession:
         # audit caches prime from the ACTIVE session's conf at first use;
         # a new session (possibly with different analysis.* keys) must
         # re-prime them
-        from ..analysis import recompile, sync_audit
+        from ..analysis import lockdep, recompile, sync_audit
         sync_audit.reset_cache()
         recompile.reset_cache()
+        # lockdep primes EAGERLY from THIS session's conf (a lazy read at
+        # first acquire would recurse through the conf-registry lock)
+        lockdep.refresh_mode(self.conf)
 
     @classmethod
     def active(cls) -> "TpuSession":

@@ -140,8 +140,9 @@ class _ConfBuilder:
 
 class ConfRegistry:
     def __init__(self) -> None:
+        from .analysis.lockdep import named_lock
         self._entries: Dict[str, ConfEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("config.ConfRegistry._lock")
 
     def conf(self, key: str) -> _ConfBuilder:
         return _ConfBuilder(self, key)
@@ -431,6 +432,17 @@ ANALYSIS_RECOMPILE_AUDIT = _conf(
     "operators compiling once per batch shape (missed capacity-bucket "
     "padding); the bench runner reports per-query deltas "
     "(analysis/recompile.py)").boolean_conf.create_with_default(True)
+
+ANALYSIS_LOCKDEP = _conf("spark.rapids.tpu.sql.analysis.lockdep").doc(
+    "Runtime lock-order tracking over the engine's named locks "
+    "(analysis/lockdep.py): off, record (build the lock-order graph, log "
+    "order-inversion cycles and lock-held-across-host-transfer findings, "
+    "accumulate per-lock wait/hold stats attributed to trace spans — the "
+    "tests/bench default), enforce (raise LockOrderInversionError / "
+    "LockHeldAcrossTransferError at the offending acquisition, with both "
+    "acquisition stacks)").string_conf.check(
+        lambda v: str(v).lower() in ("off", "record", "enforce")
+).create_with_default("off")
 
 
 class TpuConf:

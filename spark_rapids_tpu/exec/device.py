@@ -18,6 +18,7 @@ import threading
 from typing import Optional
 
 from .. import config as cfg
+from ..analysis.lockdep import named_lock
 
 
 class DeviceManager:
@@ -25,7 +26,7 @@ class DeviceManager:
     analog, Plugin.scala:124-154 executor init)."""
 
     _instance: Optional["DeviceManager"] = None
-    _lock = threading.Lock()
+    _lock = named_lock("exec.device.DeviceManager._lock")
 
     def __init__(self, conf: Optional[cfg.TpuConf] = None):
         import jax
@@ -82,13 +83,16 @@ class TpuSemaphore:
     undifferentiated ``semaphore_acquire`` bucket."""
 
     _instance: Optional["TpuSemaphore"] = None
-    _lock = threading.Lock()
+    _lock = named_lock("exec.device.TpuSemaphore._lock")
 
     def __init__(self, max_concurrent: int):
         self.max_concurrent = max_concurrent
-        self._sem = threading.Semaphore(max_concurrent)
+        # deliberately raw: the admission semaphore is HELD across whole
+        # device task bodies (transfers included) by contract — it is
+        # instrumented separately with the wait/hold split below
+        self._sem = threading.Semaphore(max_concurrent)  # lint: raw-lock-ok admission semaphore, held across device work by design; wait/hold instrumented here
         self._held = threading.local()
-        self._stats_mu = threading.Lock()
+        self._stats_mu = named_lock("exec.device.TpuSemaphore._stats_mu")
         self.wait_s = 0.0
         self.hold_s = 0.0
         self.acquires = 0

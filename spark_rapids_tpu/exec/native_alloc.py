@@ -21,8 +21,10 @@ import subprocess
 import threading
 from typing import Dict, Optional
 
+from ..analysis.lockdep import named_lock
+
 _FAIL = (1 << 64) - 1
-_lib_lock = threading.Lock()
+_lib_lock = named_lock("exec.native_alloc._lib_lock")
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
@@ -40,7 +42,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         try:
             if (not os.path.exists(out) or
                     os.path.getmtime(out) < os.path.getmtime(src)):
-                subprocess.run(
+                subprocess.run(  # lint: lock-blocking-ok one-time toolchain compile must be serialized; every later call hits the cached .so
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      src, "-o", out],
                     check=True, capture_output=True, timeout=120)
@@ -70,7 +72,7 @@ class _PyAllocator:
         self._free: Dict[int, int] = {0: size} if size else {}
         self._used: Dict[int, int] = {}
         self.allocated_bytes = 0
-        self._mu = threading.Lock()
+        self._mu = named_lock("exec.native_alloc._PyAllocator._mu")
 
     def allocate(self, want: int) -> Optional[int]:
         if want <= 0:

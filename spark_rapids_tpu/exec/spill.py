@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis import lockdep
+from ..analysis.lockdep import named_lock, named_rlock
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column
@@ -88,52 +90,97 @@ class SpillableBuffer:
         # python-object payloads that never touch the device; they ride the
         # buffer untiered (already host-resident, nothing to spill)
         self._obj_cols = obj_cols or {}
-        self._lock = threading.RLock()
+        # every buffer lock shares ONE lockdep name (a lock CLASS, kernel-
+        # lockdep style): order edges are per class of lock, not per buffer
+        self._lock = named_rlock("exec.spill.SpillableBuffer._lock")
         self.size_bytes = sum(
             a.size * a.dtype.itemsize for a in (device_arrays or []))
 
     # -- tier movement -------------------------------------------------------
+    #
+    # Tier moves follow the snapshot/work/publish shape: grab array refs
+    # under the lock, do the blocking device readback or disk write
+    # UNLOCKED (holding a mutex across a link round trip or an npz write
+    # serializes every peer thread behind IO), then re-take the lock and
+    # flip the tier only if no concurrent move/free won the race.
+
     def spill_to_host(self) -> int:
         with self._lock:
-            if self.tier != StorageTier.DEVICE:
+            if self.tier != StorageTier.DEVICE or \
+                    self._device_arrays is None:
                 return 0
-            from ..analysis.sync_audit import allowed_host_transfer
-            with allowed_host_transfer("spill tier: device->host move"):
-                self._host_arrays = [np.asarray(a) for a in self._device_arrays]  # lint: host-sync-ok spill tier: the device->host move IS the operation
+            dev = list(self._device_arrays)
+        from ..analysis.sync_audit import allowed_host_transfer
+        with allowed_host_transfer("spill tier: device->host move"):
+            host = [np.asarray(a) for a in dev]  # lint: host-sync-ok spill tier: the device->host move IS the operation
+        with self._lock:
+            if self.tier != StorageTier.DEVICE or \
+                    self._device_arrays is None:
+                return 0               # concurrent spill/free won the race
+            self._host_arrays = host
             self._device_arrays = None
             self.tier = StorageTier.HOST
             return self.size_bytes
 
     def spill_to_disk(self, spill_dir: str) -> int:
+        self.spill_to_host()           # no-op unless device-resident
         with self._lock:
-            if self.tier == StorageTier.DEVICE:
-                self.spill_to_host()
-            if self.tier != StorageTier.HOST:
+            if self.tier != StorageTier.HOST or self._host_arrays is None:
                 return 0
-            os.makedirs(spill_dir, exist_ok=True)
-            path = os.path.join(spill_dir, f"spill-{self.id}.npz")
-            # codec per spill.compression.codec (TableCompressionCodec
-            # analog for the disk tier; zlib = np's deflate container)
-            from .. import config as cfg
-            codec = str(cfg.TpuConf().get(cfg.SPILL_COMPRESSION_CODEC))
-            save = np.savez_compressed if codec == "zlib" else np.savez
-            save(path, *self._host_arrays)
-            self._disk_path = path
-            self._host_arrays = None
-            self.tier = StorageTier.DISK
-            return self.size_bytes
+            host = self._host_arrays
+        os.makedirs(spill_dir, exist_ok=True)
+        # per-attempt unique path: a racing spill of the same buffer must
+        # never clobber (or unlink) the winner's file
+        path = os.path.join(
+            spill_dir, f"spill-{self.id}-{next(_id_counter)}.npz")
+        # codec per spill.compression.codec (TableCompressionCodec
+        # analog for the disk tier; zlib = np's deflate container)
+        from .. import config as cfg
+        codec = str(cfg.TpuConf().get(cfg.SPILL_COMPRESSION_CODEC))
+        save = np.savez_compressed if codec == "zlib" else np.savez
+        save(path, *host)
+        with self._lock:
+            if self.tier != StorageTier.HOST or \
+                    self._host_arrays is not host:
+                won = False            # concurrent move/free won the race
+            else:
+                self._disk_path = path
+                self._host_arrays = None
+                self.tier = StorageTier.DISK
+                won = True
+        if not won:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return 0
+        return self.size_bytes
 
     def _load_arrays(self) -> List[Any]:
         """Arrays at whatever tier, promoted to device (RapidsBuffer
-        .getColumnarBatch re-promotion, RapidsBufferStore.scala:275-301)."""
+        .getColumnarBatch re-promotion, RapidsBufferStore.scala:275-301).
+        Snapshot under the lock, materialize unlocked (np.load and the
+        host->device transfer both block)."""
         import jax.numpy as jnp
         with self._lock:
-            if self.tier == StorageTier.DEVICE:
-                return self._device_arrays
-            if self.tier == StorageTier.HOST:
-                return [jnp.asarray(a) for a in self._host_arrays]
-            with np.load(self._disk_path) as z:
+            tier = self.tier
+            dev, host, path = (self._device_arrays, self._host_arrays,
+                               self._disk_path)
+        if tier == StorageTier.DEVICE:
+            if dev is None:
+                raise BufferLostError(f"buffer {self.id} was freed")
+            return dev
+        if tier == StorageTier.HOST:
+            if host is None:
+                raise BufferLostError(f"buffer {self.id} was freed")
+            return [jnp.asarray(a) for a in host]
+        try:
+            with np.load(path) as z:
                 return [jnp.asarray(z[k]) for k in z.files]
+        except (FileNotFoundError, TypeError) as e:
+            raise BufferLostError(
+                f"buffer {self.id} disk payload vanished mid-read "
+                f"(concurrent free): {e}") from None
 
     def get_batch(self, promote: bool = True) -> ColumnarBatch:
         from ..columnar.column import build_column
@@ -173,7 +220,7 @@ class BufferCatalog:
     the three RapidsBufferStores collapsed into one coordinator)."""
 
     _instance: Optional["BufferCatalog"] = None
-    _lock = threading.Lock()
+    _lock = named_lock("exec.spill.BufferCatalog._lock")
 
     def __init__(self, device_budget: int = 1 << 34,
                  host_budget: int = 1 << 33,
@@ -186,25 +233,35 @@ class BufferCatalog:
         self.host_bytes = 0
         self.spilled_device_bytes = 0     # metrics: total spilled (task metrics analog)
         self.spilled_host_bytes = 0
-        self._mu = threading.RLock()
+        self._mu = named_rlock("exec.spill.BufferCatalog._mu")
 
     @classmethod
     def get(cls) -> "BufferCatalog":
+        # double-checked creation: dependencies are built OUTSIDE the
+        # class lock. The old shape called DeviceManager.get() (which
+        # takes DeviceManager._lock and can probe the device) while
+        # holding BufferCatalog._lock — an undocumented cross-singleton
+        # order edge that lockdep flagged on its first clean run
+        with cls._lock:
+            inst = cls._instance
+        if inst is not None:
+            return inst
+        from .. import config as cfg
+        conf = cfg.TpuConf()
+        try:
+            # real device budget even when no session was built —
+            # the 16 GiB constructor default is only a last resort
+            from .device import DeviceManager
+            device_budget = DeviceManager.get(conf).memory_budget_bytes
+        except Exception:
+            device_budget = 1 << 34
+        candidate = BufferCatalog(
+            device_budget=device_budget,
+            host_budget=conf.host_spill_storage_size,
+            spill_dir=conf.spill_dir)
         with cls._lock:
             if cls._instance is None:
-                from .. import config as cfg
-                conf = cfg.TpuConf()
-                try:
-                    # real device budget even when no session was built —
-                    # the 16 GiB constructor default is only a last resort
-                    from .device import DeviceManager
-                    device_budget = DeviceManager.get(conf).memory_budget_bytes
-                except Exception:
-                    device_budget = 1 << 34
-                cls._instance = BufferCatalog(
-                    device_budget=device_budget,
-                    host_budget=conf.host_spill_storage_size,
-                    spill_dir=conf.spill_dir)
+                cls._instance = candidate
             return cls._instance
 
     @classmethod
@@ -249,7 +306,7 @@ class BufferCatalog:
             if buf.tier != StorageTier.DEVICE:
                 target = self.device_budget - buf.size_bytes
                 if self.device_bytes > target:
-                    self._spill_device_to(max(target, 0))
+                    self._spill_device_to_locked(max(target, 0))
                 prev_tier = buf.tier
                 arrays = buf._load_arrays()
                 buf.promote_to_device(arrays)
@@ -279,38 +336,44 @@ class BufferCatalog:
         with self._mu:
             target = self.device_budget - nbytes
             if self.device_bytes > target:
-                self._spill_device_to(max(target, 0))
+                self._spill_device_to_locked(max(target, 0))
 
     def _maybe_spill_locked(self) -> None:
         if self.device_bytes > self.device_budget:
-            self._spill_device_to(self.device_budget)
+            self._spill_device_to_locked(self.device_budget)
 
-    def _spill_device_to(self, target: int) -> None:
+    def _spill_device_to_locked(self, target: int) -> None:
         """Pop lowest-priority device buffers and push to host tier
-        (RapidsBufferStore.synchronousSpill, RapidsBufferStore.scala:139-201)."""
+        (RapidsBufferStore.synchronousSpill, RapidsBufferStore.scala:139-201).
+        Caller holds ``self._mu`` (the ``_locked`` convention)."""
         device_bufs = sorted(
             (b for b in self.buffers.values() if b.tier == StorageTier.DEVICE),
             key=lambda b: b.priority)
-        for buf in device_bufs:
-            if self.device_bytes <= target:
-                break
-            moved = buf.spill_to_host()
-            self.device_bytes -= moved
-            self.host_bytes += moved
-            self.spilled_device_bytes += moved
+        with lockdep.allowed_while_locked(
+                "synchronous spill: the admission lock serializes tier "
+                "moves by design (DeviceMemoryEventHandler analog)"):
+            for buf in device_bufs:
+                if self.device_bytes <= target:
+                    break
+                moved = buf.spill_to_host()
+                self.device_bytes -= moved
+                self.host_bytes += moved
+                self.spilled_device_bytes += moved
         if self.host_bytes > self.host_budget:
-            self._spill_host_to(self.host_budget)
+            self._spill_host_to_locked(self.host_budget)
 
-    def _spill_host_to(self, target: int) -> None:
+    def _spill_host_to_locked(self, target: int) -> None:
         host_bufs = sorted(
             (b for b in self.buffers.values() if b.tier == StorageTier.HOST),
             key=lambda b: b.priority)
-        for buf in host_bufs:
-            if self.host_bytes <= target:
-                break
-            moved = buf.spill_to_disk(self.spill_dir)
-            self.host_bytes -= moved
-            self.spilled_host_bytes += moved
+        with lockdep.allowed_while_locked(
+                "synchronous host->disk cascade under the admission lock"):
+            for buf in host_bufs:
+                if self.host_bytes <= target:
+                    break
+                moved = buf.spill_to_disk(self.spill_dir)
+                self.host_bytes -= moved
+                self.spilled_host_bytes += moved
 
 
 class SpillableColumnarBatch:

@@ -107,5 +107,8 @@ def run_partition_tasks(parts: Sequence[Any],
 
     if len(parts) <= 1 or max_workers <= 1:
         return [task((i, p)) for i, p in enumerate(parts)]
-    with ThreadPoolExecutor(max_workers=min(max_workers, len(parts))) as pool:
+    # named pool threads: lockdep acquisition stacks and teardown reports
+    # attribute lock traffic to the drain pool instead of Thread-N
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(parts)),
+                            thread_name_prefix="tpu-task") as pool:
         return list(pool.map(task, enumerate(parts)))

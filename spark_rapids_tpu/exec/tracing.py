@@ -78,18 +78,19 @@ class SpanRecorder:
     def __init__(self):
         import collections
         import threading
+        from ..analysis.lockdep import named_lock
         self._self_s = collections.defaultdict(float)
         self._count = collections.defaultdict(int)
-        self._mu = threading.Lock()
+        self._mu = named_lock("exec.tracing.SpanRecorder._mu")
         self._tls = threading.local()
 
     def __enter__(self):
-        self._prev = SpanRecorder.active
-        SpanRecorder.active = self
+        self._prev = SpanRecorder.active  # lint: unguarded-ok recorder entered on the driving thread only; pool workers read .active, never swap it
+        SpanRecorder.active = self  # lint: unguarded-ok single driving-thread swap; worker reads race only with query start/end, where no spans are open
         return self
 
     def __exit__(self, *exc):
-        SpanRecorder.active = self._prev
+        SpanRecorder.active = self._prev  # lint: unguarded-ok same single driving-thread swap as __enter__
         return False
 
     def _stack(self):

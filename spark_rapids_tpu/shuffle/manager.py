@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.lockdep import named_lock
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from .transport import (ShuffleClient, ShuffleDesyncError, ShuffleFetchError,
@@ -50,6 +51,11 @@ class WorkerContext:
     exchange exec planned afterwards."""
 
     current: Optional["WorkerContext"] = None
+    # class-level: ``current`` is a CLASS attribute, so its two writers
+    # (init_worker, shutdown) must share one lock — a per-instance lock
+    # would let a dying context's check-then-clear race a fresh
+    # init_worker and clobber the new context
+    _current_mu = named_lock("shuffle.manager.WorkerContext._current_mu")
 
     def __init__(self, worker_id: int, n_workers: int,
                  port: int = 0, codec: str = "none",
@@ -65,11 +71,11 @@ class WorkerContext:
         self.fetch_timeout_s = fetch_timeout_s
         self._next_shuffle = 1
         self._peer_complete: set = set()    # (worker_id, shuffle_id)
-        self._mu = threading.Lock()
+        self._mu = named_lock("shuffle.manager.WorkerContext._mu")
 
     def set_peers(self, peers: Dict[int, Tuple[str, int]]) -> None:
         """worker_id -> (host, port) for every OTHER worker."""
-        self.peers = {int(w): (h, int(p)) for w, (h, p) in peers.items()
+        self.peers = {int(w): (h, int(p)) for w, (h, p) in peers.items()  # lint: unguarded-ok cluster wiring: set once at startup before any query thread runs
                       if int(w) != self.worker_id}
 
     def next_shuffle_id(self) -> int:
@@ -155,8 +161,9 @@ class WorkerContext:
 
     def shutdown(self) -> None:
         self.server.stop()
-        if WorkerContext.current is self:
-            WorkerContext.current = None
+        with WorkerContext._current_mu:
+            if WorkerContext.current is self:
+                WorkerContext.current = None
 
 
 def init_worker(worker_id: int, n_workers: int, port: int = 0,
@@ -165,7 +172,8 @@ def init_worker(worker_id: int, n_workers: int, port: int = 0,
     RapidsExecutorPlugin.init analog). Returns the context; call
     ``set_peers`` once every worker's port is known."""
     ctx = WorkerContext(worker_id, n_workers, port, codec)
-    WorkerContext.current = ctx
+    with WorkerContext._current_mu:
+        WorkerContext.current = ctx
     return ctx
 
 

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.lockdep import named_lock
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column
@@ -79,7 +80,7 @@ class ShuffleStore:
     bytes from host staging, never touching the device)."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = named_lock("shuffle.transport.ShuffleStore._mu")
         self._next_id = 1
         self._buffers: Dict[int, Tuple[BufferDesc, List[np.ndarray]]] = {}
         self._by_partition: Dict[Tuple[int, int], List[int]] = {}
@@ -266,11 +267,19 @@ class ShuffleServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._threads_mu = named_lock(
+            "shuffle.transport.ShuffleServer._threads_mu")
+        self._conn_seq = 0
         self._accept_thread: Optional[threading.Thread] = None
 
     def start(self) -> "ShuffleServer":
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
+        # named so lockdep order reports and teardown diagnostics can
+        # attribute acquisitions to the transport plane; still daemonic
+        # (a hung peer must never wedge interpreter exit), but stop()
+        # joins them bounded so orderly shutdown is observable
+        self._accept_thread = threading.Thread(  # lint: unguarded-ok set once here, before the accept thread exists
+            target=self._accept_loop, daemon=True,
+            name="tpu-shuffle-accept")
         self._accept_thread.start()
         return self
 
@@ -283,10 +292,19 @@ class ShuffleServer:
                 continue
             except OSError:
                 return
+            with self._threads_mu:
+                self._conn_seq += 1
+                seq = self._conn_seq
             t = threading.Thread(target=self.handle_connection,
-                                 args=(SocketConnection(sock),), daemon=True)
+                                 args=(SocketConnection(sock),),
+                                 daemon=True,
+                                 name=f"tpu-shuffle-conn-{seq}")
             t.start()
-            self._threads.append(t)
+            with self._threads_mu:
+                self._threads.append(t)
+                # prune finished handlers so a long-lived server's list
+                # does not grow with every connection ever served
+                self._threads = [x for x in self._threads if x.is_alive()]
 
     def handle_connection(self, conn: Connection) -> None:
         """One request/response session (the server handler loop,
@@ -353,12 +371,36 @@ class ShuffleServer:
                     "crc32": wire.chunk_crc(body)}, body))
         conn.send(encode_frame(XFER_DONE, {"buffer_ids": buffer_ids}))
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 2.0) -> None:
+        """Stop accepting and join the transport threads BOUNDED: the
+        accept loop exits on its next poll tick, handler threads get
+        ``join_timeout_s`` each to drain their in-flight frame. A thread
+        still alive after its timeout is left daemonic (it dies with the
+        process) — shutdown must never hang on a wedged peer."""
         self._stop.set()
         try:
             self._sock.close()
         except OSError:
             pass
+        acc = self._accept_thread
+        if acc is not None and acc.is_alive():
+            acc.join(timeout=join_timeout_s)
+        with self._threads_mu:
+            handlers = list(self._threads)
+        for t in handlers:
+            if t.is_alive():
+                t.join(timeout=join_timeout_s)
+        with self._threads_mu:
+            self._threads = [t for t in self._threads if t.is_alive()]
+
+    def alive_threads(self) -> List[str]:
+        """Names of transport threads still running (teardown reports)."""
+        with self._threads_mu:
+            names = [t.name for t in self._threads if t.is_alive()]
+        acc = self._accept_thread
+        if acc is not None and acc.is_alive():
+            names.insert(0, acc.name)
+        return names
 
 
 # ---------------------------------------------------------------------------

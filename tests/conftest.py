@@ -20,6 +20,17 @@ if "--xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+# lockdep runs in `record` mode throughout the test suite (the conf's
+# documented tests/bench default): every session bootstrap primes the
+# mode from its conf, and the env override reaches every TpuConf built
+# without an explicit setting. Tests that need `enforce` (or `off`) set
+# the key on their own session and restore after. Measured cost: ~0 on
+# compile-dominated files, ~0.5s on the most lock-heavy file — suite
+# wall time is unaffected at the tier-1 gate's resolution.
+os.environ.setdefault(
+    "SPARK_RAPIDS_TPU_CONF__SPARK__RAPIDS__TPU__SQL__ANALYSIS__LOCKDEP",
+    "record")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -124,6 +124,197 @@ def test_conf_docs_in_sync_now():
 
 
 # ---------------------------------------------------------------------------
+# Concurrency rules (analysis/concurrency.py, wired into lint.lint_source):
+# every rule trips on a broken fixture, pragmas (with reason) silence,
+# out-of-scope modules are exempt
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu.analysis import concurrency  # noqa: E402
+
+
+def test_rule_raw_lock():
+    src = "import threading\n\nl = threading.Lock()\n"
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"raw-lock"} and len(v) == 1
+    ok = ("import threading\n\nl = threading.Lock()  "
+          "# lint: raw-lock-ok leaf lock of the instrumentation itself\n")
+    assert lint.lint_source(ok, "exec/fixture.py") == []
+    # threading.local / Event are confinement + signalling, not flagged
+    benign = ("import threading\n\nt = threading.local()\n"
+              "e = threading.Event()\n")
+    assert lint.lint_source(benign, "exec/fixture.py") == []
+
+
+def test_rule_raw_lock_lockdep_itself_exempt():
+    src = "import threading\n\nl = threading.Lock()\n"
+    assert lint.lint_source(src, "analysis/lockdep.py") == []
+
+
+def test_rule_unguarded_state_lock_owning_class():
+    src = ("from spark_rapids_tpu.analysis.lockdep import named_lock\n\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = named_lock('x.C._mu')\n"
+           "        self.n = 0\n"                       # ctor exempt
+           "    def bump(self):\n"
+           "        self.n += 1\n"                      # UNGUARDED
+           "    def bump_guarded(self):\n"
+           "        with self._mu:\n"
+           "            self.n += 1\n"                  # guarded: ok
+           "    def _bump_locked(self):\n"
+           "        self.n += 1\n")                     # convention: ok
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert len(v) == 1 and v[0].rule == "unguarded-state"
+    assert "C.n" in v[0].message
+
+
+def test_rule_unguarded_state_lock_free_class_exempt():
+    src = ("class C:\n"
+           "    def bump(self):\n"
+           "        self.n = 1\n")       # no lock owned: thread-confined
+    assert lint.lint_source(src, "exec/fixture.py") == []
+
+
+def test_rule_unguarded_state_module_global():
+    src = ("from spark_rapids_tpu.analysis.lockdep import named_lock\n"
+           "_mu = named_lock('x._mu')\n"
+           "_cache = None\n\n"
+           "def prime(v):\n"
+           "    global _cache\n"
+           "    _cache = v\n")                          # UNGUARDED
+    v = lint.lint_source(src, "analysis/fixture.py")
+    assert len(v) == 1 and v[0].rule == "unguarded-state"
+    guarded = ("from spark_rapids_tpu.analysis.lockdep import named_lock\n"
+               "_mu = named_lock('x._mu')\n"
+               "_cache = None\n\n"
+               "def prime(v):\n"
+               "    global _cache\n"
+               "    with _mu:\n"
+               "        _cache = v\n")
+    assert lint.lint_source(guarded, "analysis/fixture.py") == []
+
+
+def test_rule_unguarded_state_threading_local_exempt():
+    src = ("import threading\n"
+           "from spark_rapids_tpu.analysis.lockdep import named_lock\n\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._mu = named_lock('x2.C._mu')\n"
+           "        self._tls = threading.local()\n"
+           "    def mark(self):\n"
+           "        self._tls.value = 1\n")   # through thread-local: ok
+    assert lint.lint_source(src, "exec/fixture.py") == []
+
+
+def test_rule_lock_blocking_io_and_readback():
+    src = ("import numpy as np\n"
+           "from spark_rapids_tpu.analysis.lockdep import named_rlock\n\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = named_rlock('x3.C._lock')\n"
+           "    def bad(self, path, arrs):\n"
+           "        with self._lock:\n"
+           "            np.savez(path, *arrs)\n"        # disk IO under lock
+           "            h = [np.asarray(a) for a in arrs]\n"  # readback
+           "            return h\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    rules = [x.rule for x in v]
+    assert rules.count("lock-blocking") == 2, v
+    assert any("np.savez" in x.message for x in v)
+    assert any("np.asarray" in x.message for x in v)
+
+
+def test_rule_lock_blocking_nested_lock_and_pragma():
+    src = ("from spark_rapids_tpu.analysis.lockdep import named_lock\n\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._a_lock = named_lock('x4.C.a')\n"
+           "        self._b_lock = named_lock('x4.C.b')\n"
+           "    def nested(self):\n"
+           "        with self._a_lock:\n"
+           "            with self._b_lock:\n"
+           "                pass\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"lock-blocking"}
+    assert "nested acquisition" in v[0].message
+    ok = src.replace(
+        "        with self._a_lock:\n",
+        "        with self._a_lock:\n"
+        "            # lint: lock-blocking-ok documented order a < b\n")
+    assert lint.lint_source(ok, "exec/fixture.py") == []
+
+
+def test_rule_lock_blocking_not_flagged_outside_lock():
+    src = ("import numpy as np\n\n"
+           "def f(path, arrs):\n"
+           "    np.savez(path, *arrs)\n")
+    v = lint.lint_source(src, "shuffle/fixture.py")
+    assert "lock-blocking" not in _rules(v)
+
+
+def test_rule_singleton_guard():
+    src = ("import threading\n\n"
+           "class S:\n"
+           "    _instance = None\n"
+           "    _lock = threading.Lock()  # lint: raw-lock-ok fixture\n"
+           "    @classmethod\n"
+           "    def get(cls):\n"
+           "        if cls._instance is None:\n"        # UNGUARDED read
+           "            with cls._lock:\n"
+           "                cls._instance = S()\n"      # guarded write: ok
+           "        return cls._instance\n")            # UNGUARDED read
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert [x.rule for x in v] == ["singleton-guard", "singleton-guard"]
+    ok = ("import threading\n\n"
+          "class S:\n"
+          "    _instance = None\n"
+          "    _lock = threading.Lock()  # lint: raw-lock-ok fixture\n"
+          "    @classmethod\n"
+          "    def get(cls):\n"
+          "        with cls._lock:\n"
+          "            if cls._instance is None:\n"
+          "                cls._instance = S()\n"
+          "            return cls._instance\n")
+    assert lint.lint_source(ok, "exec/fixture.py") == []
+
+
+def test_rule_concurrency_pragma_requires_reason():
+    src = ("import threading\n\nl = threading.Lock()  # lint: raw-lock-ok\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    # a reason-less pragma does NOT silence and is itself flagged
+    assert _rules(v) == {"raw-lock", "pragma-reason"}
+
+
+def test_concurrency_rules_scoped_to_thread_reachable_modules():
+    src = ("import threading\n\nl = threading.Lock()\n")
+    assert lint.lint_source(src, "columnar/fixture.py") == []
+    assert lint.lint_source(src, "plan/fixture.py") == []
+
+
+def test_rule_lock_name_dup():
+    mk = lambda rel, line: concurrency.LockSite(
+        path=rel, rel=rel, line=line, kind="named_lock",
+        attr="_mu", canonical="dup.name")
+    v = concurrency.check_registry([mk("exec/a.py", 3), mk("exec/b.py", 9)])
+    assert len(v) == 1 and v[0].rule == "lock-name-dup"
+    # same site re-parsed twice is NOT a dup
+    assert concurrency.check_registry(
+        [mk("exec/a.py", 3), mk("exec/a.py", 3)]) == []
+
+
+def test_lock_registry_covers_engine_locks():
+    sites = concurrency.lock_registry(PKG)
+    names = {s.canonical for s in sites}
+    for expected in ("exec.spill.BufferCatalog._mu",
+                     "exec.spill.SpillableBuffer._lock",
+                     "exec.device.TpuSemaphore._stats_mu",
+                     "shuffle.transport.ShuffleStore._mu",
+                     "api.session.TpuSession._lock",
+                     "config.ConfRegistry._lock"):
+        assert expected in names, f"{expected} missing from registry"
+
+
+# ---------------------------------------------------------------------------
 # api_validation enforced in tier-1 (registry drift must fail loudly)
 # ---------------------------------------------------------------------------
 
