@@ -1,0 +1,87 @@
+"""Device preflight for benchmark entry points.
+
+BENCH_r04/r05 recorded ``value: 0`` because a dead device tunnel hung
+``jax.devices()`` and the probe timeout turned the whole round into an
+error string — two rounds of perf signal lost to infra (ROADMAP open
+item 5). The rule now: every bench artifact carries an explicit
+``backend`` plus the probe result, and a failed probe DEGRADES to a real
+CPU-backed measurement (labeled ``cpu-degraded``) instead of emitting a
+zero.
+
+The probe runs ``jax.devices()`` in a CHILD process (a dead tunnel hangs
+the call indefinitely; the child takes the hang) with a SHORT timeout —
+the tunnel either answers in seconds or not at all, and a 180 s wait
+only delays the degraded fallback.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def probe_devices(timeout_s: float = DEFAULT_TIMEOUT_S) -> Dict:
+    """Probe the jax backend in a child process.
+
+    Returns ``{"ok", "latencyS", "platform", "deviceCount", "error"}``;
+    ``ok=False`` means the tunnel/backend is unusable and the caller
+    should fall back to an explicit cpu-degraded run."""
+    import subprocess
+    import sys
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform, len(d))"],
+            capture_output=True, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "latencyS": round(time.perf_counter() - t0, 2),
+                "platform": None, "deviceCount": 0,
+                "error": f"device probe timed out after {timeout_s}s "
+                         "(jax.devices() hung; tunnel unreachable)"}
+    latency = round(time.perf_counter() - t0, 2)
+    if out.returncode == 0 and out.stdout.strip():
+        # parse only the LAST line: sitecustomize banners / runtime init
+        # notices on stdout must not crash the module that exists to make
+        # the bench crash-proof
+        tokens = out.stdout.strip().splitlines()[-1].split()
+        if len(tokens) >= 2 and tokens[-1].isdigit():
+            return {"ok": True, "latencyS": latency,
+                    "platform": tokens[-2], "deviceCount": int(tokens[-1]),
+                    "error": None}
+        return {"ok": False, "latencyS": latency, "platform": None,
+                "deviceCount": 0,
+                "error": ("device probe printed unparseable output: "
+                          + out.stdout.strip()[-200:])}
+    tail = (out.stderr or "").strip().splitlines()[-3:]
+    return {"ok": False, "latencyS": latency, "platform": None,
+            "deviceCount": 0,
+            "error": (f"device probe failed (rc={out.returncode}): "
+                      + " | ".join(tail)[:400])}
+
+
+def force_cpu_backend() -> None:
+    """Pin THIS process to the CPU backend before any jax device use
+    (the degraded-mode switch: safe only while jax hasn't initialized a
+    backend yet, which is why the probe runs in a child)."""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def preflight(timeout_s: float = DEFAULT_TIMEOUT_S) -> Dict:
+    """Probe and, on failure, force the CPU backend. Returns
+    ``{"backend": <platform or "cpu-degraded">, "deviceProbe": {...}}`` —
+    the fields every BENCH/MULTICHIP artifact now records."""
+    probe = probe_devices(timeout_s)
+    if probe["ok"]:
+        return {"backend": probe["platform"], "deviceProbe": probe}
+    force_cpu_backend()
+    return {"backend": "cpu-degraded", "deviceProbe": probe}

@@ -22,21 +22,42 @@ from . import datagen, queries as Q
 def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                   iterations: int = 2, verify: bool = False,
                   output: Optional[str] = None, suite: str = "tpch",
-                  concurrent_tasks: Optional[int] = None) -> Dict:
+                  concurrent_tasks: Optional[int] = None,
+                  trace_dir: Optional[str] = None,
+                  probe_timeout_s: float = 30.0) -> Dict:
     import os
+    # device preflight BEFORE any engine/jax use: a dead tunnel degrades
+    # this run to an explicit cpu-degraded measurement instead of hanging
+    # or emitting a zero (the BENCH_r04/r05 dark rounds)
+    from .preflight import preflight
+    pf = preflight(probe_timeout_s)
     from spark_rapids_tpu.api.session import TpuSession
     if concurrent_tasks is None:
         # pin device admission to host parallelism: the engine default (2)
         # under a 4-thread task pool makes CPU-backend reports measure
         # semaphore admission thrash instead of engine time
         concurrent_tasks = os.cpu_count() or 4
+    if trace_dir is None and output:
+        trace_dir = f"{output}.traces"
     session = TpuSession.builder.config(
         "spark.rapids.tpu.sql.explain", "NONE").config(
         "spark.rapids.tpu.sql.concurrentTpuTasks",
         concurrent_tasks).config(
+        # per-query Chrome-trace timelines (exec/tracing.SpanRecorder):
+        # recorded when a trace dir exists to dump them into
+        "spark.rapids.tpu.sql.tracing.timeline",
+        "true" if trace_dir else "false").config(
         # lock-order graph + per-lock wait/hold attribution on for bench
         # runs (the documented tests/bench default for analysis.lockdep)
         "spark.rapids.tpu.sql.analysis.lockdep", "record").getOrCreate()
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+    # the listener API (session.register_query_listener) delivers the
+    # executed plan + metrics tree per query; the LAST capture per name
+    # lands in the report as that query's per-operator metrics tree
+    # (registered around the query loop below, unregistered in a finally
+    # — getOrCreate can hand this session to later callers)
+    captures: List = []
 
     if suite == "tpcds":
         from . import tpcds_queries
@@ -55,64 +76,96 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
 
     report: Dict = {"suite": suite, "sf": sf, "datagen_s": round(gen_s, 3),
                     "concurrentTpuTasks": concurrent_tasks,
+                    "backend": pf["backend"],
+                    "deviceProbe": pf["deviceProbe"],
                     "queries": {}}
     names = query_names or list(queries)
-    for name in names:
-        from spark_rapids_tpu.exec.device import TpuSemaphore
-        from spark_rapids_tpu.analysis import lockdep, recompile
-        qfn = queries[name]
-        timings = []
-        rows = 0
-        sem0 = TpuSemaphore.get().stats()
-        rc0 = recompile.snapshot()
-        lk0 = lockdep.stats()
-        for it in range(iterations):
-            t0 = time.perf_counter()
-            df = qfn(tables)
-            batch = df.collect_batch().fetch_to_host()
-            rows = batch.num_rows
-            timings.append(round(time.perf_counter() - t0, 4))
-        sem1 = TpuSemaphore.get().stats()
-        entry = {
-            "rows": rows,
-            "cold_s": timings[0],
-            "hot_s": min(timings[1:]) if len(timings) > 1 else timings[0],
-            "timings_s": timings,
-            # admission contention vs device occupancy, separable
-            # (wait = blocked acquiring a permit; hold = acquire->release)
-            "semaphore": {
-                "waitS": round(sem1["waitS"] - sem0["waitS"], 4),
-                "holdS": round(sem1["holdS"] - sem0["holdS"], 4),
-                "acquires": sem1["acquires"] - sem0["acquires"],
-            },
-            # distinct-compile counts across this query's iterations
-            # (analysis/recompile.py): a kernel compiling per iteration
-            # means its shapes never hit the fused cache
-            "recompiles": recompile.delta(rc0),
-        }
-        flags = recompile.flagged(entry["recompiles"])
-        if flags:
-            entry["recompileFlags"] = flags
-        # per-lock wait/hold deltas attributed to trace spans, next to
-        # the semaphore wait/hold split (analysis/lockdep.py): which
-        # lock a query's threads actually contended, and in which
-        # named execute region
-        locks = _lock_delta(lk0, lockdep.stats())
-        if locks:
-            entry["locks"] = locks
-        try:
-            m = session.last_query_metrics()
-            entry["planTimeS"] = m.get("planTimeS")
-            entry["executeTimeS"] = m.get("executeTimeS")
-            # sync includes the per-span breakdown (syncSpans): which named
-            # execute region paid the device->host round trips
-            entry["sync"] = m.get("sync")
-            entry["spans"] = m.get("spans")
-        except Exception:
-            pass
-        if verify:
-            entry["verified"] = _verify(session, qfn(tables))
-        report["queries"][name] = entry
+    try:
+        for name in names:
+            session.register_query_listener(captures.append)
+            from spark_rapids_tpu.exec.device import TpuSemaphore
+            from spark_rapids_tpu.analysis import lockdep, recompile
+            qfn = queries[name]
+            timings = []
+            rows = 0
+            sem0 = TpuSemaphore.get().stats()
+            rc0 = recompile.snapshot()
+            lk0 = lockdep.stats()
+            for it in range(iterations):
+                if it == 1:
+                    # capture (listener snapshots + QueryExecution build)
+                    # rides the COLD iteration only: hot_s = min of the
+                    # later iterations must not time observability work
+                    session.unregister_query_listener(captures.append)
+                t0 = time.perf_counter()
+                df = qfn(tables)
+                batch = df.collect_batch().fetch_to_host()
+                rows = batch.num_rows
+                timings.append(round(time.perf_counter() - t0, 4))
+            sem1 = TpuSemaphore.get().stats()
+            entry = {
+                "rows": rows,
+                "cold_s": timings[0],
+                "hot_s": min(timings[1:]) if len(timings) > 1 else timings[0],
+                "timings_s": timings,
+                # admission contention vs device occupancy, separable
+                # (wait = blocked acquiring a permit; hold = acquire->release)
+                "semaphore": {
+                    "waitS": round(sem1["waitS"] - sem0["waitS"], 4),
+                    "holdS": round(sem1["holdS"] - sem0["holdS"], 4),
+                    "acquires": sem1["acquires"] - sem0["acquires"],
+                },
+                # distinct-compile counts across this query's iterations
+                # (analysis/recompile.py): a kernel compiling per iteration
+                # means its shapes never hit the fused cache
+                "recompiles": recompile.delta(rc0),
+            }
+            flags = recompile.flagged(entry["recompiles"])
+            if flags:
+                entry["recompileFlags"] = flags
+            # per-lock wait/hold deltas attributed to trace spans, next to
+            # the semaphore wait/hold split (analysis/lockdep.py): which
+            # lock a query's threads actually contended, and in which
+            # named execute region
+            locks = _lock_delta(lk0, lockdep.stats())
+            if locks:
+                entry["locks"] = locks
+            try:
+                m = session.last_query_metrics()
+                entry["planTimeS"] = m.get("planTimeS")
+                entry["executeTimeS"] = m.get("executeTimeS")
+                # sync includes the per-span breakdown (syncSpans): which named
+                # execute region paid the device->host round trips
+                entry["sync"] = m.get("sync")
+                entry["spans"] = m.get("spans")
+                # per-operator metrics tree of the captured (cold)
+                # iteration (EXPLAIN ANALYZE's data, via the query
+                # listener): which node paid the rows/time/syncs/recompiles
+                if captures:
+                    entry["metricsTree"] = [
+                        {"depth": d, "operator": op,
+                         "metrics": {k: (round(v, 4) if isinstance(v, float)
+                                         else v)
+                                     for k, v in mm.items()}}
+                        for d, op, mm in captures[-1].metrics_tree]
+            except Exception:
+                pass
+            if trace_dir:
+                # Chrome-trace timeline of the last iteration (open in
+                # chrome://tracing / ui.perfetto.dev)
+                try:
+                    rec = getattr(session, "_last_span_recorder", None)
+                    if rec is not None:
+                        path = os.path.join(trace_dir, f"{name}.trace.json")
+                        entry["traceFile"] = rec.dump_chrome_trace(path)
+                except Exception:
+                    pass
+            captures.clear()
+            if verify:
+                entry["verified"] = _verify(session, qfn(tables))
+            report["queries"][name] = entry
+    finally:
+        session.unregister_query_listener(captures.append)
     # run-level lockdep findings: order-inversion cycles (with both
     # acquisition stacks) and lock-held-across-transfer events
     from spark_rapids_tpu.analysis import lockdep
@@ -131,32 +184,10 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
 
 
 def _lock_delta(before: Dict, after: Dict) -> Dict:
-    """Per-lock growth of wait/hold/acquires (and per-span attribution)
-    between two lockdep.stats() snapshots, dropping untouched locks."""
-    out: Dict = {}
-    for name, now in after.items():
-        was = before.get(name, {"waitS": 0.0, "holdS": 0.0, "acquires": 0,
-                                "spans": {}})
-        d = {"waitS": round(now["waitS"] - was["waitS"], 4),
-             "holdS": round(now["holdS"] - was["holdS"], 4),
-             "acquires": now["acquires"] - was["acquires"]}
-        # acquires counts at acquire but holdS accrues at release, so a
-        # lock taken before the window and released inside it shows
-        # acquires == 0 with nonzero holdS — exactly the long-hold stall
-        # the metric exists to expose
-        if not (d["acquires"] or d["waitS"] or d["holdS"]):
-            continue
-        spans = {}
-        for s, v in now["spans"].items():
-            w = was["spans"].get(s, {"waitS": 0.0, "holdS": 0.0})
-            ds = {"waitS": round(v["waitS"] - w["waitS"], 4),
-                  "holdS": round(v["holdS"] - w["holdS"], 4)}
-            if ds["waitS"] or ds["holdS"]:
-                spans[s] = ds
-        if spans:
-            d["spans"] = spans
-        out[name] = d
-    return out
+    """Per-lock wait/hold/acquires growth (moved to
+    ``analysis/lockdep.stats_delta`` so query listeners share it)."""
+    from spark_rapids_tpu.analysis import lockdep
+    return lockdep.stats_delta(before, after)
 
 
 def _verify(session, df, epsilon: float = 1e-4) -> bool:
@@ -197,12 +228,21 @@ def main():
     ap.add_argument("--output", type=str, default=None)
     ap.add_argument("--concurrent-tasks", type=int, default=None,
                     help="concurrentTpuTasks (default: host cpu count)")
+    ap.add_argument("--trace-dir", type=str, default=None,
+                    help="directory for per-query Chrome-trace timelines "
+                         "(default: <output>.traces when --output is set)")
+    ap.add_argument("--probe-timeout", type=float, default=30.0,
+                    help="device preflight probe timeout in seconds; on "
+                         "failure the run degrades to an explicit "
+                         "cpu-degraded backend instead of a zero")
     args = ap.parse_args()
     report = run_benchmark(args.sf,
                            args.queries.split(",") if args.queries else None,
                            args.iterations, args.verify, args.output,
                            suite=args.suite,
-                           concurrent_tasks=args.concurrent_tasks)
+                           concurrent_tasks=args.concurrent_tasks,
+                           trace_dir=args.trace_dir,
+                           probe_timeout_s=args.probe_timeout)
     print(json.dumps(report, indent=2))
 
 

@@ -121,9 +121,12 @@ def validate_plan(root, meta=None) -> List[Violation]:
     out: List[Violation] = []
     promised = _meta_reasons(meta) if meta is not None else None
 
-    def walk(node, path: str) -> None:
+    def walk(node, path: str, idx: Optional[int] = None) -> None:
         name = type(node).__name__
-        here = f"{path}/{name}" if path else name
+        # child ordinal in the path: same-class siblings (a join's two
+        # exchanges) must key DIFFERENT paths or EXPLAIN ANALYZE would
+        # attach one child's violation under both
+        here = f"{path}/{idx}.{name}" if path else name
         contract = getattr(type(node), "CONTRACT", None)
         if contract is None:
             out.append(Violation(name, here,
@@ -136,8 +139,8 @@ def validate_plan(root, meta=None) -> List[Violation]:
                     name, here, f"contract check failed to run: {e!r}"))
         if promised is not None:
             _check_promise(node, promised, here, out)
-        for c in getattr(node, "children", ()):
-            walk(c, here)
+        for i, c in enumerate(getattr(node, "children", ())):
+            walk(c, here, i)
 
     walk(root, "")
     return out
@@ -400,16 +403,19 @@ def format_violations(violations: List[Violation]) -> str:
 _warned_once = False
 
 
-def enforce(root, meta, mode: str) -> Optional[str]:
-    """Run validation per ``mode``: returns the diagnostic text to append
-    to the explain output (None when clean or off); raises
+def enforce(root, meta, mode: str
+            ) -> Tuple[Optional[str], List[Violation]]:
+    """Run validation per ``mode``: returns ``(diagnostic text to append
+    to the explain output or None when clean/off, the violations
+    themselves)`` — the structured list is what EXPLAIN ANALYZE attaches
+    per node (matched on the root->node path); raises
     :class:`PlanContractError` in ``error`` mode."""
     mode = (mode or "warn").lower()
     if mode == "off":
-        return None
+        return None, []
     violations = validate_plan(root, meta)
     if not violations:
-        return None
+        return None, []
     diag = format_violations(violations)
     if mode == "error":
         raise PlanContractError(diag)
@@ -422,4 +428,4 @@ def enforce(root, meta, mode: str) -> Optional[str]:
             "off to silence):\n%s", diag)
     else:
         logger.debug("plan-contract violations:\n%s", diag)
-    return diag
+    return diag, violations

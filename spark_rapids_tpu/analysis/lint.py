@@ -29,6 +29,16 @@ appears in ``docs/configs.md`` and vice versa (regenerate with
 modules) declares a ``CONTRACT`` in its class body — the declaration
 ``analysis/contracts.py`` validates per plan.
 
+``exec-metrics``: every exec class that declares a ``CONTRACT`` also
+declares ``METRICS = exec_metrics(...)`` — its metric-key surface
+(``exec/metrics.py``; the GpuExec.additionalMetrics analog).
+
+``metric-key``: every literal metric key the class body emits — the
+``metric_key`` argument of a ``trace_span(...)`` call or the first
+argument of a ``<x>.metrics.inc("...")`` call — is declared by the
+enclosing class's ``METRICS`` (base keys exempt). Keeps the metrics
+surface greppable and drift-free, like the contract rule.
+
 The linter is pure AST + text: no engine import, no jax import.
 """
 
@@ -50,7 +60,7 @@ HOT_PATH_FILES = ("plan/physical.py",)
 # batched readback funnels every other site must go through
 HOST_SYNC_ALLOWLIST = {
     ("exec/pipeline.py", "PipelineWindow._resolve"),
-    ("plan/physical.py", "Metrics.resolve"),
+    ("exec/metrics.py", "TpuMetrics.resolve"),
 }
 
 # modules whose *Exec classes must declare a CONTRACT
@@ -60,6 +70,12 @@ EXEC_MODULES = (
     "parallel/mesh_exec.py",
 )
 EXEC_BASE_CLASSES = {"TpuExec"}       # abstract root: no contract of its own
+
+# mirror of exec/metrics.BASE_METRICS (the linter is pure AST and cannot
+# import the engine): keys every exec may emit without declaring —
+# GpuMetricNames basics plus the attributed cross-cutting keys
+BASE_METRIC_KEYS = {"numOutputRows", "numOutputBatches", "opTime",
+                    "hostSyncs", "recompiles", "spillBytes"}
 
 PRAGMA_RE = re.compile(r"#\s*lint:\s*host-sync-ok(.*)$")
 
@@ -204,12 +220,87 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
                         path, node.lineno, "exec-contract",
                         f"exec class {node.name} declares no CONTRACT "
                         "(analysis/contracts.exec_contract)"))
+                else:
+                    out.extend(_check_exec_metrics(node, path))
 
     # concurrency rules (raw-lock / unguarded-state / lock-blocking /
     # singleton-guard) over the thread-reachable modules — lazy import:
     # concurrency.py imports LintViolation from here
     from . import concurrency
     out.extend(concurrency.lint_source(source, rel, path=path))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# exec METRICS declarations (exec-metrics / metric-key rules)
+# ---------------------------------------------------------------------------
+
+def _declared_metric_keys(cls: ast.ClassDef):
+    """The string keys of this class's ``METRICS = exec_metrics(...)``
+    assignment, or None when no METRICS is declared."""
+    for st in cls.body:
+        if isinstance(st, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "METRICS"
+                for t in st.targets):
+            keys = {n.value for n in ast.walk(st.value)
+                    if isinstance(n, ast.Constant) and
+                    isinstance(n.value, str)}
+            return keys
+    return None
+
+
+def _used_metric_keys(cls: ast.ClassDef):
+    """(line, key, kind) for every literal metric key the class body
+    emits: trace_span's metric_key argument and
+    ``<x>.metrics.inc("...")`` calls."""
+    out = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if fname == "trace_span":
+            key = None
+            if len(node.args) >= 3 and isinstance(node.args[2],
+                                                  ast.Constant):
+                key = node.args[2].value
+            for kw in node.keywords:
+                if kw.arg == "metric_key" and \
+                        isinstance(kw.value, ast.Constant):
+                    key = kw.value.value
+            if isinstance(key, str):
+                out.append((node.lineno, key, "trace_span metric_key"))
+        elif fname == "inc" and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Attribute) and \
+                f.value.attr == "metrics" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.lineno, node.args[0].value, "metrics.inc"))
+    return out
+
+
+def _check_exec_metrics(cls: ast.ClassDef, path: str
+                        ) -> List[LintViolation]:
+    """exec-metrics: a CONTRACT-declaring exec class must declare METRICS;
+    metric-key: every literal key it emits must be declared (base keys
+    exempt)."""
+    out: List[LintViolation] = []
+    declared = _declared_metric_keys(cls)
+    if declared is None:
+        out.append(LintViolation(
+            path, cls.lineno, "exec-metrics",
+            f"exec class {cls.name} declares a CONTRACT but no METRICS "
+            "(exec/metrics.exec_metrics: its metric-key surface)"))
+        declared = set()
+    allowed = declared | BASE_METRIC_KEYS
+    for line, key, kind in _used_metric_keys(cls):
+        if key not in allowed:
+            out.append(LintViolation(
+                path, line, "metric-key",
+                f"{cls.name} emits metric key {key!r} ({kind}) not "
+                "declared in its METRICS = exec_metrics(...) — declare "
+                "it so the metrics surface stays greppable"))
     return out
 
 

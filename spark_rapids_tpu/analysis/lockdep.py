@@ -416,6 +416,38 @@ def stats() -> Dict[str, Dict]:
         return out
 
 
+def stats_delta(before: Dict, after: Optional[Dict] = None) -> Dict:
+    """Per-lock growth of wait/hold/acquires (and per-span attribution)
+    between two :func:`stats` snapshots, dropping untouched locks — the
+    per-query lock report (bench runner, query listeners)."""
+    if after is None:
+        after = stats()
+    out: Dict = {}
+    for name, now in after.items():
+        was = before.get(name, {"waitS": 0.0, "holdS": 0.0, "acquires": 0,
+                                "spans": {}})
+        d = {"waitS": round(now["waitS"] - was["waitS"], 4),
+             "holdS": round(now["holdS"] - was["holdS"], 4),
+             "acquires": now["acquires"] - was["acquires"]}
+        # acquires counts at acquire but holdS accrues at release, so a
+        # lock taken before the window and released inside it shows
+        # acquires == 0 with nonzero holdS — exactly the long-hold stall
+        # the metric exists to expose
+        if not (d["acquires"] or d["waitS"] or d["holdS"]):
+            continue
+        spans = {}
+        for s, v in now["spans"].items():
+            w = was["spans"].get(s, {"waitS": 0.0, "holdS": 0.0})
+            ds = {"waitS": round(v["waitS"] - w["waitS"], 4),
+                  "holdS": round(v["holdS"] - w["holdS"], 4)}
+            if ds["waitS"] or ds["holdS"]:
+                spans[s] = ds
+        if spans:
+            d["spans"] = spans
+        out[name] = d
+    return out
+
+
 def report() -> Dict:
     """Full lockdep report: mode, per-lock stats, the order graph, every
     inversion (with both stacks), and held-across-transfer findings."""

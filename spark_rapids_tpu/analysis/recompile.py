@@ -85,6 +85,10 @@ def note_compile(kernel: str, key: Any) -> None:
         ent["keys"].add(key)
         ent["compiles"] += 1
         ent["calls"] += 1
+    # charge the innermost open exec's metrics bag so EXPLAIN ANALYZE
+    # shows which plan node paid the compile (exec/metrics attribution)
+    from ..exec.metrics import attribute
+    attribute("recompiles")
 
 
 def note_call(kernel: str) -> None:

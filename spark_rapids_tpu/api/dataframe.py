@@ -322,12 +322,32 @@ class DataFrame:
         import time
         from ..exec.tracing import SpanRecorder, SyncCounter
         exec_plan = self._execute()
+        listeners = bool(self.session._query_listeners)
+        if listeners:
+            # snapshots only when someone is listening: the deltas cost a
+            # dict copy per query
+            from ..analysis import lockdep, recompile
+            rc0 = recompile.snapshot()
+            lk0 = lockdep.stats()
         t0 = time.perf_counter()
         with SyncCounter() as sc, SpanRecorder() as spans:
             out = exec_plan.execute_collect()
         self.session._last_execute_time_s = time.perf_counter() - t0
         self.session._last_sync_report = sc.report()
         self.session._last_span_report = spans.report()
+        # the recorder itself stays reachable so the bench runner / tests
+        # can export the Chrome-trace timeline of this query
+        self.session._last_span_recorder = spans
+        if listeners:
+            from .session import QueryExecution
+            ov = self.session._last_overrides
+            self.session._notify_query_listeners(QueryExecution(
+                self.session, exec_plan,
+                self.session._last_sync_report,
+                self.session._last_span_report,
+                recompile.delta(rc0), lockdep.stats_delta(lk0),
+                violations=getattr(ov, "last_violations", ()) if ov
+                else ()))
         return out
 
     def collect(self) -> List[tuple]:
@@ -349,6 +369,15 @@ class DataFrame:
         print(self.limit(n).toPandas().to_string(index=False))
 
     def explain(self, extended: bool = False) -> None:
+        """Print the physical plan. ``extended=True`` adds the overrides
+        explain (fallback reasons + contract diagnostics);
+        ``extended="analyze"`` EXECUTES the query (Spark's EXPLAIN
+        ANALYZE) and prints the executed tree with each node's runtime
+        metrics inline plus the query-level summary."""
+        if isinstance(extended, str) and extended.lower() == "analyze":
+            self.collect_batch()
+            print(self.session.explain_analyze())
+            return
         plan = self._analyzed()
         from ..plan.overrides import Overrides
         conf = self.session.conf.with_overrides(

@@ -60,6 +60,53 @@ class _BuilderAccessor:
         return TpuSessionBuilder()
 
 
+class QueryExecution:
+    """Everything a query-execution listener receives for ONE executed
+    query (the ExecutionPlanCaptureCallback analog, Plugin.scala:211-300,
+    widened with the observability reports): the executed physical plan,
+    the per-operator metrics tree, and the sync/span/recompile/lock
+    reports the bench runner prints. Self-contained: renders from ITS
+    OWN captured plan and violations, so a capture for query N stays
+    correct after later queries run."""
+
+    def __init__(self, session: "TpuSession", plan, sync: dict,
+                 spans: dict, recompiles: dict, locks: dict,
+                 violations=()):
+        self.session = session
+        self.plan = plan                   # executed TpuExec tree
+        self.sync = sync                   # SyncCounter.report()
+        self.spans = spans                 # SpanRecorder.report()
+        self.recompiles = recompiles       # recompile.delta over the query
+        self.locks = locks                 # lockdep stats delta
+        self.violations = list(violations)  # contract diags at capture
+        self._metrics_tree = None
+
+    @property
+    def metrics_tree(self):
+        """[(depth, operator, metrics)] — materialized LAZILY: resolving
+        the bags costs device readbacks, which must not land inside a
+        benchmark's timed collect window."""
+        if self._metrics_tree is None:
+            self._metrics_tree = self.plan.metrics_tree()
+        return self._metrics_tree
+
+    def explain_analyze(self) -> str:
+        """THIS query's executed plan annotated with runtime metrics and
+        its captured contract diagnostics (rendered on demand)."""
+        by_path = {}
+        for v in self.violations:
+            by_path.setdefault(v.path, []).append(v.message)
+        lines = ["== Executed Plan (analyzed) =="]
+        lines += self.plan.metrics_lines(
+            annotate=lambda path: [f"! contract: {m}"
+                                   for m in by_path.get(path, ())])
+        lines.append(
+            f"query: hostSyncs={self.sync.get('hostSyncs', 0)} "
+            f"spanWallS={self.spans.get('wallS', 0.0)} "
+            f"concurrency={self.spans.get('concurrency', 0.0)}")
+        return "\n".join(lines)
+
+
 class TpuSession:
     builder = _BuilderAccessor()
 
@@ -71,6 +118,7 @@ class TpuSession:
         self._views: Dict[str, lp.LogicalPlan] = {}
         self._last_exec_plan = None
         self._last_overrides = None
+        self._query_listeners: List = []
         self._bootstrap()
         with TpuSession._lock:
             TpuSession._active = self
@@ -88,8 +136,14 @@ class TpuSession:
         # a new session (possibly with different analysis.* keys) must
         # re-prime them
         from ..analysis import lockdep, recompile, sync_audit
+        from ..exec import metrics as exec_metrics_mod, tracing
         sync_audit.reset_cache()
         recompile.reset_cache()
+        # metrics gate primes EAGERLY from THIS conf (like lockdep): a
+        # lazy read at first inc could run under the spill catalog's
+        # admission lock and recurse into the session lock
+        exec_metrics_mod.refresh(self.conf)
+        tracing.reset_cache()               # tracing.enabled / .timeline
         # lockdep primes EAGERLY from THIS session's conf (a lazy read at
         # first acquire would recurse through the conf-registry lock)
         lockdep.refresh_mode(self.conf)
@@ -235,6 +289,64 @@ class TpuSession:
         tail = ("memory: " +
                 ", ".join(f"{k}={v}" for k, v in sorted(mem.items())))
         return self._last_exec_plan.metrics_string() + "\n" + tail
+
+    def explain_analyze(self) -> str:
+        """EXPLAIN ANALYZE of the last executed query: the executed plan
+        tree with each node's runtime metrics inline (rows, batches,
+        opTime, attributed hostSyncs/recompiles/spillBytes, ...), the
+        plan-contract validator's diagnostics attached to the offending
+        node, and the query-level wall/sync/span summary — the Spark-UI
+        SQL-tab view, in text. ``df.explain(\"analyze\")`` executes the
+        frame and prints this."""
+        if self._last_exec_plan is None:
+            raise RuntimeError("no plan executed yet")
+        # contract violations keyed by root->node path (the same path
+        # contracts.validate_plan builds and metrics_tree(with_path=True)
+        # reproduces)
+        by_path: Dict[str, List[str]] = {}
+        ov = self._last_overrides
+        for v in getattr(ov, "last_violations", []) if ov else []:
+            by_path.setdefault(v.path, []).append(v.message)
+
+        lines: List[str] = ["== Executed Plan (analyzed) =="]
+        lines += self._last_exec_plan.metrics_lines(
+            annotate=lambda path: [f"! contract: {m}"
+                                   for m in by_path.get(path, ())])
+        rep = self.last_query_metrics()
+        sync = rep.get("sync", {})
+        spans = rep.get("spans", {})
+        lines.append(
+            f"query: planTimeS={rep.get('planTimeS')} "
+            f"executeTimeS={rep.get('executeTimeS')} "
+            f"hostSyncs={sync.get('hostSyncs', 0)} "
+            f"spanWallS={spans.get('wallS', 0.0)} "
+            f"concurrency={spans.get('concurrency', 0.0)}")
+        return "\n".join(lines)
+
+    # -- query-execution listeners (ExecutionPlanCaptureCallback analog,
+    # Plugin.scala:211-300): tests and the bench runner register callbacks
+    # receiving a QueryExecution per executed query -----------------------
+    def register_query_listener(self, callback) -> None:
+        """``callback(QueryExecution)`` fires after every collect-style
+        action on this session. Exceptions in listeners are logged and
+        swallowed — observability must never fail the query."""
+        if callback not in self._query_listeners:
+            self._query_listeners.append(callback)
+
+    def unregister_query_listener(self, callback) -> None:
+        try:
+            self._query_listeners.remove(callback)
+        except ValueError:
+            pass
+
+    def _notify_query_listeners(self, qe: "QueryExecution") -> None:
+        import logging
+        for cb in list(self._query_listeners):
+            try:
+                cb(qe)
+            except Exception:
+                logging.getLogger("spark_rapids_tpu.listener").exception(
+                    "query listener %r failed", cb)
 
     def assert_on_tpu(self, allowed_fallbacks: Sequence[str] = ()) -> None:
         """assertIsOnTheGpu test mode (GpuTransitionOverrides.scala:311-367)."""

@@ -338,6 +338,13 @@ TRACING_ENABLED = _conf("spark.rapids.tpu.sql.tracing.enabled").doc(
     "Wrap hot regions in jax profiler TraceAnnotations (ref: NVTX ranges, "
     "NvtxWithMetrics.scala:27)").boolean_conf.create_with_default(False)
 
+TRACING_TIMELINE = _conf("spark.rapids.tpu.sql.tracing.timeline").doc(
+    "Record every trace span's begin/end with its thread and export a "
+    "Chrome-trace/Perfetto timeline per query "
+    "(SpanRecorder.chrome_trace; the bench runner dumps trace.json per "
+    "query — open in chrome://tracing or ui.perfetto.dev, see "
+    "docs/observability.md)").boolean_conf.create_with_default(False)
+
 READER_TYPE = _conf("spark.rapids.tpu.sql.format.parquet.reader.type").doc(
     "Parquet reader strategy: PERFILE, COALESCING, MULTITHREADED "
     "(ref: spark.rapids.sql.format.parquet.reader.type, RapidsConf.scala:510)"

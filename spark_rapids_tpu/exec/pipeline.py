@@ -46,8 +46,12 @@ class PipelineWindow:
     batched host readbacks. Single-consumer: one window per partition
     drain (partition tasks each build their own)."""
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, metrics=None):
         self.depth = max(1, int(depth))
+        # owning exec's metrics bag: batched resolves run OUTSIDE the
+        # operator's metered span (the push happens after it closes), so
+        # the window re-opens the exec scope itself for sync attribution
+        self.metrics = metrics
         self._pending: deque = deque()
         # observability: how many batched resolves ran, how many scalars
         # they carried, and how many landings degraded to per-entry reads
@@ -121,7 +125,8 @@ class PipelineWindow:
         import jax
         import jax.numpy as jnp
         import numpy as np
-        with trace_span("pipeline_resolve"):
+        from .metrics import exec_scope
+        with trace_span("pipeline_resolve"), exec_scope(self.metrics):
             try:
                 groups: dict = {}
                 for i, s in device:

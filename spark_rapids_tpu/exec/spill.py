@@ -120,7 +120,12 @@ class SpillableBuffer:
             self._host_arrays = host
             self._device_arrays = None
             self.tier = StorageTier.HOST
-            return self.size_bytes
+        # charge the innermost open exec (exec/metrics attribution): the
+        # operator whose pressure pushed this buffer off the device shows
+        # spillBytes on its EXPLAIN ANALYZE node
+        from .metrics import attribute
+        attribute("spillBytes", self.size_bytes)
+        return self.size_bytes
 
     def spill_to_disk(self, spill_dir: str) -> int:
         self.spill_to_host()           # no-op unless device-resident

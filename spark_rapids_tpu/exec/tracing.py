@@ -9,14 +9,30 @@ TPU analog: ``jax.profiler.TraceAnnotation`` spans show up in xprof/
 TensorBoard traces; ``start_profiler_server`` exposes the live profiler.
 Disabled (no-op, zero overhead beyond one attr check) unless
 ``spark.rapids.tpu.sql.tracing.enabled`` is on.
+
+Beyond the per-name self-time totals, ``SpanRecorder`` optionally records
+every span's begin/end with its thread (conf
+``spark.rapids.tpu.sql.tracing.timeline``) and exports a Chrome-trace /
+Perfetto ``trace.json`` (:meth:`SpanRecorder.chrome_trace`), turning the
+flat self-time map into an actual timeline — open it in chrome://tracing
+or ui.perfetto.dev (see docs/observability.md).
 """
 
 from __future__ import annotations
 
 from contextlib import contextmanager
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
+
+from ..analysis.lockdep import named_lock
+from .metrics import exec_scope
 
 _enabled: Optional[bool] = None
+_timeline: Optional[bool] = None
+
+
+def _effective_conf():
+    from ..analysis.sync_audit import _effective_conf as eff
+    return eff()
 
 
 def _tracing_on() -> bool:
@@ -27,9 +43,21 @@ def _tracing_on() -> bool:
     return _enabled
 
 
+def _timeline_on() -> bool:
+    global _timeline
+    if _timeline is None:
+        try:
+            from .. import config as cfg
+            _timeline = bool(_effective_conf().get(cfg.TRACING_TIMELINE))
+        except Exception:
+            _timeline = False
+    return _timeline
+
+
 def reset_cache() -> None:
-    global _enabled
+    global _enabled, _timeline
     _enabled = None
+    _timeline = None
 
 
 @contextmanager
@@ -37,12 +65,19 @@ def trace_span(name: str, metrics=None, metric_key: Optional[str] = None):
     """Named profiler span (NvtxWithMetrics: optionally also feeds a
     metrics timer). Always feeds the active :class:`SpanRecorder` (the
     per-query wall-clock breakdown); the jax profiler annotation is
-    config-gated."""
+    config-gated. When ``metrics`` is an exec's bag, the span also marks
+    that exec as the innermost open one on this thread
+    (``exec/metrics.exec_scope``) so attributed events — host syncs,
+    recompiles, spill bytes — land on its operator node."""
     rec = SpanRecorder.active
     if rec is None and not _tracing_on():
-        if metrics is not None and metric_key:
-            with metrics.timer(metric_key):
-                yield
+        if metrics is not None:
+            with exec_scope(metrics):
+                if metric_key:
+                    with metrics.timer(metric_key):
+                        yield
+                else:
+                    yield
         else:
             yield
         return
@@ -50,16 +85,17 @@ def trace_span(name: str, metrics=None, metric_key: Optional[str] = None):
     t0 = time.perf_counter()
     frame = rec._push(name) if rec is not None else None
     try:
-        if _tracing_on():
-            import jax
-            with jax.profiler.TraceAnnotation(name):
+        with exec_scope(metrics):
+            if _tracing_on():
+                import jax
+                with jax.profiler.TraceAnnotation(name):
+                    yield
+            else:
                 yield
-        else:
-            yield
     finally:
         elapsed = time.perf_counter() - t0
         if rec is not None:
-            rec._pop(frame, name, elapsed)
+            rec._pop(frame, name, elapsed, begin=t0)
         if metrics is not None and metric_key:
             metrics.inc(metric_key, elapsed)
 
@@ -71,26 +107,40 @@ class SpanRecorder:
     execute wall went without double counting nesting (the NVTX-range
     timeline of the reference, reduced to per-name totals). Partitions
     drain on a thread pool, so stacks are thread-local and concurrent
-    spans can legitimately sum past the wall clock."""
+    spans can legitimately sum past the wall clock — ``report()`` carries
+    the wall clock and the ``concurrency`` ratio (sum of self-time over
+    wall) so such reports read as parallelism, not as confusion.
+
+    With ``timeline=True`` (or conf ``...sql.tracing.timeline``) every
+    span's (begin, duration, thread) is kept and
+    :meth:`chrome_trace` exports Chrome-trace JSON."""
 
     active: Optional["SpanRecorder"] = None
 
-    def __init__(self):
+    def __init__(self, timeline: Optional[bool] = None):
         import collections
         import threading
-        from ..analysis.lockdep import named_lock
         self._self_s = collections.defaultdict(float)
         self._count = collections.defaultdict(int)
         self._mu = named_lock("exec.tracing.SpanRecorder._mu")
         self._tls = threading.local()
+        self._timeline = _timeline_on() if timeline is None else timeline
+        self._events: List[tuple] = []     # (name, begin, dur, tid, tname)
+        self._t0: Optional[float] = None   # entered wall-clock origin
+        self._wall: Optional[float] = None
 
     def __enter__(self):
+        import time
         self._prev = SpanRecorder.active  # lint: unguarded-ok recorder entered on the driving thread only; pool workers read .active, never swap it
         SpanRecorder.active = self  # lint: unguarded-ok single driving-thread swap; worker reads race only with query start/end, where no spans are open
+        self._t0 = time.perf_counter()  # lint: unguarded-ok driving-thread-only enter bookkeeping
         return self
 
     def __exit__(self, *exc):
+        import time
         SpanRecorder.active = self._prev  # lint: unguarded-ok same single driving-thread swap as __enter__
+        if self._t0 is not None:
+            self._wall = time.perf_counter() - self._t0  # lint: unguarded-ok driving-thread-only exit bookkeeping
         return False
 
     def _stack(self):
@@ -112,7 +162,7 @@ class SpanRecorder:
         st = self._stack()
         return st[-1]["name"] if st else None
 
-    def _pop(self, frame, name, elapsed):
+    def _pop(self, frame, name, elapsed, begin: Optional[float] = None):
         # remove THIS frame by identity, not the stack top: spans held open
         # across generator yields (the pipelined join suspends mid-span)
         # close out of order, and popping the top would steal an unrelated
@@ -131,23 +181,97 @@ class SpanRecorder:
                 # frames are still open above
                 st[idx - 1]["child_s"] += elapsed
         self_s = max(0.0, elapsed - frame["child_s"])
+        ev = None
+        if self._timeline and begin is not None:
+            import threading
+            t = threading.current_thread()
+            ev = (name, begin, elapsed, t.ident, t.name)
         with self._mu:
             self._self_s[name] += self_s
             self._count[name] += 1
+            if ev is not None:
+                self._events.append(ev)
 
     def add(self, name, seconds):
         """Account an externally-timed interval as a leaf span (semaphore
         hold time is measured acquire->release, which brackets yields and
         cannot be a context-managed span)."""
+        ev = None
+        if self._timeline:
+            import threading
+            import time
+            t = threading.current_thread()
+            ev = (name, time.perf_counter() - seconds, seconds,
+                  t.ident, t.name)
         with self._mu:
             self._self_s[name] += seconds
             self._count[name] += 1
+            if ev is not None:
+                self._events.append(ev)
+
+    def wall_s(self) -> float:
+        """Wall clock between __enter__ and __exit__ (or now, while still
+        open); 0.0 when the recorder was never entered."""
+        if self._wall is not None:
+            return self._wall
+        if self._t0 is None:
+            return 0.0
+        import time
+        return time.perf_counter() - self._t0
 
     def report(self) -> dict:
+        """name -> {selfS, count}, most-expensive first, plus two reserved
+        scalar entries: ``wallS`` (the recorder's wall clock) and
+        ``concurrency`` (sum of self-time over wall — pool threads
+        legitimately push this past 1.0; ~1.0 means serial execution)."""
         with self._mu:
-            return {name: {"selfS": round(s, 4), "count": self._count[name]}
-                    for name, s in sorted(self._self_s.items(),
-                                          key=lambda kv: -kv[1])}
+            out: Dict[str, Any] = {
+                name: {"selfS": round(s, 4), "count": self._count[name]}
+                for name, s in sorted(self._self_s.items(),
+                                      key=lambda kv: -kv[1])}
+            total_self = sum(self._self_s.values())
+        wall = self.wall_s()
+        out["wallS"] = round(wall, 4)
+        out["concurrency"] = round(total_self / wall, 2) if wall > 0 else 0.0
+        return out
+
+    # -- Chrome-trace / Perfetto timeline export ----------------------------
+    def chrome_trace(self) -> dict:
+        """The recorded spans as a Chrome-trace JSON object (the format
+        chrome://tracing and ui.perfetto.dev open natively): one complete
+        ("X") event per span with microsecond ts/dur relative to recorder
+        entry, grouped by thread, plus thread_name metadata so the task
+        pool / shuffle threads show under their real names."""
+        base = self._t0 if self._t0 is not None else 0.0
+        with self._mu:
+            events = list(self._events)
+        out: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+            "args": {"name": "spark-rapids-tpu query"}}]
+        # synthetic track ids keyed on (ident, name): CPython REUSES
+        # thread idents after a thread exits, so keying on ident alone
+        # would merge a dead shuffle-conn thread's spans into whichever
+        # later thread inherited its ident
+        track_of: Dict[tuple, int] = {}
+        for name, begin, dur, tid, tname in events:
+            track = track_of.setdefault((tid, tname), len(track_of) + 1)
+            out.append({
+                "ph": "X", "cat": "span", "name": name, "pid": 0,
+                "tid": track, "ts": round((begin - base) * 1e6, 1),
+                "dur": round(dur * 1e6, 1)})
+        for (_tid, tname), track in sorted(track_of.items(),
+                                           key=lambda kv: kv[1]):
+            out.append({"ph": "M", "name": "thread_name", "pid": 0,
+                        "tid": track, "args": {"name": tname}})
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def dump_chrome_trace(self, path: str) -> str:
+        """Write :meth:`chrome_trace` to ``path`` (the per-query
+        ``trace.json`` the bench runner emits); returns the path."""
+        import json
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
 
 
 def record_span(name: str, seconds: float) -> None:
@@ -192,6 +316,11 @@ class SyncCounter:
 
     _tls = None                    # lazy threading.local
     _default_stack: List["SyncCounter"] = []
+    # guards _default_stack: counters enter on the driving thread but
+    # exits can interleave across threads (generator-suspended queries,
+    # tests driving counters from workers), and bare list.append/remove
+    # racing on the shared stack can drop or resurrect a default counter
+    _stack_mu = named_lock("exec.tracing.SyncCounter._default_stack")
     _orig_value = None
 
     @classmethod
@@ -200,8 +329,16 @@ class SyncCounter:
         local = getattr(tls, "active", None) if tls is not None else None
         if local is not None:
             return local
-        stack = cls._default_stack
-        return stack[-1] if stack else None
+        # LOCK-FREE read: this runs on EVERY ArrayImpl._value access (the
+        # readback funnel), so it must not acquire. Mutations (__enter__/
+        # __exit__) serialize under _stack_mu; the read handles the
+        # check-then-index window (a concurrent exit emptying the list)
+        # by catching instead of locking — either counter-or-None answer
+        # is valid during a swap
+        try:
+            return cls._default_stack[-1]
+        except IndexError:
+            return None
 
     def __init__(self):
         self.total = 0
@@ -224,7 +361,7 @@ class SyncCounter:
                 c._record()
             return orig.fget(self_arr)
 
-        cls._orig_value = orig
+        cls._orig_value = orig  # lint: unguarded-ok one-time process-lifetime patch installed from the first entering thread
         jarray.ArrayImpl._value = property(counting_value)
 
     @classmethod
@@ -233,11 +370,11 @@ class SyncCounter:
             return
         from jax._src import array as jarray
         jarray.ArrayImpl._value = cls._orig_value
-        cls._orig_value = None
+        cls._orig_value = None  # lint: unguarded-ok test-only restore of the pristine property
 
     def _record(self):
         import traceback
-        self.total += 1
+        self.total += 1  # lint: unguarded-ok best-effort counter: concurrent increments may undercount, the attributed counts are advisory diagnostics
         site = "<unknown>"
         for frame in reversed(traceback.extract_stack(limit=24)):
             fn = frame.filename
@@ -245,14 +382,18 @@ class SyncCounter:
                 short = fn[fn.rindex("spark_rapids_tpu"):]
                 site = f"{short}:{frame.lineno}"
                 break
-        self.sites[site] = self.sites.get(site, 0) + 1
+        self.sites[site] = self.sites.get(site, 0) + 1  # lint: unguarded-ok best-effort counter map, see total above
         # attribute to the innermost open span on this thread (the
         # analysis/sync_audit per-span breakdown): which named region of
         # the execute wall is paying link round trips
         rec = SpanRecorder.active
         span = rec.current_span() if rec is not None else None
         span = span or "<no-span>"
-        self.spans[span] = self.spans.get(span, 0) + 1
+        self.spans[span] = self.spans.get(span, 0) + 1  # lint: unguarded-ok best-effort counter map, see total above
+        # ...and to the innermost open EXEC's metrics bag, so EXPLAIN
+        # ANALYZE shows which plan node paid the round trip
+        from .metrics import attribute
+        attribute("hostSyncs")
 
     # -- context ------------------------------------------------------------
     def __enter__(self):
@@ -261,21 +402,23 @@ class SyncCounter:
         cls._install()
         if cls._tls is None:
             cls._tls = threading.local()
-        self._prev = getattr(cls._tls, "active", None)
+        self._prev = getattr(cls._tls, "active", None)  # lint: unguarded-ok entering thread's own field, set before the counter is shared
         cls._tls.active = self
         # the entering thread's counter is also the process default so
         # pool worker threads record into it; removal is by identity (not
         # LIFO) so interleaved exits across threads cannot resurrect a
         # finished counter as the lingering default
-        cls._default_stack.append(self)
+        with cls._stack_mu:
+            cls._default_stack.append(self)
         return self
 
     def __exit__(self, *exc):
         SyncCounter._tls.active = self._prev
-        try:
-            SyncCounter._default_stack.remove(self)
-        except ValueError:
-            pass
+        with SyncCounter._stack_mu:
+            try:
+                SyncCounter._default_stack.remove(self)
+            except ValueError:
+                pass
         return False
 
     def report(self, top: int = 10) -> dict:

@@ -25,7 +25,7 @@ from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..ops import expressions as ex
 from ..plan import logical as lp
-from ..plan.physical import Partition, TpuExec
+from ..plan.physical import Partition, TpuExec, exec_metrics
 from . import expand_paths, read_file_to_arrow
 from ..exec.tracing import trace_span
 
@@ -50,6 +50,7 @@ class TpuFileScanExec(TpuExec):
     """GpuFileSourceScanExec / GpuBatchScanExec analog."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="source")
+    METRICS = exec_metrics("bufferTime", "tpuDecodeTime")
 
     def __init__(self, plan: lp.FileScan, conf: Optional[cfg.TpuConf] = None):
         super().__init__()

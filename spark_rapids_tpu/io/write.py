@@ -18,12 +18,13 @@ from ..analysis.contracts import exec_contract
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..plan import logical as lp
-from ..plan.physical import Partition, TpuExec
+from ..plan.physical import Partition, TpuExec, exec_metrics
 
 
 class TpuWriteFileExec(TpuExec):
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              extras=("empty_schema",))
+    METRICS = exec_metrics()
 
     def __init__(self, child: TpuExec, plan: lp.WriteFile):
         super().__init__(child)

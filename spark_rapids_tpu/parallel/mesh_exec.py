@@ -23,7 +23,8 @@ from ..ops import expressions as ex
 from ..ops import kernels as K
 from ..plan import logical as lp
 from ..plan.physical import (Partition, TpuExec, TpuShuffledJoinExec,
-                             accumulate_spillable, bind_refs, concat_spillable)
+                             accumulate_spillable, bind_refs,
+                             concat_spillable, exec_metrics)
 from . import mesh as M
 from ..exec.tracing import trace_span
 
@@ -76,6 +77,7 @@ class TpuMeshGroupByExec(TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="defined",
                              bound={"grouping": 0})
+    METRICS = exec_metrics("meshGroupByTime")
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
                  outputs: List[ex.Expression], mesh,
@@ -166,6 +168,7 @@ class TpuMeshSortExec(TpuExec):
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
                              bound={"orders": 0})
+    METRICS = exec_metrics("meshSortTime")
 
     def __init__(self, child: TpuExec, orders: List[lp.SortOrder], mesh):
         super().__init__(child)
@@ -216,6 +219,8 @@ class TpuMeshJoinExec(TpuShuffledJoinExec):
     CONTRACT = exec_contract(schema="defined", partitioning="defined",
                              bound={"left_keys": 0, "right_keys": 1},
                              extras=("join_schema",))
+    METRICS = exec_metrics("joinTime", "buildTime", "skewJoinSplits",
+                           "runtimeBroadcastJoins", "meshExchangeTime")
 
     def __init__(self, left: TpuExec, right: TpuExec, how: str,
                  left_keys, right_keys, condition, mesh,

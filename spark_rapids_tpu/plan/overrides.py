@@ -439,6 +439,9 @@ class Overrides:
         self.conf = conf or cfg.TpuConf()
         self.last_explain: str = ""
         self.last_meta: Optional[PlanMeta] = None
+        # structured plan-contract violations from the last apply():
+        # EXPLAIN ANALYZE attaches these to the rendered tree per node
+        self.last_violations: list = []
 
     def apply(self, plan: lp.LogicalPlan) -> ph.TpuExec:
         plan = _shred_struct_columns(plan)
@@ -459,7 +462,7 @@ class Overrides:
         # and contract diagnostics; `error` mode rejects the plan.
         from ..analysis import contracts as _contracts
         try:
-            diag = _contracts.enforce(
+            diag, self.last_violations = _contracts.enforce(
                 node, meta, str(self.conf.get(cfg.ANALYSIS_VALIDATE_PLAN)))
         except _contracts.PlanContractError as e:
             # the rejection diagnostic still lands in last_explain so the
@@ -1187,6 +1190,7 @@ class _ReorderExec(ph.TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              extras=("reorder_permutation",))
+    METRICS = ph.exec_metrics()
 
     def __init__(self, child: ph.TpuExec, schema: dt.Schema,
                  n_right: int, n_left: int):
@@ -1215,6 +1219,7 @@ class CpuOpBridgeExec(ph.TpuExec):
     GpuTransitionOverrides.scala transitions)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="single")
+    METRICS = ph.exec_metrics()
 
     def __init__(self, plan: lp.LogicalPlan, tpu_children: List[ph.TpuExec]):
         super().__init__(*tpu_children)

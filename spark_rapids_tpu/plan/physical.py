@@ -84,84 +84,9 @@ def _dev_count(batch) -> "Any":
     return nr.astype(jnp.int32)
 
 
-class Metrics(dict):
-    _lock = __import__("threading").Lock()
-
-    def inc(self, key: str, amount: float = 1) -> None:
-        # partitions drain on concurrent task threads; keep counters exact.
-        # Device-resident amounts (lazy batch counts) are banked unresolved
-        # so metric accounting never forces a device sync on the hot path.
-        if not isinstance(amount, (int, float)):
-            with Metrics._lock:
-                if not hasattr(self, "_pending"):
-                    self._pending = []
-                self._pending.append((key, amount))
-                flush = len(self._pending) >= 256
-            if flush:          # bound the deferred-scalar backlog
-                self.resolve()
-            return
-        with Metrics._lock:
-            self[key] = dict.get(self, key, 0) + amount
-
-    def resolve(self) -> "Metrics":
-        """Fold deferred device-scalar amounts into the counters in one
-        batched readback (reporting boundaries; readers below call it)."""
-        with Metrics._lock:
-            pend = getattr(self, "_pending", [])
-            self._pending = []
-        if pend:
-            import jax
-            try:
-                vals = jax.device_get([a for _k, a in pend])
-            except Exception:
-                # one bad scalar must not zero the whole flush: fall back
-                # to per-value reads, dropping only the failed ones
-                vals = []
-                for _k, a in pend:
-                    try:
-                        vals.append(jax.device_get(a))
-                    except Exception:
-                        vals.append(None)
-            with Metrics._lock:
-                for (key, _a), v in zip(pend, vals):
-                    if v is None:
-                        continue
-                    v = v.item() if hasattr(v, "item") else v
-                    if isinstance(v, float) and v.is_integer():
-                        v = int(v)     # row/batch counters stay integral
-                    self[key] = dict.get(self, key, 0) + v
-        return self
-
-    # readers see resolved counters (deferred amounts fold in lazily)
-    def __getitem__(self, key):
-        self.resolve()
-        return dict.__getitem__(self, key)
-
-    def get(self, key, default=None):
-        if getattr(self, "_pending", None):
-            self.resolve()
-        return dict.get(self, key, default)
-
-    def items(self):
-        self.resolve()
-        return dict.items(self)
-
-    def timer(self, key: str):
-        return _Timer(self, key)
-
-
-class _Timer:
-    def __init__(self, metrics: Metrics, key: str):
-        self.metrics = metrics
-        self.key = key
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        self.metrics.inc(self.key, time.perf_counter() - self.t0)
-        return False
+# The metrics bag + per-exec attribution live in exec/metrics.py; the
+# ``Metrics`` name stays importable from here for existing call sites.
+from ..exec.metrics import TpuMetrics as Metrics, exec_metrics  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -179,6 +104,7 @@ class TpuExec:
     after every conversion) enforces it holds."""
 
     CONTRACT = None          # abstract base: concrete execs must declare
+    METRICS = None           # abstract base: concrete execs must declare
 
     def __init__(self, *children: "TpuExec"):
         self.children = list(children)
@@ -281,31 +207,49 @@ class TpuExec:
                 stack.extend(getattr(node, "children", ()))
         return True
 
-    def metrics_tree(self) -> List[tuple]:
+    def metrics_tree(self, with_path: bool = False) -> List[tuple]:
         """Per-exec metrics in plan-tree order: [(depth, node name,
         resolved metrics dict)] — the SQLMetrics-per-operator surface the
         reference renders in the Spark UI (GpuMetricNames,
-        GpuExec.scala:27-56)."""
+        GpuExec.scala:27-56). ``with_path=True`` appends the root->node
+        class-name path (the same format ``analysis/contracts`` keys its
+        violations on) as a fourth element."""
         out: List[tuple] = []
 
-        def walk(node, depth):
-            out.append((depth, node._node_string(),
-                        dict(node.metrics.resolve())))
-            for c in node.children:
-                walk(c, depth + 1)
-        walk(self, 0)
+        def walk(node, depth, path, idx=None):
+            # path mirrors contracts.validate_plan: child ordinal included
+            # so same-class siblings key different paths
+            here = (f"{path}/{idx}.{type(node).__name__}" if path
+                    else type(node).__name__)
+            row = (depth, node._node_string(),
+                   dict(node.metrics.resolve()))
+            out.append(row + (here,) if with_path else row)
+            for i, c in enumerate(node.children):
+                walk(c, depth + 1, here, i)
+        walk(self, 0, "")
         return out
 
-    def metrics_string(self) -> str:
-        """The executed plan annotated with each operator's metrics."""
-        lines = []
-        for depth, name, m in self.metrics_tree():
-            lines.append("  " * depth + name)
+    def metrics_lines(self, annotate: Optional[Callable] = None
+                      ) -> List[str]:
+        """Rendered metrics tree, one list entry per line: node name then
+        its sorted metrics (floats rounded to 4). ``annotate(path)`` may
+        return extra lines to attach under a node — EXPLAIN ANALYZE hangs
+        plan-contract diagnostics there."""
+        lines: List[str] = []
+        for depth, name, m, path in self.metrics_tree(with_path=True):
+            pad = "  " * depth
+            lines.append(pad + name)
             for k in sorted(m):
                 v = m[k]
                 v = round(v, 4) if isinstance(v, float) else v
-                lines.append("  " * depth + f"  {k}: {v}")
-        return "\n".join(lines)
+                lines.append(pad + f"  {k}: {v}")
+            for extra in (annotate(path) if annotate is not None else ()):
+                lines.append(pad + f"  {extra}")
+        return lines
+
+    def metrics_string(self) -> str:
+        """The executed plan annotated with each operator's metrics."""
+        return "\n".join(self.metrics_lines())
 
     def _tree_string(self, depth: int = 0) -> str:
         out = "  " * depth + self._node_string()
@@ -763,7 +707,7 @@ class TpuLocalScanExec(TpuExec):
     """In-memory arrow table scan -> device batches (HostColumnarToGpu analog)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="source")
-
+    METRICS = exec_metrics("scanTime", "cacheHitBatches")
 
     def __init__(self, table, schema: dt.Schema, batch_rows: int = 1 << 20,
                  num_partitions: int = 1, base_data=None):
@@ -922,7 +866,7 @@ class TpuCachedScanExec(TpuExec):
     (GpuInMemoryTableScanExec, reference spark310 shim)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="single")
-
+    METRICS = exec_metrics()
 
     def __init__(self, plan):
         super().__init__()
@@ -959,7 +903,7 @@ class TpuRangeExec(TpuExec):
     """range() generated on device (GpuRangeExec, basicPhysicalOperators.scala:187)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="source")
-
+    METRICS = exec_metrics()
 
     def __init__(self, start: int, end: int, step: int, num_partitions: int = 1,
                  batch_rows: int = 1 << 20):
@@ -1009,7 +953,7 @@ class TpuProjectExec(TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              bound={"exprs": 0})
-
+    METRICS = exec_metrics()
 
     def __init__(self, child: TpuExec, exprs: List[ex.Expression]):
         super().__init__(child)
@@ -1051,7 +995,7 @@ class TpuFilterExec(TpuExec):
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="preserve",
                              bound={"condition": 0})
-
+    METRICS = exec_metrics()
 
     def __init__(self, child: TpuExec, condition: ex.Expression):
         super().__init__(child)
@@ -1106,7 +1050,7 @@ class TpuCoalesceBatchesExec(TpuExec):
     'single' (RequireSingleBatch) or target row count."""
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="preserve")
-
+    METRICS = exec_metrics("concatTime")
 
     def __init__(self, child: TpuExec, goal: Any = "single",
                  target_rows: int = 1 << 22):
@@ -1176,6 +1120,7 @@ class TpuHashAggregateExec(TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="defined",
                              extras=("agg_distribution",))
+    METRICS = exec_metrics("computeAggTime")
 
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
                  aggregate_exprs: List[ex.Expression], mode: str = "complete",
@@ -1338,7 +1283,9 @@ class TpuHashAggregateExec(TpuExec):
             return pb
 
         depth = max(1, int(cfg.TpuConf().get(cfg.AGG_PIPELINE_DEPTH)))
-        win = PipelineWindow(depth)
+        # metrics=: the window's batched stat readbacks charge THIS exec's
+        # hostSyncs (exec/metrics.exec_scope), not just the span string
+        win = PipelineWindow(depth, metrics=self.metrics)
         for batch in batches:
             # semaphore ordering contract: acquire only once the first input
             # batch exists (upstream host IO done), GpuSemaphore.scala:74-78
@@ -2033,6 +1980,7 @@ class TpuSortExec(TpuExec):
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="preserve",
                              bound={"orders": 0})
+    METRICS = exec_metrics("sortTime")
 
     def __init__(self, child: TpuExec, orders: List[lp.SortOrder],
                  is_global: bool = True):
@@ -2073,6 +2021,7 @@ class TpuLimitExec(TpuExec):
     """Local/global limit (limit.scala)."""
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="defined")
+    METRICS = exec_metrics()
 
     def __init__(self, child: TpuExec, n: int, is_global: bool = True):
         super().__init__(child)
@@ -2123,6 +2072,7 @@ class TpuUnionExec(TpuExec):
     """Union all (GpuUnionExec)."""
 
     CONTRACT = exec_contract(schema="union", partitioning="defined")
+    METRICS = exec_metrics()
 
     @property
     def schema(self):
@@ -2150,6 +2100,7 @@ class TpuExpandExec(TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              bound={"projections": 0})
+    METRICS = exec_metrics()
 
     def __init__(self, child: TpuExec, projections: List[List[ex.Expression]],
                  output_names: List[str]):
@@ -2184,6 +2135,7 @@ class TpuMapInPandasExec(TpuExec):
     to a steady size first (RebatchingRoundoffIterator analog)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve")
+    METRICS = exec_metrics()
 
     def __init__(self, child: TpuExec, plan: "lp.MapInPandas",
                  target_rows: int = 1 << 16):
@@ -2247,6 +2199,7 @@ class TpuFlatMapGroupsInPandasExec(TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              bound={"grouping": 0})
+    METRICS = exec_metrics("udfTime")
 
     def __init__(self, child: TpuExec, plan: "lp.FlatMapGroupsInPandas"):
         super().__init__(child)
@@ -2299,6 +2252,7 @@ class TpuFlatMapCoGroupsInPandasExec(TpuExec):
     sets; a missing side passes an empty frame), fn maps each pair."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="defined")
+    METRICS = exec_metrics("udfTime")
 
     def __init__(self, left: TpuExec, right: TpuExec,
                  plan: "lp.FlatMapCoGroupsInPandas"):
@@ -2370,6 +2324,7 @@ class TpuAggregateInPandasExec(TpuExec):
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              bound={"grouping": 0})
+    METRICS = exec_metrics("udfTime")
 
     def __init__(self, child: TpuExec, plan: "lp.AggregateInPandas"):
         super().__init__(child)
@@ -2435,6 +2390,7 @@ class TpuGenerateExec(TpuExec):
     the intermediate array<string> never materializes."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve")
+    METRICS = exec_metrics("generateTime")
 
     def __init__(self, child: TpuExec, plan: lp.Generate):
         super().__init__(child)
@@ -2510,6 +2466,7 @@ class TpuSortMergeJoinExec(TpuExec):
     CONTRACT = exec_contract(schema="defined", partitioning="defined",
                              bound={"left_keys": 0, "right_keys": 1},
                              extras=("join_schema",))
+    METRICS = exec_metrics("joinTime", "buildTime")
 
     def __init__(self, left: TpuExec, right: TpuExec, how: str,
                  left_keys: List[ex.Expression], right_keys: List[ex.Expression],
@@ -2563,8 +2520,11 @@ class TpuSortMergeJoinExec(TpuExec):
         if isinstance(bchild, TpuBroadcastExchangeExec):
             handle = bchild.materialize()
         else:
-            build = concat_spillable(
-                bchild.schema, accumulate_spillable(bchild.execute()))
+            # metered separately from the stream loop (the reference's
+            # buildTime vs joinTime split, GpuMetricNames)
+            with trace_span("join_build", self.metrics, "buildTime"):
+                build = concat_spillable(
+                    bchild.schema, accumulate_spillable(bchild.execute()))
             handle = self._build_handle = SpillableColumnarBatch(build)
         stream_parts = self.children[0].execute()
         if self.how == "full":
@@ -2608,7 +2568,9 @@ class TpuSortMergeJoinExec(TpuExec):
         # sizing resolves; the window lands half a depth of size scalars
         # per batched readback, so join-path host syncs are O(1) per stage
         # instead of one blocking RTT per stream batch.
-        win = PipelineWindow(self._pipeline_depth())
+        # metrics=: sizing-scalar readbacks attribute their hostSyncs to
+        # this join exec (the EXPLAIN ANALYZE per-node sync count)
+        win = PipelineWindow(self._pipeline_depth(), metrics=self.metrics)
         for batch in part:
             # admission: up to `depth` stream batches (+ match state) stay
             # device-resident while their sizing scalars are in flight —
@@ -2715,6 +2677,8 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
     CONTRACT = exec_contract(schema="defined", partitioning="defined",
                              bound={"left_keys": 0, "right_keys": 1},
                              extras=("join_schema", "copartitioned"))
+    METRICS = exec_metrics("joinTime", "buildTime", "skewJoinSplits",
+                           "runtimeBroadcastJoins")
 
     # runtime AQE join switch: set by the planner to the broadcast-join
     # byte threshold when adaptive execution is on (None = off)
@@ -2857,10 +2821,12 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
     def _join_copart(self, stream_part: Partition,
                      build_part: Partition) -> Partition:
         from ..exec.spill import SpillableColumnarBatch
-        build = concat_spillable(
-            self.children[1].schema,
-            [SpillableColumnarBatch(b) for b in build_part if b.num_rows > 0])
-        handle = SpillableColumnarBatch(build)
+        with trace_span("join_build", self.metrics, "buildTime"):
+            build = concat_spillable(
+                self.children[1].schema,
+                [SpillableColumnarBatch(b) for b in build_part
+                 if b.num_rows > 0])
+            handle = SpillableColumnarBatch(build)
         try:
             if self.how == "full":
                 merged = concat_spillable(
@@ -2910,6 +2876,7 @@ class TpuCrossJoinExec(TpuExec):
     """Cartesian product (GpuCartesianProductExec)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="defined")
+    METRICS = exec_metrics()
 
     def __init__(self, left: TpuExec, right: TpuExec,
                  condition: Optional[ex.Expression] = None):
@@ -2958,6 +2925,7 @@ class CpuFallbackExec(TpuExec):
     of a mixed plan; transition = GpuRowToColumnarExec analog on output)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="single")
+    METRICS = exec_metrics()
 
     def __init__(self, plan: lp.LogicalPlan):
         super().__init__()

@@ -20,12 +20,14 @@ from ..ops import kernels as K
 from ..ops import window as W
 from . import logical as lp
 from ..analysis.contracts import exec_contract
-from .physical import Partition, TpuExec, bind_refs, concat_batches
+from .physical import (Partition, TpuExec, bind_refs, concat_batches,
+                       exec_metrics)
 
 
 class TpuWindowExec(TpuExec):
     CONTRACT = exec_contract(schema="defined", partitioning="preserve",
                              extras=("window_schema",))
+    METRICS = exec_metrics("windowTime")
 
     def __init__(self, child: TpuExec, window_exprs: List[Tuple[str, W.WindowExpression]]):
         super().__init__(child)

@@ -22,7 +22,8 @@ from ..columnar.batch import ColumnarBatch
 from ..exec.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY, BufferCatalog,
                           SpillableColumnarBatch)
 from ..ops import expressions as ex
-from ..plan.physical import Partition, TpuExec, bind_refs, concat_batches
+from ..plan.physical import (Partition, TpuExec, bind_refs, concat_batches,
+                             exec_metrics)
 from ..exec.tracing import trace_span
 from .partitioning import (HashPartitioner, RoundRobinPartitioner,
                            SinglePartitioner, TpuPartitioner)
@@ -109,6 +110,10 @@ class TpuShuffleExchangeExec(TpuExec):
     keep identical partitioning."""
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="defined")
+    METRICS = exec_metrics("dataSize", "shuffleWriteTime",
+                           "shuffleFetchTime", "skewSplitPartitions",
+                           "skewSplitTasks", "coalescedPartitions",
+                           "fetchFailedRetries")
 
     def __init__(self, child: TpuExec, num_partitions: int,
                  by: Optional[List[ex.Expression]] = None,
@@ -372,6 +377,7 @@ class TpuHashExchangeExec(TpuShuffleExchangeExec):
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
                              bound={"by": 0})
+    METRICS = TpuShuffleExchangeExec.METRICS   # emits only inherited keys
 
     def __init__(self, child: TpuExec, num_partitions: int,
                  keys: List[ex.Expression], adaptive_ok: bool = False,
@@ -395,6 +401,7 @@ class TpuRangeExchangeExec(TpuExec):
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
                              bound={"orders": 0})
+    METRICS = exec_metrics("sampleTime", "shuffleWriteTime")
 
     SAMPLE_TARGET_PER_PARTITION = 100
 
@@ -471,6 +478,7 @@ class TpuBroadcastExchangeExec(TpuExec):
     """
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="single")
+    METRICS = exec_metrics("broadcastTime", "dataSize")
 
     def __init__(self, child: TpuExec):
         super().__init__(child)

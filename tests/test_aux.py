@@ -297,7 +297,11 @@ def test_span_breakdown_names_query_time():
     m = s.last_query_metrics()
     spans = m["spans"]
     assert spans, "span report must not be empty"
+    # reserved query-level scalars ride next to the per-name records
+    assert spans["wallS"] > 0.0 and spans["concurrency"] >= 0.0
     for name, rec in spans.items():
+        if name in ("wallS", "concurrency"):
+            continue
         assert rec["selfS"] >= 0.0 and rec["count"] >= 1, (name, rec)
     # the aggregate/sort pipeline must be named
     assert any(n in spans for n in ("aggregate", "fused_project",

@@ -93,10 +93,56 @@ def test_rule_allowlist_helpers_exempt():
 
 def test_rule_exec_contract_missing():
     src = ("class TpuFooExec(TpuExec):\n    pass\n\n"
-           "class TpuBarExec(TpuExec):\n    CONTRACT = object()\n")
+           "class TpuBarExec(TpuExec):\n"
+           "    CONTRACT = object()\n"
+           "    METRICS = exec_metrics()\n")
     v = lint.lint_source(src, "plan/physical.py")
     assert len(v) == 1 and v[0].rule == "exec-contract" \
         and "TpuFooExec" in v[0].message
+
+
+def test_rule_exec_metrics_missing():
+    """A CONTRACT-declaring exec without METRICS trips exec-metrics."""
+    src = ("class TpuFooExec(TpuExec):\n"
+           "    CONTRACT = object()\n")
+    v = lint.lint_source(src, "plan/physical.py")
+    assert len(v) == 1 and v[0].rule == "exec-metrics" \
+        and "TpuFooExec" in v[0].message
+
+
+def test_base_metric_keys_mirror_in_sync():
+    """lint.BASE_METRIC_KEYS is a hand-maintained mirror of
+    exec/metrics.BASE_METRICS (the linter cannot import the engine); a
+    drift would lint-fail every exec emitting the new key — or exempt a
+    dropped one forever."""
+    from spark_rapids_tpu.exec import metrics as em
+    assert lint.BASE_METRIC_KEYS == set(em.BASE_METRICS)
+
+
+def test_rule_metric_key_undeclared():
+    """A literal metric key not in the class's METRICS trips metric-key —
+    both the trace_span metric_key argument and metrics.inc calls; base
+    keys (numOutputRows, opTime, hostSyncs, ...) are exempt."""
+    src = (
+        "class TpuFooExec(TpuExec):\n"
+        "    CONTRACT = object()\n"
+        '    METRICS = exec_metrics("fooTime")\n'
+        "    def _map(self):\n"
+        '        with trace_span("foo", self.metrics, "fooTime"):\n'
+        "            pass\n"
+        '        with trace_span("bar", self.metrics, "barTime"):\n'
+        "            pass\n"
+        '        self.metrics.inc("numOutputRows", 1)\n'
+        '        self.metrics.inc("rogueCounter")\n'
+        '        with trace_span("kw", self.metrics,\n'
+        '                        metric_key="kwTime"):\n'
+        "            pass\n")
+    v = lint.lint_source(src, "plan/physical.py")
+    rules = [x.rule for x in v]
+    msgs = "\n".join(x.message for x in v)
+    assert rules == ["metric-key"] * 3, v
+    assert "barTime" in msgs and "rogueCounter" in msgs and "kwTime" in msgs
+    assert "fooTime" not in msgs and "numOutputRows" not in msgs
 
 
 def test_rule_conf_docs_drift_both_directions():
