@@ -263,6 +263,37 @@ def main():
     if degraded and probe.get("error"):
         line["probe_error"] = probe["error"]
     line.update(engine)
+
+    # regression gate (benchmarks/history.py): stamp this round against
+    # the best prior clean same-backend round and append it to the
+    # history JSONL, so round-over-round trajectory lives in the
+    # artifact instead of in whoever remembers r03
+    try:
+        from benchmarks import history as bh
+        queries = {"fused_pipeline": line["value"]}
+        for q in ("q6", "q1"):
+            v = engine.get(f"engine_{q}_mrows_per_s")
+            if v is not None:
+                queries[f"engine_{q}"] = v
+        gate = bh.stamp(
+            "bench", queries, backend=line["backend"], degraded=degraded,
+            error=probe.get("error") if degraded else None,
+            higher_is_better=True,
+            meta={"rows": n_rows, "engine_sf": engine_sf})
+        line["regression"] = {q: v.get("verdict")
+                              for q, v in gate["verdicts"].items()}
+        line["regression_overall"] = gate["overall"]
+    except Exception as e:        # the gate must not kill the bench line
+        line["regression_error"] = str(e)[:120]
+
+    # process-telemetry tail (service/telemetry): the registry numbers a
+    # round-over-round reader diffs (parity with the MULTICHIP artifact)
+    try:
+        from spark_rapids_tpu.service.telemetry import compact_snapshot
+        line["telemetry"] = compact_snapshot()
+    except Exception:
+        pass
+
     print(json.dumps(line))
 
 

@@ -1,0 +1,208 @@
+"""Bench round history + regression gate.
+
+BENCH_r04/r05 shipped dark (``value: 0`` from a dead device tunnel) and
+nobody noticed until a human diffed JSON by hand — and even CLEAN rounds
+carried no round-over-round signal: the trajectory of the bench lived in
+nobody's head. The rule now:
+
+* every BENCH / MULTICHIP / runner round APPENDS one line to a history
+  JSONL (``benchmarks/reports/bench_history.jsonl``), keyed by query,
+  carrying its backend label and degraded/error state;
+* ``cpu-degraded`` and errored rounds are EXCLUDED from baselines (they
+  are real, labeled measurements — but an infra fallback must never
+  become the bar new rounds are judged against);
+* each new round is stamped with a per-query regression verdict against
+  the best prior clean round **on the same backend** (a cpu round judged
+  against an accelerator baseline is noise, not signal):
+  ``fail`` at >= 25% worse, ``warn`` at >= 10% worse, ``improvement``
+  when better, ``ok`` in between, ``no-baseline`` for a first round.
+
+``bench.py``, ``benchmarks/runner.py`` and the MULTICHIP dryrun all
+stamp through :func:`stamp`; the verdicts ride the artifact JSON so the
+next dark or slow round is visible in the round itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+WARN_PCT = 0.10
+FAIL_PCT = 0.25
+
+#: default history file, committed with the repo so the gate has memory
+#: across rounds (each bench round is a fresh process)
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "reports", "bench_history.jsonl")
+
+
+def default_path() -> str:
+    """The history file every stamper uses unless told otherwise. The
+    env override exists so the TEST suite (which drives bench/dryrun
+    code paths) never appends synthetic rounds to the committed file."""
+    return os.environ.get("SPARK_RAPIDS_TPU_BENCH_HISTORY") or DEFAULT_PATH
+
+
+def load(path: Optional[str] = None) -> List[Dict]:
+    """Every parseable round in the history file, in append order.
+    Corrupt lines are skipped — a torn write from a killed round must
+    not take the whole gate down."""
+    path = path or default_path()
+    if not os.path.exists(path):
+        return []
+    out: List[Dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(entry, dict) and "queries" in entry:
+                out.append(entry)
+    return out
+
+
+def append(entry: Dict, path: Optional[str] = None) -> str:
+    """Append one round line (parent dirs created defensively)."""
+    path = path or default_path()
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return path
+
+
+def round_entry(kind: str, queries: Dict[str, float], *, backend: str,
+                degraded: bool = False, error: Optional[str] = None,
+                higher_is_better: bool = True,
+                meta: Optional[Dict] = None) -> Dict:
+    """Build one history line. ``kind`` namespaces the comparison series
+    (e.g. ``bench``, ``multichip``, ``runner-tpch-sf0.01``): values are
+    only ever compared within one kind. ``queries`` maps query name ->
+    the round's number (Mrows/s for BENCH — higher better; hot seconds
+    for the runner — lower better)."""
+    entry = {
+        "atS": round(time.time(), 3),
+        "kind": kind,
+        "backend": backend,
+        "degraded": bool(degraded),
+        "higherIsBetter": bool(higher_is_better),
+        "queries": {q: v for q, v in queries.items() if v is not None},
+    }
+    if error:
+        entry["error"] = str(error)[:400]
+    if meta:
+        entry["meta"] = meta
+    return entry
+
+
+def _clean(entry: Dict, kind: str, backend: str) -> bool:
+    """A round usable as baseline: same series, same backend, not
+    degraded, not errored."""
+    return (entry.get("kind") == kind and
+            entry.get("backend") == backend and
+            not entry.get("degraded") and
+            not entry.get("error"))
+
+
+def baseline(history: List[Dict], kind: str, backend: str,
+             query: str, higher_is_better: bool = True) -> Optional[float]:
+    """Best prior clean same-backend value for ``query`` (max when higher
+    is better, min otherwise); None with no usable prior round. Zero /
+    negative values never qualify — a zeroed metric is a dark round, not
+    a record."""
+    vals = [e["queries"][query] for e in history
+            if _clean(e, kind, backend) and
+            isinstance(e["queries"].get(query), (int, float)) and
+            e["queries"][query] > 0]
+    if not vals:
+        return None
+    return max(vals) if higher_is_better else min(vals)
+
+
+def verdict_for(value: Optional[float], base: Optional[float],
+                higher_is_better: bool = True) -> Dict:
+    """One query's regression verdict vs its baseline."""
+    if value is None or value <= 0:
+        return {"verdict": "no-measurement", "baseline": base}
+    if base is None:
+        return {"verdict": "no-baseline", "value": value}
+    # normalized so positive change == better, regardless of direction
+    if higher_is_better:
+        change = (value - base) / base
+    else:
+        change = (base - value) / base
+    out = {"value": value, "baseline": base,
+           "changePct": round(change * 100, 2)}
+    if change <= -FAIL_PCT:
+        out["verdict"] = "fail"
+    elif change <= -WARN_PCT:
+        out["verdict"] = "warn"
+    elif change > 0:
+        out["verdict"] = "improvement"
+    else:
+        out["verdict"] = "ok"
+    return out
+
+
+def verdicts(history: List[Dict], entry: Dict) -> Dict[str, Dict]:
+    """Per-query verdicts for ``entry`` against ``history``. A degraded
+    or errored round is never judged (its values are infra artifacts):
+    every query reads ``excluded``."""
+    kind = entry["kind"]
+    backend = entry["backend"]
+    hib = entry.get("higherIsBetter", True)
+    out: Dict[str, Dict] = {}
+    for q, v in entry["queries"].items():
+        if entry.get("degraded") or entry.get("error"):
+            out[q] = {"verdict": "excluded",
+                      "reason": "degraded/errored round: measured and "
+                                "recorded, never judged or used as "
+                                "baseline"}
+            continue
+        out[q] = verdict_for(v, baseline(history, kind, backend, q, hib),
+                             hib)
+    return out
+
+
+def worst(vs: Dict[str, Dict]) -> str:
+    """The round's overall verdict (the single word a dashboard shows)."""
+    order = ("fail", "warn", "no-measurement", "ok", "improvement",
+             "no-baseline", "excluded")
+    present = {v.get("verdict") for v in vs.values()}
+    for level in order:
+        if level in present:
+            return level
+    return "no-data"
+
+
+def stamp(kind: str, queries: Dict[str, float], *, backend: str,
+          degraded: bool = False, error: Optional[str] = None,
+          higher_is_better: bool = True, meta: Optional[Dict] = None,
+          path: Optional[str] = None) -> Dict:
+    """The one-call gate: verdicts for this round against the existing
+    history, then append the round so the NEXT one sees it. Returns
+    ``{"verdicts": {q: ...}, "overall": str, "rounds": n}``. Never
+    raises — a broken history file downgrades to no-baseline verdicts,
+    and an unwritable file loses persistence, not the round's report."""
+    path = path or default_path()
+    try:
+        history = load(path)
+    except Exception:
+        history = []
+    entry = round_entry(kind, queries, backend=backend, degraded=degraded,
+                        error=error, higher_is_better=higher_is_better,
+                        meta=meta)
+    vs = verdicts(history, entry)
+    entry["regression"] = {q: v.get("verdict") for q, v in vs.items()}
+    try:
+        append(entry, path)
+    except Exception:
+        pass
+    return {"verdicts": vs, "overall": worst(vs),
+            "rounds": len(history) + 1}

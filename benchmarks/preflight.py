@@ -76,12 +76,34 @@ def force_cpu_backend() -> None:
         pass
 
 
+def _publish_probe(backend: str, probe: Dict) -> None:
+    """Probe latency + backend into the process metrics registry
+    (service/telemetry): scrape surfaces answer "which backend, how far
+    away" for the lifetime of the bench process. Best-effort — the
+    module that exists to make the bench crash-proof must not crash it.
+    Runs AFTER the backend decision, so a degraded run has already
+    pinned JAX_PLATFORMS=cpu before the engine package imports."""
+    try:
+        from spark_rapids_tpu.service.telemetry import MetricsRegistry
+        reg = MetricsRegistry.get()
+        reg.gauge("tpu_preflight_probe_seconds",
+                  "child-process jax.devices() probe latency").set(
+            probe.get("latencyS") or 0.0)
+        reg.gauge("tpu_preflight_backend_info",
+                  "constant 1; resolved bench backend label",
+                  backend=backend).set(1)
+    except Exception:
+        pass
+
+
 def preflight(timeout_s: float = DEFAULT_TIMEOUT_S) -> Dict:
     """Probe and, on failure, force the CPU backend. Returns
     ``{"backend": <platform or "cpu-degraded">, "deviceProbe": {...}}`` —
     the fields every BENCH/MULTICHIP artifact now records."""
     probe = probe_devices(timeout_s)
     if probe["ok"]:
+        _publish_probe(probe["platform"], probe)
         return {"backend": probe["platform"], "deviceProbe": probe}
     force_cpu_backend()
+    _publish_probe("cpu-degraded", probe)
     return {"backend": "cpu-degraded", "deviceProbe": probe}

@@ -24,7 +24,8 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                   output: Optional[str] = None, suite: str = "tpch",
                   concurrent_tasks: Optional[int] = None,
                   trace_dir: Optional[str] = None,
-                  probe_timeout_s: float = 30.0) -> Dict:
+                  probe_timeout_s: float = 30.0,
+                  history_path: Optional[str] = None) -> Dict:
     import os
     # device preflight BEFORE any engine/jax use: a dead tunnel degrades
     # this run to an explicit cpu-degraded measurement instead of hanging
@@ -51,7 +52,12 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         # runs (the documented tests/bench default for analysis.lockdep)
         "spark.rapids.tpu.sql.analysis.lockdep", "record").getOrCreate()
     if trace_dir:
-        os.makedirs(trace_dir, exist_ok=True)
+        # defensive: --trace-dir may name a nested path that does not
+        # exist yet; a failed trace write must never fail the run
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+        except OSError:
+            trace_dir = None
     # the listener API (session.register_query_listener) delivers the
     # executed plan + metrics tree per query; the LAST capture per name
     # lands in the report as that query's per-operator metrics tree
@@ -177,6 +183,35 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                 {"locks": t["locks"], "transfer": t["transfer"]}
                 for t in lk["heldAcrossTransfer"]],
         }
+    # regression gate (benchmarks/history.py): per-query hot seconds vs
+    # the best prior clean same-backend round of this suite+sf series;
+    # the verdict lands both per query and as a report summary
+    try:
+        from . import history as bh
+        degraded = report["backend"] == "cpu-degraded"
+        gate = bh.stamp(
+            f"runner-{suite}-sf{sf}",
+            {name: e.get("hot_s") for name, e in report["queries"].items()},
+            backend=report["backend"], degraded=degraded,
+            error=report["deviceProbe"].get("error") if degraded else None,
+            higher_is_better=False,        # hot seconds: lower is better
+            meta={"iterations": iterations,
+                  "concurrentTpuTasks": concurrent_tasks},
+            path=history_path)
+        for name, v in gate["verdicts"].items():
+            if name in report["queries"]:
+                report["queries"][name]["regression"] = v
+        report["regression_overall"] = gate["overall"]
+    except Exception as e:        # the gate must not kill the report
+        report["regression_error"] = str(e)[:200]
+    # process-telemetry registry snapshot rides the artifact (parity
+    # with BENCH/MULTICHIP tails): semaphore/lockdep/sync/recompile/
+    # spill/shuffle/HBM numbers for this whole run
+    try:
+        from spark_rapids_tpu.service.telemetry import compact_snapshot
+        report["telemetry"] = compact_snapshot()
+    except Exception:
+        pass
     if output:
         with open(output, "w") as f:
             json.dump(report, f, indent=2)
@@ -235,6 +270,9 @@ def main():
                     help="device preflight probe timeout in seconds; on "
                          "failure the run degrades to an explicit "
                          "cpu-degraded backend instead of a zero")
+    ap.add_argument("--history", type=str, default=None,
+                    help="bench-history JSONL for the regression gate "
+                         "(default: benchmarks/reports/bench_history.jsonl)")
     args = ap.parse_args()
     report = run_benchmark(args.sf,
                            args.queries.split(",") if args.queries else None,
@@ -242,7 +280,8 @@ def main():
                            suite=args.suite,
                            concurrent_tasks=args.concurrent_tasks,
                            trace_dir=args.trace_dir,
-                           probe_timeout_s=args.probe_timeout)
+                           probe_timeout_s=args.probe_timeout,
+                           history_path=args.history)
     print(json.dumps(report, indent=2))
 
 

@@ -39,6 +39,12 @@ argument of a ``<x>.metrics.inc("...")`` call — is declared by the
 enclosing class's ``METRICS`` (base keys exempt). Keeps the metrics
 surface greppable and drift-free, like the contract rule.
 
+``telemetry-key``: every literal registry metric name in the package —
+the first argument of a ``<registry>.counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")`` call — is declared in
+``service/telemetry.py``'s ``TELEMETRY_KEYS`` tuple (the metric-key
+rule's analog for the process-lifetime scrape surface).
+
 The linter is pure AST + text: no engine import, no jax import.
 """
 
@@ -75,7 +81,8 @@ EXEC_BASE_CLASSES = {"TpuExec"}       # abstract root: no contract of its own
 # import the engine): keys every exec may emit without declaring —
 # GpuMetricNames basics plus the attributed cross-cutting keys
 BASE_METRIC_KEYS = {"numOutputRows", "numOutputBatches", "opTime",
-                    "hostSyncs", "recompiles", "spillBytes"}
+                    "hostSyncs", "recompiles", "spillBytes",
+                    "peakDeviceBytes"}
 
 PRAGMA_RE = re.compile(r"#\s*lint:\s*host-sync-ok(.*)$")
 
@@ -305,6 +312,83 @@ def _check_exec_metrics(cls: ast.ClassDef, path: str
 
 
 # ---------------------------------------------------------------------------
+# telemetry registry names (telemetry-key rule)
+# ---------------------------------------------------------------------------
+
+#: module declaring the registry name surface (relative to the package)
+TELEMETRY_MODULE = "service/telemetry.py"
+_TELEMETRY_CALLS = {"counter", "gauge", "histogram"}
+
+
+def telemetry_declared_keys(source: str):
+    """The string names in ``TELEMETRY_KEYS = (...)``, or None when the
+    module declares no such tuple."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):   # TELEMETRY_KEYS: Tuple = (...)
+            targets = [node.target]
+        else:
+            continue
+        if node.value is not None and any(
+                isinstance(t, ast.Name) and t.id == "TELEMETRY_KEYS"
+                for t in targets):
+            return {n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant) and
+                    isinstance(n.value, str)}
+    return None
+
+
+def telemetry_usages(source: str):
+    """(line, name) for every ``<x>.counter/gauge/histogram("...")``
+    literal registry-metric name in a module."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _TELEMETRY_CALLS and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.lineno, node.args[0].value))
+    return out
+
+
+def check_telemetry_keys(sources: Dict[str, Tuple[str, str]]
+                         ) -> List[LintViolation]:
+    """``telemetry-key``: every literal registry metric name used
+    anywhere in the package is declared in TELEMETRY_KEYS
+    (``sources``: rel -> (path, source) for every package module)."""
+    decl_entry = sources.get(TELEMETRY_MODULE)
+    if decl_entry is None:
+        return []                          # no telemetry subsystem yet
+    decl_path, decl_src = decl_entry
+    declared = telemetry_declared_keys(decl_src)
+    if declared is None:
+        return [LintViolation(
+            decl_path, 0, "telemetry-key",
+            "service/telemetry.py declares no TELEMETRY_KEYS tuple — the "
+            "registry name surface must be declared")]
+    out: List[LintViolation] = []
+    for rel, (path, src) in sorted(sources.items()):
+        for line, name in telemetry_usages(src):
+            if name not in declared:
+                out.append(LintViolation(
+                    path, line, "telemetry-key",
+                    f"registry metric name {name!r} is not declared in "
+                    "service/telemetry.TELEMETRY_KEYS — declare it so "
+                    "the scrape surface stays greppable"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # conf <-> docs agreement
 # ---------------------------------------------------------------------------
 
@@ -385,6 +469,7 @@ def run(package_dir: str, docs_dir: Optional[str] = None
     """Lint every .py under ``package_dir`` (the spark_rapids_tpu package)
     plus the conf/docs agreement check."""
     out: List[LintViolation] = []
+    sources: Dict[str, Tuple[str, str]] = {}
     for dirpath, dirnames, filenames in os.walk(package_dir):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in sorted(filenames):
@@ -394,7 +479,10 @@ def run(package_dir: str, docs_dir: Optional[str] = None
             rel = os.path.relpath(full, package_dir).replace(os.sep, "/")
             with open(full, "r") as f:
                 src = f.read()
+            sources[rel] = (full, src)
             out.extend(lint_source(src, rel, path=full))
+    # cross-module: registry metric names vs the TELEMETRY_KEYS surface
+    out.extend(check_telemetry_keys(sources))
     config_path = os.path.join(package_dir, "config.py")
     if docs_dir is None:
         docs_dir = os.path.join(os.path.dirname(package_dir), "docs")

@@ -193,6 +193,7 @@ def _note_acquired(name: str, held: List[list]) -> None:
         return
     stack_now = None
     raise_report = None
+    flight_report = None
     with _state._mu:
         for h in dict.fromkeys(held_names):        # de-dup, keep order
             edge = (h, name)
@@ -217,6 +218,7 @@ def _note_acquired(name: str, held: List[list]) -> None:
                             for a, b in zip(path, path[1:])},
                     }
                     _state.cycles.append(report)
+                    flight_report = report
                     if pair not in _state._reported:
                         _state._reported.add(pair)
                         if _mode == "enforce":
@@ -231,6 +233,16 @@ def _note_acquired(name: str, held: List[list]) -> None:
                                 next(iter(report["reverseStacks"].values()),
                                      ""))
             ent["count"] += 1
+    if flight_report is not None:
+        # flight-recorder incident, recorded OUTSIDE _state._mu: the
+        # recorder's first conf read acquires the (lockdep-instrumented)
+        # conf-registry lock, which would re-enter this module
+        try:
+            from ..service.telemetry import flight_record
+            flight_record("lock-cycle", flight_report["edge"],
+                          {"reverse": flight_report["reverse"]})
+        except Exception:
+            pass
     if raise_report is not None:
         rev = next(iter(raise_report["reverseStacks"].values()), "")
         raise LockOrderInversionError(

@@ -89,6 +89,10 @@ def note_compile(kernel: str, key: Any) -> None:
     # shows which plan node paid the compile (exec/metrics attribution)
     from ..exec.metrics import attribute
     attribute("recompiles")
+    # flight-recorder breadcrumb: a compile right before a crash is a
+    # prime post-mortem suspect (OOM during build, shape explosion)
+    from ..service.telemetry import flight_record
+    flight_record("recompile", kernel)
 
 
 def note_call(kernel: str) -> None:

@@ -330,9 +330,26 @@ class DataFrame:
             rc0 = recompile.snapshot()
             lk0 = lockdep.stats()
         t0 = time.perf_counter()
-        with SyncCounter() as sc, SpanRecorder() as spans:
-            out = exec_plan.execute_collect()
+        try:
+            with SyncCounter() as sc, SpanRecorder() as spans:
+                out = exec_plan.execute_collect()
+        except BaseException as e:
+            # post-mortem for failures OUTSIDE task bodies (planner-side
+            # execute, concat, exchange setup): dump the flight ring.
+            # dump_on_error never raises and dedups against the task-level
+            # hook, so the original exception propagates unmasked.
+            from ..service.telemetry import dump_on_error
+            dump_on_error(e)
+            raise
         self.session._last_execute_time_s = time.perf_counter() - t0
+        try:
+            from ..service.telemetry import MetricsRegistry
+            MetricsRegistry.get().histogram(
+                "tpu_query_execute_seconds",
+                "collect-action execute wall seconds").observe(
+                self.session._last_execute_time_s)
+        except Exception:
+            pass           # observability must never fail the query
         self.session._last_sync_report = sc.report()
         self.session._last_span_report = spans.report()
         # the recorder itself stays reachable so the bench runner / tests

@@ -47,6 +47,10 @@ class RuntimeConf:
 
     def set(self, key: str, value: Any) -> None:
         self._session.conf = self._session.conf.with_overrides({key: value})
+        # conf changes are flight-recorder events: a post-mortem on a
+        # dead run needs to know which knobs moved right before it died
+        from ..service.telemetry import flight_record
+        flight_record("conf", key, {"value": str(value)})
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._session.conf.get_key(key, default)
@@ -147,6 +151,10 @@ class TpuSession:
         # lockdep primes EAGERLY from THIS session's conf (a lazy read at
         # first acquire would recurse through the conf-registry lock)
         lockdep.refresh_mode(self.conf)
+        # telemetry primes EAGERLY too (flight-recorder gate/capacity/dir)
+        # and starts the scrape endpoint when telemetry.port is set
+        from ..service import telemetry
+        telemetry.refresh(self.conf)
 
     @classmethod
     def active(cls) -> "TpuSession":
@@ -233,6 +241,34 @@ class TpuSession:
         with TpuSession._lock:
             if TpuSession._active is self:
                 TpuSession._active = None
+
+    # -- process telemetry (service/telemetry: the continuous layer) --------
+    def metrics_snapshot(self, path: Optional[str] = None) -> dict:
+        """Point-in-time snapshot of the PROCESS metrics registry —
+        semaphore, lockdep, sync, recompile, spill, shuffle-transport and
+        HBM watermark metrics from one surface (the live-Spark-UI
+        metrics stream, pulled). With ``path``, one JSONL line is also
+        appended there (the scrape-less export)."""
+        from ..service.telemetry import MetricsRegistry
+        reg = MetricsRegistry.get()
+        snap = reg.snapshot()
+        if path:
+            # the line on disk IS the returned dict (one harvest)
+            reg.snapshot_jsonl(path, snap)
+        return snap
+
+    def prometheus_metrics(self) -> str:
+        """The registry in Prometheus text format (what the scrape
+        endpoint at ``spark.rapids.tpu.sql.telemetry.port`` serves)."""
+        from ..service.telemetry import MetricsRegistry
+        return MetricsRegistry.get().prometheus_text()
+
+    def dump_flight_record(self, path: Optional[str] = None) -> str:
+        """Write the always-on flight ring to a JSON artifact on demand
+        (the automatic dump fires when a task body or collect raises);
+        returns the artifact path."""
+        from ..service.telemetry import FlightRecorder
+        return FlightRecorder.get().dump(path, reason="on-demand")
 
     # -- testing hooks (ExecutionPlanCaptureCallback analog) ----------------
     def last_plan(self):

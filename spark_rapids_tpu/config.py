@@ -451,6 +451,32 @@ ANALYSIS_LOCKDEP = _conf("spark.rapids.tpu.sql.analysis.lockdep").doc(
         lambda v: str(v).lower() in ("off", "record", "enforce")
 ).create_with_default("off")
 
+TELEMETRY_PORT = _conf("spark.rapids.tpu.sql.telemetry.port").doc(
+    "Port for the background telemetry scrape endpoint serving /metrics "
+    "(Prometheus text) and /snapshot (JSON) from the process metrics "
+    "registry (service/telemetry.py; the live-Spark-UI metrics-stream "
+    "analog). 0 disables the endpoint"
+).integer_conf.create_with_default(0)
+
+TELEMETRY_FLIGHT_RECORDER = _conf(
+    "spark.rapids.tpu.sql.telemetry.flightRecorder").doc(
+    "Always-on flight recorder: a fixed-size ring of recent span ends, "
+    "sync/recompile/spill/lock incidents and conf changes, dumped to a "
+    "JSON artifact automatically when a task body or collect() raises "
+    "(service/telemetry.FlightRecorder; see docs/telemetry.md)"
+).boolean_conf.create_with_default(True)
+
+TELEMETRY_FLIGHT_DIR = _conf(
+    "spark.rapids.tpu.sql.telemetry.flightRecorderDir").doc(
+    "Directory for automatic flight-recorder dump artifacts (created on "
+    "demand; a failed dump never masks the query exception)"
+).string_conf.create_with_default("/tmp/spark_rapids_tpu_flight")
+
+TELEMETRY_FLIGHT_EVENTS = _conf(
+    "spark.rapids.tpu.sql.telemetry.flightRecorderEvents").doc(
+    "Capacity of the flight-recorder ring; the newest events win"
+).integer_conf.check(lambda v: int(v) >= 16).create_with_default(4096)
+
 
 class TpuConf:
     """Immutable-ish view over a key->value dict with typed accessors.

@@ -57,6 +57,13 @@ class DeviceManager:
             return cls._instance
 
     @classmethod
+    def peek(cls) -> Optional["DeviceManager"]:
+        """The existing instance or None — never constructs (the
+        telemetry harvest must not probe a device as a side effect)."""
+        with cls._lock:
+            return cls._instance
+
+    @classmethod
     def reset(cls) -> None:
         with cls._lock:
             cls._instance = None
@@ -109,6 +116,13 @@ class TpuSemaphore:
             if cls._instance is None:
                 cls._instance = TpuSemaphore(
                     cfg.TpuConf().get(cfg.CONCURRENT_TPU_TASKS))
+            return cls._instance
+
+    @classmethod
+    def peek(cls) -> Optional["TpuSemaphore"]:
+        """The existing instance or None — never constructs (telemetry
+        harvest: an idle process contributes no samples)."""
+        with cls._lock:
             return cls._instance
 
     @classmethod

@@ -46,7 +46,7 @@ from typing import Iterator, Optional, Tuple
 #: AST and cannot import this module.)
 BASE_METRICS: Tuple[str, ...] = (
     "numOutputRows", "numOutputBatches", "opTime",
-    "hostSyncs", "recompiles", "spillBytes",
+    "hostSyncs", "recompiles", "spillBytes", "peakDeviceBytes",
 )
 
 
@@ -182,6 +182,10 @@ class TpuMetrics(dict):
     # when sql.metrics.enabled is off
     LOAD_BEARING_KEYS = frozenset({"dataSize"})
 
+    # watermark-style keys are SET (max), not summed — publishing their
+    # growth into a cumulative registry counter would add peaks together
+    WATERMARK_KEYS = frozenset({"peakDeviceBytes"})
+
     def inc(self, key: str, amount: float = 1) -> None:
         # partitions drain on concurrent task threads; keep counters exact.
         if not metrics_enabled() and key not in TpuMetrics.LOAD_BEARING_KEYS:
@@ -197,6 +201,15 @@ class TpuMetrics(dict):
             return
         with TpuMetrics._lock:
             self[key] = dict.get(self, key, 0) + amount
+
+    def max(self, key: str, value: float) -> None:
+        """Raise ``key`` to at least ``value`` (watermark-style metrics:
+        the HBM peak attribution sets, never sums)."""
+        if not metrics_enabled():
+            return
+        with TpuMetrics._lock:
+            if value > dict.get(self, key, 0):
+                self[key] = value
 
     def resolve(self) -> "TpuMetrics":
         """Fold deferred device-scalar amounts into the counters in one
@@ -225,7 +238,40 @@ class TpuMetrics(dict):
                     if isinstance(v, float) and v.is_integer():
                         v = int(v)     # row/batch counters stay integral
                     self[key] = dict.get(self, key, 0) + v
+        self._publish()
         return self
+
+    def _publish(self) -> None:
+        """Fold this bag's growth since the last publish into the
+        process metrics registry (``tpu_exec_metric_total{key=...}``) —
+        the resolve-boundary publish of the continuous-telemetry layer.
+        Resolve runs at reporting boundaries, so the registry never sees
+        per-batch (let alone per-row) traffic."""
+        if not metrics_enabled():
+            return
+        with TpuMetrics._lock:
+            pub = getattr(self, "_published", None)
+            if pub is None:
+                pub = self._published = {}
+            deltas = []
+            for key in dict.keys(self):
+                if key in TpuMetrics.WATERMARK_KEYS:
+                    continue
+                d = dict.get(self, key, 0) - pub.get(key, 0)
+                if d > 0:
+                    deltas.append((key, d))
+                    pub[key] = pub.get(key, 0) + d
+        if not deltas:
+            return
+        try:
+            from ..service.telemetry import MetricsRegistry
+            reg = MetricsRegistry.get()
+            for key, d in deltas:
+                reg.counter("tpu_exec_metric_total",
+                            "per-exec metric totals folded in at bag "
+                            "resolve", key=key).inc(d)
+        except Exception:
+            pass               # telemetry must never fail a metrics read
 
     # readers see resolved counters (deferred amounts fold in lazily)
     def __getitem__(self, key):

@@ -198,6 +198,7 @@ class BounceBufferManager:
         mv = memoryview(self.arena)[off:off + size]
         self._offsets = getattr(self, "_offsets", {})
         self._offsets[id(mv)] = off
+        self._note_arena()
         return mv
 
     def release(self, mv: memoryview) -> None:
@@ -205,3 +206,11 @@ class BounceBufferManager:
         if off is not None:
             mv.release()
             self.allocator.free(off)
+            self._note_arena()
+
+    def _note_arena(self) -> None:
+        """Track the staging arena's current + peak occupancy on the
+        process watermark (service/telemetry): shuffle receive pressure
+        becomes scrapeable next to the HBM stores."""
+        from ..service.telemetry import watermark
+        watermark("native_arena").update(self.allocator.allocated_bytes)

@@ -125,6 +125,9 @@ class SpillableBuffer:
         # spillBytes on its EXPLAIN ANALYZE node
         from .metrics import attribute
         attribute("spillBytes", self.size_bytes)
+        from ..service.telemetry import flight_record
+        flight_record("spill", f"buffer-{self.id}",
+                      {"bytes": self.size_bytes, "to": "host"})
         return self.size_bytes
 
     def spill_to_disk(self, spill_dir: str) -> int:
@@ -159,6 +162,9 @@ class SpillableBuffer:
             except OSError:
                 pass
             return 0
+        from ..service.telemetry import flight_record
+        flight_record("spill", f"buffer-{self.id}",
+                      {"bytes": self.size_bytes, "to": "disk"})
         return self.size_bytes
 
     def _load_arrays(self) -> List[Any]:
@@ -270,12 +276,34 @@ class BufferCatalog:
             return cls._instance
 
     @classmethod
+    def peek(cls) -> Optional["BufferCatalog"]:
+        """The existing instance or None — never constructs (telemetry
+        harvest: reading residency must not bootstrap a catalog)."""
+        with cls._lock:
+            return cls._instance
+
+    @classmethod
     def reset(cls) -> None:
         with cls._lock:
             if cls._instance is not None:
                 for b in list(cls._instance.buffers.values()):
                     b.free()
             cls._instance = None
+
+    def buffer_count(self) -> int:
+        with self._mu:
+            return len(self.buffers)
+
+    def _note_residency(self) -> None:
+        """Update the process HBM/host watermarks after an accounting
+        change (service/telemetry): current + peak bytes with
+        per-operator peak attribution through the open exec scope.
+        Called at admission/registration/free boundaries — never per
+        row, never per element."""
+        from ..service import telemetry
+        telemetry.watermark("device", bag_key="peakDeviceBytes").update(
+            self.device_bytes)
+        telemetry.watermark("host").update(self.host_bytes)
 
     # -- registration --------------------------------------------------------
     def register_batch(self, batch: ColumnarBatch,
@@ -298,6 +326,7 @@ class BufferCatalog:
             self.buffers[buf.id] = buf
             self.device_bytes += buf.size_bytes
             self._maybe_spill_locked()
+            self._note_residency()
         return buf.id
 
     def acquire_batch(self, buffer_id: int) -> ColumnarBatch:
@@ -318,6 +347,7 @@ class BufferCatalog:
                 if prev_tier == StorageTier.HOST:
                     self.host_bytes -= buf.size_bytes
                 self.device_bytes += buf.size_bytes
+                self._note_residency()
         # device-tier rebuild happens OUTSIDE the catalog lock so concurrent
         # task threads on the (common) unspilled path never serialize here
         return buf.get_batch()
@@ -332,6 +362,7 @@ class BufferCatalog:
             elif buf.tier == StorageTier.HOST:
                 self.host_bytes -= buf.size_bytes
             buf.free()
+            self._note_residency()
 
     # -- spill logic ---------------------------------------------------------
     def reserve(self, nbytes: int) -> None:
@@ -342,6 +373,7 @@ class BufferCatalog:
             target = self.device_budget - nbytes
             if self.device_bytes > target:
                 self._spill_device_to_locked(max(target, 0))
+            self._note_residency()
 
     def _maybe_spill_locked(self) -> None:
         if self.device_bytes > self.device_budget:
@@ -364,6 +396,7 @@ class BufferCatalog:
                 self.device_bytes -= moved
                 self.host_bytes += moved
                 self.spilled_device_bytes += moved
+        self._note_residency()     # host tier may have just peaked
         if self.host_bytes > self.host_budget:
             self._spill_host_to_locked(self.host_budget)
 

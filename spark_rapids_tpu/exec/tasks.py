@@ -102,6 +102,14 @@ def run_partition_tasks(parts: Sequence[Any],
             from ..analysis.sync_audit import audited_region
             with audited_region():
                 return fn(pid, part)
+        except BaseException as e:
+            # post-mortem: dump the always-on flight ring for a dying
+            # task body. dump_on_error never raises and marks the
+            # exception, so the collect-level hook will not dump twice
+            # and the original error propagates unmasked.
+            from ..service.telemetry import dump_on_error
+            dump_on_error(e)
+            raise
         finally:
             _release_semaphore()
 

@@ -24,7 +24,7 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 from ..analysis.lockdep import named_lock
-from .metrics import exec_scope
+from .metrics import exec_scope, metrics_enabled
 
 _enabled: Optional[bool] = None
 _timeline: Optional[bool] = None
@@ -60,30 +60,42 @@ def reset_cache() -> None:
     _timeline = None
 
 
+def _telemetry_span(name: str, begin: float, elapsed: float,
+                    err: bool) -> None:
+    """Feed the process-lifetime telemetry at span close (a flush
+    boundary): the always-on flight ring gets the span (error-marked
+    when it unwound on an exception — the post-mortem breadcrumb), and
+    the registry span histogram gets its duration."""
+    from ..service import telemetry as tel
+    try:
+        if tel._flight_on():
+            data = {"beginS": round(begin, 6), "durS": round(elapsed, 6)}
+            if err:
+                data["error"] = True
+            tel.FlightRecorder.get().record("span", name, data)
+        if metrics_enabled():
+            tel.MetricsRegistry.get().histogram(
+                "tpu_span_seconds", "trace span durations",
+                name=name).observe(elapsed)
+    except Exception:
+        pass                   # telemetry must never fail the span
+
+
 @contextmanager
 def trace_span(name: str, metrics=None, metric_key: Optional[str] = None):
     """Named profiler span (NvtxWithMetrics: optionally also feeds a
     metrics timer). Always feeds the active :class:`SpanRecorder` (the
-    per-query wall-clock breakdown); the jax profiler annotation is
-    config-gated. When ``metrics`` is an exec's bag, the span also marks
-    that exec as the innermost open one on this thread
-    (``exec/metrics.exec_scope``) so attributed events — host syncs,
-    recompiles, spill bytes — land on its operator node."""
-    rec = SpanRecorder.active
-    if rec is None and not _tracing_on():
-        if metrics is not None:
-            with exec_scope(metrics):
-                if metric_key:
-                    with metrics.timer(metric_key):
-                        yield
-                else:
-                    yield
-        else:
-            yield
-        return
+    per-query wall-clock breakdown) and the ALWAYS-ON flight recorder
+    (``service/telemetry``: post-mortems without tracing pre-enabled);
+    the jax profiler annotation is config-gated. When ``metrics`` is an
+    exec's bag, the span also marks that exec as the innermost open one
+    on this thread (``exec/metrics.exec_scope``) so attributed events —
+    host syncs, recompiles, spill bytes — land on its operator node."""
     import time
+    rec = SpanRecorder.active
     t0 = time.perf_counter()
     frame = rec._push(name) if rec is not None else None
+    err = False
     try:
         with exec_scope(metrics):
             if _tracing_on():
@@ -92,12 +104,16 @@ def trace_span(name: str, metrics=None, metric_key: Optional[str] = None):
                     yield
             else:
                 yield
+    except BaseException:
+        err = True
+        raise
     finally:
         elapsed = time.perf_counter() - t0
         if rec is not None:
             rec._pop(frame, name, elapsed, begin=t0)
         if metrics is not None and metric_key:
             metrics.inc(metric_key, elapsed)
+        _telemetry_span(name, t0, elapsed, err)
 
 
 class SpanRecorder:
@@ -267,8 +283,13 @@ class SpanRecorder:
 
     def dump_chrome_trace(self, path: str) -> str:
         """Write :meth:`chrome_trace` to ``path`` (the per-query
-        ``trace.json`` the bench runner emits); returns the path."""
+        ``trace.json`` the bench runner emits); returns the path.
+        Parent directories are created defensively — a --trace-dir
+        naming a not-yet-existing nested path must not fail the dump."""
         import json
+        import os
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
             json.dump(self.chrome_trace(), f)
         return path
@@ -315,6 +336,9 @@ class SyncCounter:
     that must restore the pristine property."""
 
     _tls = None                    # lazy threading.local
+    #: process-lifetime total of counted syncs (telemetry registry gauge
+    #: ``tpu_host_syncs_total``); best-effort like the per-counter maps
+    process_total: int = 0
     _default_stack: List["SyncCounter"] = []
     # guards _default_stack: counters enter on the driving thread but
     # exits can interleave across threads (generator-suspended queries,
@@ -375,6 +399,7 @@ class SyncCounter:
     def _record(self):
         import traceback
         self.total += 1  # lint: unguarded-ok best-effort counter: concurrent increments may undercount, the attributed counts are advisory diagnostics
+        SyncCounter.process_total += 1  # lint: unguarded-ok same best-effort counter discipline, harvested as a telemetry gauge
         site = "<unknown>"
         for frame in reversed(traceback.extract_stack(limit=24)):
             fn = frame.filename
@@ -383,6 +408,10 @@ class SyncCounter:
                 site = f"{short}:{frame.lineno}"
                 break
         self.sites[site] = self.sites.get(site, 0) + 1  # lint: unguarded-ok best-effort counter map, see total above
+        # flight-recorder breadcrumb: which code path paid a round trip
+        # right before a crash (the post-mortem question)
+        from ..service.telemetry import flight_record
+        flight_record("sync", site)
         # attribute to the innermost open span on this thread (the
         # analysis/sync_audit per-span breakdown): which named region of
         # the execute wall is paying link round trips

@@ -109,6 +109,10 @@ class TpuExec:
     def __init__(self, *children: "TpuExec"):
         self.children = list(children)
         self.metrics = Metrics()
+        # the owning operator's name rides the bag so cross-cutting
+        # attribution (HBM watermark peaks, service/telemetry) can name
+        # the exec that was innermost-open, not just charge its bag
+        self.metrics.owner = type(self).__name__
 
     @property
     def schema(self) -> dt.Schema:
@@ -2135,7 +2139,7 @@ class TpuMapInPandasExec(TpuExec):
     to a steady size first (RebatchingRoundoffIterator analog)."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="preserve")
-    METRICS = exec_metrics()
+    METRICS = exec_metrics("udfTime")
 
     def __init__(self, child: TpuExec, plan: "lp.MapInPandas",
                  target_rows: int = 1 << 16):
@@ -2157,7 +2161,20 @@ class TpuMapInPandasExec(TpuExec):
             for b in rebatch_iterator(part, self.target_rows):
                 yield b.to_pandas()
 
-        for out_df in self.plan.fn(frames()):
+        # the user fn runs lazily inside next(): metering each pull (like
+        # the sibling pandas execs' pandas_udf span) times fn execution
+        # only — not downstream device consumption — and an exception in
+        # the fn unwinds through the span, error-marking it in the
+        # flight ring for the post-mortem artifact. The construction is
+        # metered too: a non-generator fn runs (and can fail) right here
+        with trace_span("pandas_udf", self.metrics, "udfTime"):
+            it = iter(self.plan.fn(frames()))
+        end = object()       # a fn yielding None must fail loudly below,
+        while True:          # not silently truncate the stream
+            with trace_span("pandas_udf", self.metrics, "udfTime"):
+                out_df = next(it, end)
+            if out_df is end:
+                break
             n = len(out_df)
             if n == 0:
                 continue

@@ -1,0 +1,12 @@
+"""Long-lived service layer: process-lifetime telemetry today, the
+multi-tenant query server tomorrow (ROADMAP open item 5).
+
+The reference plugin lives inside a long-running Spark executor whose
+metrics stream continuously into the driver UI/listener bus
+(GpuMetricNames -> SQLMetrics, SURVEY.md §2.7-§2.8). Standalone there is
+no executor process wrapping us, so this package holds the
+process-lifetime substrate instead: :mod:`.telemetry` (metrics registry,
+HBM watermarks, flight recorder, scrape endpoint).
+"""
+
+from . import telemetry  # noqa: F401

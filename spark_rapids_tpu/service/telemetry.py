@@ -1,0 +1,878 @@
+"""Process-lifetime telemetry: metrics registry, HBM watermarks, flight
+recorder, scrape endpoint.
+
+PR 6 gave every query a snapshot (per-operator metrics, EXPLAIN ANALYZE,
+timelines); the reference plugin's observability is *continuous* —
+GpuMetricNames metrics stream into the live Spark UI/listener bus for
+the lifetime of the executor (SURVEY.md §2.7-§2.8), and shuffle/memory
+state is inspectable while queries run. This module is that substrate,
+four pillars:
+
+* :class:`MetricsRegistry` — named counters/gauges/histograms with
+  labels. Cross-cutting instruments publish in at **resolve/flush
+  boundaries, never per row**: per-exec ``TpuMetrics`` bags fold their
+  deltas in on ``resolve``, span durations land at span end, and
+  everything pull-shaped (semaphore wait/hold, lockdep per-lock stats,
+  sync/recompile totals, spill residency, shuffle transport totals,
+  watermarks) is harvested by a collector only when someone actually
+  reads the registry (``collect``/scrape). Exported as Prometheus text
+  (:meth:`MetricsRegistry.prometheus_text`), JSONL snapshots
+  (``session.metrics_snapshot()``), and an opt-in background HTTP
+  scrape endpoint (conf ``spark.rapids.tpu.sql.telemetry.port``, off by
+  default).
+* :func:`watermark` accounting — DeviceManager budget, the buffer
+  catalog's device/host residency and the native bounce arena track
+  current + peak bytes; a new peak records the innermost open exec
+  (``exec/metrics.exec_scope``) and charges ``peakDeviceBytes`` onto its
+  bag, so "which operator drove peak HBM" is answerable per query
+  (EXPLAIN ANALYZE) and per process (the registry gauge).
+* :class:`FlightRecorder` — an always-on, fixed-size, lock-light ring of
+  recent span begin/ends, sync/recompile/spill/lock incidents, and conf
+  changes, dumped to a JSON artifact automatically when a task body or
+  ``collect()`` raises (and on demand via
+  ``session.dump_flight_record()``) — post-mortems on a dead multichip
+  run no longer depend on having enabled tracing in advance.
+* the scrape endpoint — :class:`TelemetryServer`, a daemon-thread HTTP
+  server answering ``/metrics`` (Prometheus text) and ``/snapshot``
+  (JSON), started by session bootstrap when the port conf is set.
+
+Every registry metric name is a literal declared in
+:data:`TELEMETRY_KEYS`; the project linter (rule ``telemetry-key``)
+enforces the declaration, keeping the scrape surface greppable exactly
+like the per-exec ``METRICS`` surface.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis.lockdep import named_lock
+
+log = logging.getLogger("spark_rapids_tpu.telemetry")
+
+# ---------------------------------------------------------------------------
+# Declared metric names (the lint-enforced scrape surface)
+# ---------------------------------------------------------------------------
+
+#: Every metric name the registry may carry. The ``telemetry-key`` lint
+#: rule checks each ``registry.counter/gauge/histogram("...")`` literal
+#: in the package against this tuple — an undeclared name fails tier-1,
+#: so the scrape surface cannot drift silently.
+TELEMETRY_KEYS: Tuple[str, ...] = (
+    # pushed at resolve/flush boundaries
+    "tpu_exec_metric_total",            # label key=<TpuMetrics key>
+    "tpu_span_seconds",                 # histogram, label name=<span>
+    "tpu_query_execute_seconds",        # histogram, per collect
+    "tpu_preflight_probe_seconds",
+    "tpu_preflight_backend_info",       # label backend=..., value 1
+    "tpu_flight_dumps_total",
+    # harvested at collect/scrape time
+    "tpu_semaphore_wait_seconds_total",
+    "tpu_semaphore_hold_seconds_total",
+    "tpu_semaphore_acquires_total",
+    "tpu_semaphore_permits",
+    "tpu_lock_wait_seconds_total",      # label lock=<lockdep name>
+    "tpu_lock_hold_seconds_total",
+    "tpu_lock_acquires_total",
+    "tpu_lockdep_cycles_total",
+    "tpu_host_syncs_total",
+    "tpu_recompiles_total",
+    "tpu_fused_calls_total",
+    "tpu_spill_device_bytes",
+    "tpu_spill_host_bytes",
+    "tpu_spilled_device_bytes_total",
+    "tpu_spilled_host_bytes_total",
+    "tpu_spill_buffers",
+    "tpu_shuffle_bytes_fetched_total",
+    "tpu_shuffle_chunks_total",
+    "tpu_shuffle_retries_total",
+    "tpu_shuffle_bounce_misses_total",
+    "tpu_hbm_bytes",                    # label store=device|host|...
+    "tpu_hbm_peak_bytes",
+    "tpu_hbm_peak_operator_info",       # labels store=..., operator=...
+    "tpu_device_budget_bytes",
+    "tpu_device_count",
+    "tpu_backend_info",                 # label platform=..., value 1
+    "tpu_flight_events_total",
+)
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+                    float("inf"))
+
+
+# ---------------------------------------------------------------------------
+# Metric families and handles
+# ---------------------------------------------------------------------------
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    """One metric family: a kind, a help string, and samples per label
+    set (the Prometheus data model reduced to what the engine needs)."""
+
+    def __init__(self, kind: str, name: str, help_text: str,
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.kind = kind
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(buckets or _DEFAULT_BUCKETS) \
+            if kind == "histogram" else None
+        # label key -> float value, or [counts per bucket, sum, count]
+        self.samples: Dict[Tuple, Any] = {}
+
+    def _blank(self):
+        if self.kind == "histogram":
+            return [[0] * len(self.buckets), 0.0, 0]
+        return 0.0
+
+
+class _Handle:
+    """A (family, label set) pair: the object call sites hold."""
+
+    def __init__(self, reg: "MetricsRegistry", family: _Family, key: Tuple):
+        self._reg = reg
+        self._family = family
+        self._key = key
+
+    def _sample(self):
+        return self._family.samples[self._key]
+
+
+class _Counter(_Handle):
+    def inc(self, amount: float = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self._family.name} cannot "
+                             f"decrease (inc {amount})")
+        with self._reg._values_mu:
+            self._family.samples[self._key] += amount
+
+    @property
+    def value(self) -> float:
+        with self._reg._values_mu:
+            return self._family.samples[self._key]
+
+
+class _Gauge(_Handle):
+    def set(self, value: float) -> None:
+        with self._reg._values_mu:
+            self._family.samples[self._key] = float(value)
+
+    def inc(self, amount: float = 1) -> None:
+        with self._reg._values_mu:
+            self._family.samples[self._key] += amount
+
+    @property
+    def value(self) -> float:
+        with self._reg._values_mu:
+            return self._family.samples[self._key]
+
+
+class _Histogram(_Handle):
+    def observe(self, value: float) -> None:
+        value = float(value)
+        buckets = self._family.buckets
+        with self._reg._values_mu:
+            counts, total, n = self._family.samples[self._key]
+            for i, le in enumerate(buckets):
+                if value <= le:
+                    counts[i] += 1
+                    break
+            self._family.samples[self._key] = [counts, total + value, n + 1]
+
+    @property
+    def count(self) -> int:
+        with self._reg._values_mu:
+            return self._family.samples[self._key][2]
+
+    @property
+    def sum(self) -> float:
+        with self._reg._values_mu:
+            return self._family.samples[self._key][1]
+
+
+# ---------------------------------------------------------------------------
+# The registry
+# ---------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Process-singleton metric registry (the SQLMetrics/Dropwizard layer
+    of the reference executor, reduced to one process)."""
+
+    _instance: Optional["MetricsRegistry"] = None
+    _lock = named_lock("service.telemetry.MetricsRegistry._lock")
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+        # a RAW leaf lock on purpose (the TpuMetrics._lock rationale):
+        # histogram observes land at span end on every task thread, and
+        # a lockdep NamedLock would take the process-global lockdep
+        # state mutex per publish under record mode. Never nests.
+        self._values_mu = threading.Lock()
+        self._collectors: List[Callable] = [_harvest]
+
+    @classmethod
+    def get(cls) -> "MetricsRegistry":
+        # lock-free fast path: get() runs at every span close on every
+        # task thread, and the NamedLock below would take the process-
+        # global lockdep state mutex per event under record mode (the
+        # TpuMetrics._lock rationale). The double-checked read is safe:
+        # _instance only ever goes None -> instance
+        inst = cls._instance
+        if inst is not None:
+            return inst
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = MetricsRegistry()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the singleton (tests)."""
+        with cls._lock:
+            cls._instance = None
+
+    # -- handle creation -----------------------------------------------------
+    def _handle(self, kind: str, klass, name: str, help_text: str,
+                buckets, labels: Dict[str, str]):
+        key = _label_key(labels)
+        with self._values_mu:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(kind, name, help_text,
+                                                    buckets)
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name} already registered as {fam.kind}, "
+                    f"requested {kind}")
+            if key not in fam.samples:
+                fam.samples[key] = fam._blank()
+            if help_text and not fam.help:
+                fam.help = help_text
+        return klass(self, fam, key)
+
+    # positional-only (/) so label names like ``name=`` cannot collide
+    # with the declaration parameters
+    def counter(self, name: str, help_text: str = "", /,
+                **labels: str) -> _Counter:
+        return self._handle("counter", _Counter, name, help_text, None,
+                            labels)
+
+    def gauge(self, name: str, help_text: str = "", /,
+              **labels: str) -> _Gauge:
+        return self._handle("gauge", _Gauge, name, help_text, None, labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Optional[Tuple[float, ...]] = None, /,
+                  **labels: str) -> _Histogram:
+        return self._handle("histogram", _Histogram, name, help_text,
+                            buckets, labels)
+
+    def register_collector(self, fn: Callable) -> None:
+        """``fn(registry)`` runs before every collect/scrape — the pull
+        side of the registry (subsystems harvested only when read)."""
+        if fn not in self._collectors:
+            self._collectors.append(fn)
+
+    # -- export --------------------------------------------------------------
+    def collect(self) -> Dict[str, Dict]:
+        """Harvest collectors, then snapshot every family:
+        ``{name: {kind, help, samples: [{labels, value}...]}}``
+        (histograms carry buckets/counts/sum/count)."""
+        for fn in list(self._collectors):
+            try:
+                fn(self)
+            except Exception:
+                # a broken subsystem must never take the scrape down
+                log.exception("telemetry collector %r failed", fn)
+        out: Dict[str, Dict] = {}
+        with self._values_mu:
+            for name, fam in sorted(self._families.items()):
+                samples = []
+                for key, val in sorted(fam.samples.items()):
+                    labels = dict(key)
+                    if fam.kind == "histogram":
+                        counts, total, n = val
+                        samples.append({
+                            "labels": labels,
+                            "buckets": list(fam.buckets),
+                            "counts": list(counts),
+                            "sum": total, "count": n})
+                    else:
+                        samples.append({"labels": labels, "value": val})
+                out[name] = {"kind": fam.kind, "help": fam.help,
+                             "samples": samples}
+        return out
+
+    def prometheus_text(self) -> str:
+        """The registry in Prometheus text exposition format (what the
+        scrape endpoint serves at ``/metrics``)."""
+        lines: List[str] = []
+        for name, fam in self.collect().items():
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['kind']}")
+            for s in fam["samples"]:
+                if fam["kind"] == "histogram":
+                    cum = 0
+                    for le, c in zip(s["buckets"], s["counts"]):
+                        cum += c
+                        le_s = "+Inf" if le == float("inf") else repr(le)
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_labels({**s['labels'], 'le': le_s})}"
+                            f" {cum}")
+                    lines.append(f"{name}_sum{_fmt_labels(s['labels'])} "
+                                 f"{_fmt_value(s['sum'])}")
+                    lines.append(f"{name}_count{_fmt_labels(s['labels'])} "
+                                 f"{s['count']}")
+                else:
+                    lines.append(f"{name}{_fmt_labels(s['labels'])} "
+                                 f"{_fmt_value(s['value'])}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict:
+        """JSON-able point-in-time snapshot (``session.metrics_snapshot``;
+        one line of this is the JSONL export)."""
+        return {"atS": round(time.time(), 3), "metrics": self.collect()}
+
+    def snapshot_jsonl(self, path: str,
+                       snap: Optional[Dict] = None) -> Dict:
+        """Append one JSONL snapshot line to ``path`` (parent dirs
+        created defensively); returns the snapshot written — pass
+        ``snap`` to write an already-taken snapshot instead of
+        harvesting again."""
+        snap = snap if snap is not None else self.snapshot()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(snap) + "\n")
+        return snap
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _escape(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict, float]]]:
+    """Parse Prometheus text exposition back into
+    ``{sample_name: [(labels, value)...]}`` — the round-trip half the
+    tests use to prove the endpoint emits what a scraper reads."""
+    import re
+    out: Dict[str, List[Tuple[Dict, float]]] = {}
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if not m:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, raw_labels, raw_val = m.groups()
+        # single-pass unescape: chained str.replace would corrupt values
+        # containing literal backslash-n sequences (r'\\n' -> newline)
+        unesc = {r"\\": "\\", r'\"': '"', r"\n": "\n"}
+        labels = {k: re.sub(r'\\(?:\\|"|n)', lambda m2: unesc[m2.group(0)],
+                            v)
+                  for k, v in label_re.findall(raw_labels or "")}
+        out.setdefault(name, []).append((labels, float(raw_val)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HBM / memory watermarks
+# ---------------------------------------------------------------------------
+
+class Watermark:
+    """Current + peak bytes for one store, with per-operator peak
+    attribution: a new peak records the innermost open exec
+    (``exec/metrics.exec_scope``) and, when ``bag_key`` is set, charges
+    the peak onto that exec's metrics bag — so EXPLAIN ANALYZE answers
+    "which operator drove peak HBM" per query while the registry gauge
+    answers it per process."""
+
+    def __init__(self, name: str, bag_key: Optional[str] = None):
+        self.name = name
+        self.bag_key = bag_key
+        self.current = 0
+        self.peak = 0
+        self.peak_operator: Optional[str] = None
+        # raw leaf lock: updates run under the spill catalog's admission
+        # lock on task threads (the TpuMetrics._lock rationale); the
+        # critical section is two assignments and never nests
+        self._mu = threading.Lock()
+
+    def update(self, current: int) -> None:
+        current = int(current)
+        with self._mu:
+            self.current = current
+            new_peak = current > self.peak
+            if new_peak:
+                self.peak = current
+        if new_peak:
+            from ..exec import metrics as em
+            bag = em.current()
+            operator = getattr(bag, "owner", None) if bag is not None \
+                else None
+            with self._mu:
+                # only if OUR peak is still the record: a concurrent
+                # larger update must not have its attribution overwritten
+                # by this (smaller, slower) one
+                if self.peak == current and operator:
+                    self.peak_operator = operator
+            if bag is not None and self.bag_key:
+                bag.max(self.bag_key, current)
+
+    def reset(self) -> None:
+        with self._mu:
+            self.current = 0
+            self.peak = 0
+            self.peak_operator = None
+
+
+_watermarks: Dict[str, Watermark] = {}
+_watermarks_mu = named_lock("service.telemetry._watermarks_mu")
+
+
+def watermark(name: str, bag_key: Optional[str] = None) -> Watermark:
+    """The process watermark for ``name`` (created on first use).
+    ``bag_key`` (first creation only) names the exec-bag metric the peak
+    attribution charges — the device store uses ``peakDeviceBytes``."""
+    with _watermarks_mu:
+        wm = _watermarks.get(name)
+        if wm is None:
+            wm = _watermarks[name] = Watermark(name, bag_key)
+        return wm
+
+
+def watermarks() -> Dict[str, Watermark]:
+    with _watermarks_mu:
+        return dict(_watermarks)
+
+
+def reset_watermarks() -> None:
+    with _watermarks_mu:
+        _watermarks.clear()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+_flight_enabled: Optional[bool] = None
+_flight_capacity = 4096
+_flight_dir = "/tmp/spark_rapids_tpu_flight"
+_dump_seq = itertools.count(1)
+
+
+def _flight_on() -> bool:
+    global _flight_enabled
+    if _flight_enabled is None:
+        try:
+            from .. import config as cfg
+            _flight_enabled = bool(
+                cfg.TpuConf().get(cfg.TELEMETRY_FLIGHT_RECORDER))
+        except Exception:
+            _flight_enabled = True
+    return _flight_enabled
+
+
+class FlightRecorder:
+    """Always-on fixed-size ring of recent engine events.
+
+    Events are ``(tS, thread, kind, name, data)`` tuples; ``record`` is
+    lock-light (a raw leaf lock around one index bump + slot write) so
+    it can sit on the span-close path of every operator without showing
+    up in the bench. The ring never grows: the newest
+    ``capacity`` events win, which is exactly what a post-mortem wants."""
+
+    _instance: Optional["FlightRecorder"] = None
+    _lock = named_lock("service.telemetry.FlightRecorder._lock")
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(16, int(capacity))
+        self._ring: List = [None] * self.capacity
+        self._n = 0
+        # raw leaf lock, hot path (every span close): see Watermark._mu
+        self._mu = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "FlightRecorder":
+        # lock-free fast path (the MetricsRegistry.get rationale): the
+        # flight funnel runs at every span close. Capacity changes only
+        # at session bootstrap; the slow path handles them
+        inst = cls._instance
+        want = max(16, _flight_capacity)
+        if inst is not None and inst.capacity == want:
+            return inst
+        with cls._lock:
+            if cls._instance is None or cls._instance.capacity != want:
+                cls._instance = FlightRecorder(_flight_capacity)
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def record(self, kind: str, name: str,
+               data: Optional[Dict] = None) -> None:
+        ev = (round(time.time(), 6), threading.current_thread().name,
+              kind, name, data)
+        with self._mu:
+            self._ring[self._n % self.capacity] = ev
+            self._n += 1
+
+    def events(self) -> List[Dict]:
+        """The retained events, oldest first, as JSON-able dicts."""
+        with self._mu:
+            n = self._n
+            if n <= self.capacity:
+                raw = self._ring[:n]
+            else:
+                cut = n % self.capacity
+                raw = self._ring[cut:] + self._ring[:cut]
+        return [{"tS": t, "thread": th, "kind": k, "name": nm,
+                 **({"data": d} if d else {})}
+                for (t, th, k, nm, d) in raw]
+
+    def event_count(self) -> int:
+        with self._mu:
+            return self._n
+
+    def dump(self, path: Optional[str] = None,
+             reason: Optional[str] = None) -> str:
+        """Write the ring to a JSON artifact and return its path. Parent
+        directories are created defensively; IO errors raise here — the
+        *automatic* dump path (:func:`dump_on_error`) wraps this so a
+        failed telemetry write can never mask a query exception."""
+        if path is None:
+            path = os.path.join(
+                _flight_dir,
+                f"flight-{os.getpid()}-{next(_dump_seq)}.json")
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        doc = {"dumpedAtS": round(time.time(), 3), "pid": os.getpid(),
+               "reason": reason, "totalEvents": self.event_count(),
+               "events": self.events()}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        try:
+            MetricsRegistry.get().counter(
+                "tpu_flight_dumps_total",
+                "flight-recorder artifacts written").inc()
+        except Exception:
+            pass
+        return path
+
+
+_flight_tls = threading.local()
+
+
+def flight_record(kind: str, name: str, data: Optional[Dict] = None) -> None:
+    """Record one event into the process flight ring (no-op when the
+    recorder conf is off). The funnel every instrument calls. Re-entry
+    on the same thread is dropped: lockdep's cycle incident can fire
+    *inside* the acquisition of this module's own singleton lock, and
+    recursing there would deadlock on the non-reentrant raw lock."""
+    if getattr(_flight_tls, "busy", False) or not _flight_on():
+        return
+    _flight_tls.busy = True
+    try:
+        FlightRecorder.get().record(kind, name, data)
+    finally:
+        _flight_tls.busy = False
+
+
+def dump_on_error(exc: BaseException) -> Optional[str]:
+    """Automatic post-mortem dump for a failing task body / collect.
+    Never raises, never dumps the same exception twice (the task-level
+    and collect-level hooks both see it); returns the artifact path."""
+    if not _flight_on():
+        return None
+    try:
+        existing = getattr(exc, "_tpu_flight_dump", None)
+        if existing is not None:
+            return existing
+        path = FlightRecorder.get().dump(
+            reason=f"{type(exc).__name__}: {exc}")
+        try:
+            exc._tpu_flight_dump = path
+        except Exception:
+            pass           # exceptions with __slots__: dedup is best-effort
+        log.warning("flight record dumped to %s", path)
+        return path
+    except Exception:
+        # the original query exception is in flight — a failed telemetry
+        # write must never replace it
+        log.exception("flight-record dump failed (original error "
+                      "propagates unmasked)")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Conf priming (session bootstrap calls refresh, like lockdep/metrics)
+# ---------------------------------------------------------------------------
+
+def refresh(conf=None) -> None:
+    """Prime the flight-recorder gate/capacity/dir from a session conf
+    (eager, the lockdep pattern: lazy conf reads on hot paths recurse
+    into the conf-registry lock) and start the scrape endpoint when the
+    port conf is set."""
+    global _flight_enabled, _flight_capacity, _flight_dir
+    try:
+        from .. import config as cfg
+        conf = conf or cfg.TpuConf()
+        _flight_enabled = bool(conf.get(cfg.TELEMETRY_FLIGHT_RECORDER))
+        _flight_capacity = int(conf.get(cfg.TELEMETRY_FLIGHT_EVENTS))
+        _flight_dir = str(conf.get(cfg.TELEMETRY_FLIGHT_DIR))
+        port = int(conf.get(cfg.TELEMETRY_PORT))
+    except Exception:
+        _flight_enabled = True
+        return
+    if port > 0:
+        try:
+            start_server(port)
+        except Exception:
+            # a taken port must not fail session construction
+            log.exception("telemetry scrape endpoint failed to start on "
+                          "port %d", port)
+
+
+def reset_cache() -> None:
+    global _flight_enabled
+    _flight_enabled = None
+
+
+# ---------------------------------------------------------------------------
+# Harvest: the pull side of the registry
+# ---------------------------------------------------------------------------
+
+def _harvest(reg: MetricsRegistry) -> None:
+    """Read every pull-shaped subsystem into registry gauges. Runs only
+    at collect/scrape time — the subsystems pay nothing until someone
+    looks. Peeks never *create* singletons: an idle subsystem simply
+    contributes no samples."""
+    # semaphore admission (exec/device.TpuSemaphore)
+    from ..exec.device import DeviceManager, TpuSemaphore
+    sem = TpuSemaphore.peek()
+    if sem is not None:
+        st = sem.stats()
+        reg.gauge("tpu_semaphore_wait_seconds_total",
+                  "cumulative task wait for a device permit").set(st["waitS"])
+        reg.gauge("tpu_semaphore_hold_seconds_total",
+                  "cumulative device occupancy").set(st["holdS"])
+        reg.gauge("tpu_semaphore_acquires_total").set(st["acquires"])
+        reg.gauge("tpu_semaphore_permits").set(sem.max_concurrent)
+    dm = DeviceManager.peek()
+    if dm is not None:
+        reg.gauge("tpu_device_budget_bytes",
+                  "allocFraction * device memory").set(
+            dm.memory_budget_bytes)
+        reg.gauge("tpu_device_count").set(len(dm.devices))
+        reg.gauge("tpu_backend_info", "constant 1, platform label",
+                  platform=dm.platform).set(1)
+
+    # lockdep per-lock wait/hold (analysis/lockdep)
+    from ..analysis import lockdep, recompile
+    for name, st in lockdep.stats().items():
+        reg.gauge("tpu_lock_wait_seconds_total", lock=name).set(st["waitS"])
+        reg.gauge("tpu_lock_hold_seconds_total", lock=name).set(st["holdS"])
+        reg.gauge("tpu_lock_acquires_total", lock=name).set(st["acquires"])
+    reg.gauge("tpu_lockdep_cycles_total",
+              "lock-order inversion cycles observed").set(
+        len(lockdep.report()["cycles"]))
+
+    # host syncs (exec/tracing.SyncCounter process total)
+    from ..exec.tracing import SyncCounter
+    reg.gauge("tpu_host_syncs_total",
+              "blocking device->host readbacks (counted while any query "
+              "sync counter is active)").set(SyncCounter.process_total)
+
+    # recompile audit totals
+    rc = recompile.report()
+    reg.gauge("tpu_recompiles_total",
+              "fused-program cache-miss builds").set(
+        sum(v["compiles"] for v in rc.values()))
+    reg.gauge("tpu_fused_calls_total").set(
+        sum(v["calls"] for v in rc.values()))
+
+    # spill store residency + cumulative spill volume
+    from ..exec.spill import BufferCatalog
+    cat = BufferCatalog.peek()
+    if cat is not None:
+        reg.gauge("tpu_spill_device_bytes",
+                  "device-tier bytes held").set(cat.device_bytes)
+        reg.gauge("tpu_spill_host_bytes").set(cat.host_bytes)
+        reg.gauge("tpu_spilled_device_bytes_total",
+                  "cumulative device->host spill volume").set(
+            cat.spilled_device_bytes)
+        reg.gauge("tpu_spilled_host_bytes_total").set(cat.spilled_host_bytes)
+        reg.gauge("tpu_spill_buffers").set(cat.buffer_count())
+
+    # shuffle transport process totals
+    from ..shuffle import transport
+    for key, val in transport.transport_totals().items():
+        name = {"bytes_fetched": "tpu_shuffle_bytes_fetched_total",
+                "chunks": "tpu_shuffle_chunks_total",
+                "retries": "tpu_shuffle_retries_total",
+                "bounce_misses": "tpu_shuffle_bounce_misses_total"}.get(key)
+        if name:
+            reg.gauge(name).set(val)
+
+    # watermarks (current + peak + peak-operator attribution)
+    for wm in watermarks().values():
+        reg.gauge("tpu_hbm_bytes", "current accounted bytes",
+                  store=wm.name).set(wm.current)
+        reg.gauge("tpu_hbm_peak_bytes", "peak accounted bytes",
+                  store=wm.name).set(wm.peak)
+        if wm.peak_operator:
+            reg.gauge("tpu_hbm_peak_operator_info",
+                      "constant 1; operator that drove the peak",
+                      store=wm.name, operator=wm.peak_operator).set(1)
+
+    # the flight ring itself
+    reg.gauge("tpu_flight_events_total",
+              "events recorded into the flight ring").set(
+        FlightRecorder.get().event_count() if _flight_on() else 0)
+
+
+def compact_snapshot() -> Dict[str, Any]:
+    """A small flat snapshot for bench/multichip artifact tails: the
+    handful of registry numbers a round-over-round reader actually
+    diffs."""
+    snap = MetricsRegistry.get().collect()
+
+    def val(name, default=0):
+        fam = snap.get(name)
+        if not fam or not fam["samples"]:
+            return default
+        return fam["samples"][0].get("value", default)
+
+    out = {
+        "hostSyncs": val("tpu_host_syncs_total"),
+        "recompiles": val("tpu_recompiles_total"),
+        "semaphoreWaitS": round(val("tpu_semaphore_wait_seconds_total"), 3),
+        "semaphoreHoldS": round(val("tpu_semaphore_hold_seconds_total"), 3),
+        "spilledDeviceBytes": val("tpu_spilled_device_bytes_total"),
+        "shuffleBytesFetched": val("tpu_shuffle_bytes_fetched_total"),
+        "flightEvents": val("tpu_flight_events_total"),
+    }
+    dev = watermarks().get("device")
+    if dev is not None:
+        out["hbmPeakBytes"] = dev.peak
+        if dev.peak_operator:
+            out["hbmPeakOperator"] = dev.peak_operator
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scrape endpoint
+# ---------------------------------------------------------------------------
+
+class TelemetryServer:
+    """Background HTTP scrape endpoint: ``GET /metrics`` answers
+    Prometheus text, ``GET /snapshot`` the JSON snapshot. Daemon-thread
+    server (a wedged scraper must never block interpreter exit);
+    ``stop()`` shuts it down cleanly with a bounded join."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):              # noqa: N802 (http.server API)
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = MetricsRegistry.get().prometheus_text() \
+                            .encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.split("?")[0] == "/snapshot":
+                        body = json.dumps(
+                            MetricsRegistry.get().snapshot()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:     # scrape must answer, not die
+                    self.send_error(500, str(e)[:200])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("scrape: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TelemetryServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tpu-telemetry-http")
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout_s: float = 2.0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=join_timeout_s)
+        self._thread = None
+
+
+_server: Optional[TelemetryServer] = None
+_server_mu = named_lock("service.telemetry._server_mu")
+
+
+def start_server(port: int, host: str = "127.0.0.1") -> TelemetryServer:
+    """Start (or return) the process scrape endpoint. ``port=0`` binds an
+    ephemeral port (tests); the conf path only calls with port > 0."""
+    global _server
+    with _server_mu:
+        if _server is None:
+            _server = TelemetryServer(port, host).start()
+        return _server
+
+
+def stop_server() -> None:
+    global _server
+    with _server_mu:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()
+
+
+def active_server() -> Optional[TelemetryServer]:
+    with _server_mu:
+        return _server

@@ -38,6 +38,26 @@ from .wire import (ERROR, META_REQ, META_RESP, RELEASE, XFER_CHUNK,
                    encode_frame)
 
 
+# process-lifetime transport totals (service/telemetry harvest): client
+# instances are per-peer and short-lived, so cumulative counters live at
+# module level, bumped at buffer-completion / retry boundaries
+_TOTALS: Dict[str, int] = {"retries": 0, "bytes_fetched": 0, "chunks": 0,
+                           "bounce_misses": 0}
+_totals_mu = named_lock("shuffle.transport._totals_mu")
+
+
+def _note_total(key: str, amount: int = 1) -> None:
+    with _totals_mu:
+        _TOTALS[key] += amount
+
+
+def transport_totals() -> Dict[str, int]:
+    """Cumulative fetch-side transport counters across every client this
+    process created (the telemetry registry's shuffle gauges)."""
+    with _totals_mu:
+        return dict(_TOTALS)
+
+
 class ShuffleFetchError(RuntimeError):
     """Fetch failed after retries (RapidsShuffleFetchFailedException analog:
     the caller maps this to a stage retry / recompute)."""
@@ -503,6 +523,7 @@ class ShuffleClient:
         for attempt in range(self.max_retries + 1):
             if attempt:
                 self.metrics["retries"] += 1
+                _note_total("retries")
                 time.sleep(self.retry_backoff_s * attempt)
             try:
                 return self._fetch_once(shuffle_id, reduce_ids, fingerprint)
@@ -606,6 +627,7 @@ class ShuffleClient:
                     buf = self.bounce.acquire(total)
                     if buf is None:              # arena exhausted: fall back
                         self.metrics["bounce_misses"] += 1
+                        _note_total("bounce_misses")
                         buf = bytearray(total)
                     received[bid] = buf
                 buf[header["offset"]:header["offset"] + len(payload)] = \
@@ -616,6 +638,10 @@ class ShuffleClient:
                     m = inflight.pop(bid)
                     inflight_bytes -= m.total_bytes
                     self.metrics["bytes_fetched"] += m.total_bytes
+                    # registry totals bump at BUFFER completion (a flush
+                    # boundary), not per chunk
+                    _note_total("bytes_fetched", m.total_bytes)
+                    _note_total("chunks", seen_chunks[bid])
                     buf = received.pop(bid)
                     done.append(_rebuild_batch(m, bytes(buf)))
                     if isinstance(buf, memoryview):

@@ -31,6 +31,12 @@ os.environ.setdefault(
     "SPARK_RAPIDS_TPU_CONF__SPARK__RAPIDS__TPU__SQL__ANALYSIS__LOCKDEP",
     "record")
 
+# tests drive bench/dryrun code paths (test_partitioning runs the full
+# multichip dryrun): their regression-gate stamps must land in a scratch
+# history file, never in the committed benchmarks/reports JSONL
+os.environ.setdefault("SPARK_RAPIDS_TPU_BENCH_HISTORY",
+                      "/tmp/spark_rapids_tpu_test_history.jsonl")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

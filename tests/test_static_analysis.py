@@ -145,6 +145,50 @@ def test_rule_metric_key_undeclared():
     assert "fooTime" not in msgs and "numOutputRows" not in msgs
 
 
+def test_rule_telemetry_key_undeclared():
+    """A registry.counter/gauge/histogram literal name not declared in
+    service/telemetry.TELEMETRY_KEYS trips telemetry-key; declared names
+    pass, and a missing TELEMETRY_KEYS surface is itself a violation."""
+    decl = ('TELEMETRY_KEYS = (\n    "tpu_good_total",\n'
+            '    "tpu_fine_bytes",\n)\n')
+    user = ('def publish(reg):\n'
+            '    reg.counter("tpu_good_total").inc()\n'
+            '    reg.gauge("tpu_fine_bytes", "help", store="x").set(1)\n'
+            '    reg.histogram("tpu_rogue_seconds").observe(0.1)\n'
+            '    reg.gauge("tpu_unheard_of").set(2)\n')
+    v = lint.check_telemetry_keys({
+        "service/telemetry.py": ("service/telemetry.py", decl),
+        "exec/foo.py": ("exec/foo.py", user)})
+    assert [x.rule for x in v] == ["telemetry-key"] * 2, v
+    msgs = "\n".join(x.message for x in v)
+    assert "tpu_rogue_seconds" in msgs and "tpu_unheard_of" in msgs
+    assert "tpu_good_total" not in msgs
+    # no TELEMETRY_KEYS tuple at all: the surface itself is flagged
+    v2 = lint.check_telemetry_keys({
+        "service/telemetry.py": ("service/telemetry.py", "X = 1\n")})
+    assert len(v2) == 1 and "TELEMETRY_KEYS" in v2[0].message
+
+
+def test_telemetry_keys_surface_in_sync_now():
+    """Every registry metric name the package emits is declared (the
+    live telemetry-key gate over the real tree), and the declared tuple
+    parses to the same set the engine exports."""
+    srcs = {}
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, PKG).replace(os.sep, "/")
+                with open(full) as f:
+                    srcs[rel] = (full, f.read())
+    assert lint.check_telemetry_keys(srcs) == []
+    from spark_rapids_tpu.service import telemetry as tel
+    declared = lint.telemetry_declared_keys(
+        srcs["service/telemetry.py"][1])
+    assert declared == set(tel.TELEMETRY_KEYS)
+
+
 def test_rule_conf_docs_drift_both_directions():
     config_src = (
         'X = _conf("spark.rapids.tpu.sql.foo").doc("d")'

@@ -109,6 +109,25 @@ def test_map_in_pandas():
     assert sorted(r[1] for r in rows) == [2.0, 4.0, 6.0]
 
 
+def test_map_in_pandas_none_yield_fails_loudly():
+    """A fn yielding None mid-stream must raise (the pre-telemetry
+    behavior), never be read as end-of-stream and silently truncate
+    the frames after it."""
+    import pytest
+    from spark_rapids_tpu.api.session import TpuSession
+
+    def bad(frames):
+        for f in frames:
+            yield None
+            yield f
+
+    s = TpuSession.builder.getOrCreate()
+    df = (s.createDataFrame({"k": [1, 2, 3]})
+          .mapInPandas(bad, [("k", "bigint")]))
+    with pytest.raises(TypeError):
+        df.collect()
+
+
 def test_rebatch_iterator_alignment():
     from spark_rapids_tpu.columnar.batch import ColumnarBatch
     from spark_rapids_tpu.ops.python_udf import rebatch_iterator
