@@ -164,6 +164,44 @@ def bench_engine(sf: float, query: str, iters: int = 2):
     return n_rows / hot_s, n_rows / pd_s, cold_s
 
 
+def bench_shuffle(n_rows: int, iters: int = 2):
+    """Engine shuffle-exchange throughput: repartition ``n_rows`` through
+    TpuShuffleExchangeExec (hash keys) and report GB/s of shuffle bytes
+    moved over exchange wall time, plus which data plane carried it
+    (docs/shuffle.md). The hot iteration is the measurement; the cold one
+    pays compiles."""
+    import numpy as np
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.shuffle.exchange import shuffle_report
+    session = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    rng = np.random.default_rng(11)
+    df = session.createDataFrame({
+        "k": [int(x) for x in rng.integers(0, 1 << 20, n_rows)],
+        "v": [float(x) for x in rng.normal(0, 10, n_rows)]})
+    best = None
+    for it in range(max(1, iters) + 1):
+        t0 = time.perf_counter()
+        batch = df.repartition(8, col("k")).collect_batch()
+        wall = time.perf_counter() - t0
+        assert batch.num_rows == n_rows, (batch.num_rows, n_rows)
+        rep = shuffle_report(session.last_plan())
+        # write-side bytes only: the same definition note_plane and the
+        # tpu_shuffle_gbps gauge use (each shuffled byte counted once)
+        moved = sum(e.get("bytesWritten", 0) for e in rep)
+        plane = rep[0]["plane"] if rep else None
+        if it == 0 or moved <= 0:
+            continue                       # cold iteration pays compiles
+        gbps = moved / wall / 1e9
+        if best is None or gbps > best["shuffle_gbps"]:
+            best = {"shuffle_gbps": round(gbps, 4),
+                    "shuffle_bytes": moved,
+                    "shuffle_plane": plane,
+                    "shuffle_wall_s": round(wall, 4)}
+    return best
+
+
 def _pandas_query(query: str, li):
     import pandas as pd
     if query == "q6":
@@ -232,6 +270,16 @@ def main():
         except Exception as e:            # engine bench must not kill the line
             engine[f"engine_{q}_error"] = str(e)[:120]
 
+    # shuffle-exchange throughput (ISSUE 8: shuffle GB/s + plane in every
+    # bench artifact; judged by the same regression gate as the pipeline)
+    shuffle = None
+    try:
+        shuffle = bench_shuffle(200_000 if platform == "cpu" else 4_000_000)
+        if shuffle:
+            engine.update(shuffle)
+    except Exception as e:
+        engine["shuffle_error"] = str(e)[:120]
+
     bytes_per_row = 8 + 1 + 8 + 1 + 1            # key, kvalid, val, vvalid, flag
     gbytes_per_s = tpu_rows_per_s * bytes_per_row / 1e9
     # one-hot matmul flops: rows x slots x 2 (mul+add) x planned feature
@@ -275,6 +323,11 @@ def main():
             v = engine.get(f"engine_{q}_mrows_per_s")
             if v is not None:
                 queries[f"engine_{q}"] = v
+        if shuffle and shuffle.get("shuffle_gbps"):
+            # shuffle GB/s rides the same higher-is-better gate
+            # (benchmarks/history.SHUFFLE_GBPS series)
+            from benchmarks.history import SHUFFLE_GBPS
+            queries[SHUFFLE_GBPS] = shuffle["shuffle_gbps"]
         gate = bh.stamp(
             "bench", queries, backend=line["backend"], degraded=degraded,
             error=probe.get("error") if degraded else None,

@@ -32,6 +32,12 @@ from typing import Dict, List, Optional
 WARN_PCT = 0.10
 FAIL_PCT = 0.25
 
+#: query name of the shuffle-exchange throughput series (GB/s moved
+#: through TpuShuffleExchangeExec, higher is better): stamped by bench.py
+#: inside the ``bench`` kind, so a shuffle-plane regression fails the
+#: same gate a pipeline-throughput regression does (docs/shuffle.md)
+SHUFFLE_GBPS = "shuffle_gbps"
+
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)
 DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -137,6 +137,16 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             if locks:
                 entry["locks"] = locks
             try:
+                # per-exchange shuffle accounting (docs/shuffle.md): which
+                # data plane each exchange took (ici collectives vs the
+                # host/DCN path), bytes moved, and GB/s
+                from spark_rapids_tpu.shuffle.exchange import shuffle_report
+                shuffles = shuffle_report(session.last_plan())
+                if shuffles:
+                    entry["shuffle"] = shuffles
+            except Exception:
+                pass
+            try:
                 m = session.last_query_metrics()
                 entry["planTimeS"] = m.get("planTimeS")
                 entry["executeTimeS"] = m.get("executeTimeS")

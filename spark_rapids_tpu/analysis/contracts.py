@@ -350,6 +350,31 @@ def _extra_empty_schema(node, path: str, out: List[Violation]) -> None:
             "write exec must have an empty output schema"))
 
 
+def _extra_exchange_plane(node, path: str, out: List[Violation]) -> None:
+    """Two-plane exchange shape (docs/shuffle.md): the plan-time plane is
+    one of auto|ici|dcn, a forced ICI plane carries the mesh it needs
+    (auto may resolve either way at runtime; forced ici without a mesh is
+    a planner bug that would otherwise surface mid-query), and the
+    pipelined split depth is positive."""
+    name = type(node).__name__
+    plane = str(getattr(node, "plane", "auto") or "auto").lower()
+    if plane not in ("auto", "ici", "dcn"):
+        out.append(Violation(
+            name, path,
+            f"exchange plane {plane!r} is not one of auto|ici|dcn"))
+        return
+    if plane == "ici" and getattr(node, "mesh", None) is None:
+        out.append(Violation(
+            name, path,
+            "plane forced to ici but the planner attached no device mesh "
+            "(collectives cannot run; the conversion should have failed)"))
+    depth = getattr(node, "split_depth", None)
+    if depth is not None and int(depth) < 1:
+        out.append(Violation(
+            name, path,
+            f"map-split pipeline depth {depth} must be >= 1"))
+
+
 _EXTRAS = {
     "join_schema": _extra_join_schema,
     "copartitioned": _extra_copartitioned,
@@ -357,6 +382,7 @@ _EXTRAS = {
     "window_schema": _extra_window_schema,
     "reorder_permutation": _extra_reorder_permutation,
     "empty_schema": _extra_empty_schema,
+    "exchange_plane": _extra_exchange_plane,
 }
 
 

@@ -92,6 +92,50 @@ _state = _State()
 _mode = "off"
 _tls = threading.local()     # .held: List[[name, t_acq, reentrant, span, id]]
                              # .allow: int (allowed_while_locked depth)
+                             # .busy: int (bookkeeping re-entry shield)
+
+
+class _mu_section:
+    """``_state._mu`` with a thread-local re-entry shield.
+
+    A GC weakref finalizer (e.g. the scan-cache eviction closing a
+    spillable buffer) can fire at ANY bytecode — including while this
+    thread is inside a ``with _mu_section():`` bookkeeping section — and the
+    finalizer's own named-lock acquisition would then re-enter lockdep
+    and deadlock on the non-reentrant state mutex its interrupted frame
+    already holds (observed: ``_evict_table -> BufferCatalog.free``
+    firing inside ``_note_acquired``). While ``_tls.busy`` is set,
+    :meth:`NamedLock.acquire`/`release` bypass bookkeeping (raw lock
+    only), so the finalizer runs untracked instead of hanging the
+    process. ``busy`` is raised BEFORE the mutex acquire so a finalizer
+    interrupting the wait is shielded too."""
+
+    __slots__ = ("_m",)
+
+    def __enter__(self):
+        _tls.busy = getattr(_tls, "busy", 0) + 1
+        try:
+            # pin the mutex object: reset_state() may swap _state between
+            # enter and exit, and releasing the NEW state's unheld mutex
+            # would raise out of the exit path
+            self._m = _state._mu
+            self._m.acquire()
+        except BaseException:
+            # a KeyboardInterrupt while blocked on the mutex must not
+            # leak busy>0 (that thread would silently bypass lockdep
+            # forever)
+            _tls.busy -= 1
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        self._m.release()
+        _tls.busy -= 1
+        return False
+
+
+def _bookkeeping_busy() -> bool:
+    return getattr(_tls, "busy", 0) > 0
 
 
 # ---------------------------------------------------------------------------
@@ -194,7 +238,7 @@ def _note_acquired(name: str, held: List[list]) -> None:
     stack_now = None
     raise_report = None
     flight_report = None
-    with _state._mu:
+    with _mu_section():
         for h in dict.fromkeys(held_names):        # de-dup, keep order
             edge = (h, name)
             ent = _state.edges.get(edge)
@@ -262,11 +306,15 @@ class NamedLock:
     def __init__(self, name: str):
         self.name = name
         self._raw = self._factory()
-        with _state._mu:
+        if _bookkeeping_busy():
+            return            # created by a finalizer mid-bookkeeping:
+        with _mu_section():   # skip the registry, never re-enter the mutex
             _state.registered[name] = _state.registered.get(name, 0) + 1
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        if _mode == "off":
+        if _mode == "off" or _bookkeeping_busy():
+            # busy: this thread is INSIDE lockdep bookkeeping (a GC
+            # finalizer interrupted it) — track nothing, never re-enter
             return self._raw.acquire(blocking, timeout)
         held = _held()
         # re-entrancy is judged by lock OBJECT, not name: two instances of
@@ -290,7 +338,7 @@ class NamedLock:
         span = _current_span()
         held.append([self.name, now, reentrant, span, my_id])
         if not reentrant:
-            with _state._mu:
+            with _mu_section():
                 st = _stat(self.name)
                 st["waitS"] += now - t0
                 st["acquires"] += 1
@@ -310,9 +358,9 @@ class NamedLock:
                     entry = held.pop(i)
                     break
         self._raw.release()
-        if entry is not None and not entry[2]:
+        if entry is not None and not entry[2] and not _bookkeeping_busy():
             held_for = time.perf_counter() - entry[1]
-            with _state._mu:
+            with _mu_section():
                 st = _stat(self.name)
                 st["holdS"] += held_for
                 if entry[3]:
@@ -402,7 +450,7 @@ def note_host_transfer(reason: str) -> None:
             f"host transfer ({reason}) while holding {held} — narrow the "
             "critical section or sanction it with "
             f"lockdep.allowed_while_locked(<reason>)\n{finding['stack']}")
-    with _state._mu:
+    with _mu_section():
         if len(_state.transfers) < _MAX_FINDINGS:
             _state.transfers.append(finding)
 
@@ -414,7 +462,7 @@ def note_host_transfer(reason: str) -> None:
 def stats() -> Dict[str, Dict]:
     """Per-lock cumulative wait/hold seconds, acquire counts, and the
     per-span attribution (bench runner reads deltas of this)."""
-    with _state._mu:
+    with _mu_section():
         out = {}
         for name, st in sorted(_state.stats.items()):
             out[name] = {
@@ -463,7 +511,7 @@ def stats_delta(before: Dict, after: Optional[Dict] = None) -> Dict:
 def report() -> Dict:
     """Full lockdep report: mode, per-lock stats, the order graph, every
     inversion (with both stacks), and held-across-transfer findings."""
-    with _state._mu:
+    with _mu_section():
         edges = [{"edge": f"{a} -> {b}", "count": e["count"]}
                  for (a, b), e in sorted(_state.edges.items())]
         cycles = list(_state.cycles)

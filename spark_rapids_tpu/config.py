@@ -251,6 +251,27 @@ SHUFFLE_PARTITIONS = _conf("spark.rapids.tpu.sql.shuffle.partitions").doc(
     "Default number of shuffle partitions (ref: spark.sql.shuffle.partitions)"
 ).integer_conf.create_with_default(8)
 
+SHUFFLE_PLANE = _conf("spark.rapids.tpu.sql.shuffle.plane").doc(
+    "Shuffle exchange data plane: 'auto' (device->device ICI collectives "
+    "over the active mesh when one exists, the host/DCN path otherwise), "
+    "'ici' (force collectives; planning fails without a mesh), 'dcn' "
+    "(force the host-staged transport path). The ICI plane moves "
+    "uncompressed device buffers through all_to_all (SURVEY.md §5: the "
+    "UCX/RDMA -> ICI re-design); the DCN plane keeps the TCP transfer "
+    "server, elastic retry, and the wire compression codec "
+    "(see docs/shuffle.md)").string_conf.check(
+        lambda v: str(v).lower() in ("auto", "ici", "dcn")
+).create_with_default("auto")
+
+SHUFFLE_PIPELINE_DEPTH = _conf("spark.rapids.tpu.sql.shuffle.pipelineDepth").doc(
+    "Map-side split batches kept in flight before the oldest batch's "
+    "slice-sizing readback lands: batch k+1's fused split (hash -> stable "
+    "sort by partition id -> counts) dispatches before batch k's packed "
+    "sizing resolves, so the map phase pays O(1) host syncs instead of "
+    "one per batch. 1 degenerates to read-per-batch. Device residency "
+    "grows by one sorted batch per slot"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(8)
+
 SHUFFLE_COMPRESSION_CODEC = _conf("spark.rapids.tpu.shuffle.compression.codec").doc(
     "Codec for shuffle transfer payloads: none, zlib (ref: spark.rapids."
     "shuffle.compression.codec / NvcompLZ4CompressionCodec, "

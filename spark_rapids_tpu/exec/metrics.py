@@ -290,6 +290,17 @@ class TpuMetrics(dict):
     def timer(self, key: str):
         return _Timer(self, key)
 
+    def gbps(self, bytes_keys, seconds_keys) -> Optional[float]:
+        """Throughput view over this bag: GB/s of the summed byte
+        counters over the summed second counters (None when either side
+        is empty — a never-executed operator has no rate). The shuffle
+        report reads exchange GB/s through this."""
+        b = sum(self.get(k, 0) or 0 for k in bytes_keys)
+        s = sum(self.get(k, 0.0) or 0.0 for k in seconds_keys)
+        if b <= 0 or s <= 0:
+            return None
+        return b / s / 1e9
+
 
 class _Timer:
     def __init__(self, metrics: TpuMetrics, key: str):

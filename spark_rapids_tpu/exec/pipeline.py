@@ -117,14 +117,18 @@ class PipelineWindow:
         int32 counts above 2^24 must not round-trip through a float."""
         if not flat:
             return []
+        import numpy as np
+        # numpy values are ALREADY host: routing them through the packed
+        # device_get would pay an upload + a readback for data the caller
+        # could use directly
         device = [(i, s) for i, s in enumerate(flat)
-                  if hasattr(s, "dtype") and hasattr(s, "shape")]
+                  if hasattr(s, "dtype") and hasattr(s, "shape")
+                  and not isinstance(s, (np.ndarray, np.generic))]
         vals: List[Any] = list(flat)       # host values pass through
         if not device:
             return vals
         import jax
         import jax.numpy as jnp
-        import numpy as np
         from .metrics import exec_scope
         with trace_span("pipeline_resolve"), exec_scope(self.metrics):
             try:

@@ -60,6 +60,44 @@ class BufferLostError(RuntimeError):
     exchange re-execute the producing stage, Spark FetchFailed style)."""
 
 
+# ---------------------------------------------------------------------------
+# GC-callback-safe deferred finalization
+# ---------------------------------------------------------------------------
+#
+# A weakref finalizer fires at an ARBITRARY bytecode on an arbitrary
+# thread — including inside a frame that already holds the buffer
+# catalog / watermark / device-manager locks. Cleanup that re-takes any
+# of those locks inline self-deadlocks the thread on its own
+# non-reentrant lock (observed: the scan-cache eviction finalizer firing
+# inside ``reserve -> watermark`` and blocking on the watermark lock the
+# interrupted frame held). Finalizers therefore only ENQUEUE their work
+# (``list.append`` is atomic, no lock) and the engine drains the queue
+# at safe points: partition-task launch and scan-cache access.
+
+_DEFERRED_FINALIZERS: List[Tuple[Callable, tuple]] = []
+
+
+def defer_finalizer(fn: Callable, *args) -> None:
+    """Enqueue lock-taking cleanup from a GC/weakref callback (run later
+    by :func:`drain_deferred_finalizers` from a safe call context)."""
+    _DEFERRED_FINALIZERS.append((fn, args))
+
+
+def drain_deferred_finalizers() -> None:
+    """Run enqueued finalizer work. Callers must hold NO engine locks.
+    Failures are swallowed — deferred cleanup must never fail the query
+    that happened to trigger the drain."""
+    while _DEFERRED_FINALIZERS:
+        try:
+            fn, args = _DEFERRED_FINALIZERS.pop()
+        except IndexError:
+            break
+        try:
+            fn(*args)
+        except Exception:
+            pass
+
+
 @dataclass
 class BufferMeta:
     """Schema + shape info to rebuild a ColumnarBatch from raw arrays

@@ -90,6 +90,10 @@ def run_partition_tasks(parts: Sequence[Any],
     if max_workers <= 0:
         from .. import config as cfg
         max_workers = cfg.TpuConf().task_pool_threads
+    # safe point for GC-deferred cleanup (exec/spill.defer_finalizer):
+    # no engine locks are held at task launch
+    from .spill import drain_deferred_finalizers
+    drain_deferred_finalizers()
 
     def task(pid_part):
         pid, part = pid_part

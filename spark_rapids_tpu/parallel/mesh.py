@@ -172,6 +172,87 @@ def flatten_received(stacked: List[jnp.ndarray], counts: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# Reduce-partition exchange: the ICI data plane of TpuShuffleExchangeExec
+# ---------------------------------------------------------------------------
+
+def partition_exchange_fn(mesh: Mesh, col_dtypes: Sequence[dt.DType],
+                          cap: int, num_partitions: int):
+    """Jitted device-resident shuffle exchange over ICI: every worker
+    buckets its rows by owning worker (``pid % n``), one ``all_to_all``
+    delivers them, and the receiver stable-sorts its rows by reduce
+    partition id so each owned partition is one contiguous run.
+
+    This is ``TpuShuffleExchangeExec``'s data plane collapsed into one
+    XLA computation per stage (SURVEY.md §5/§7-step-6: the device-store +
+    RDMA transport of the reference mapped onto mesh collectives): the
+    partition payload never leaves the accelerator, and the host reads
+    back ONE ``[n, num_partitions]`` counts array per exchange to slice
+    the runs. Receive windows are ``n * cap`` so key skew cannot drop
+    rows. Output per worker: every payload array sorted by partition id
+    (padding last) plus the int32 per-partition counts.
+    """
+    n = mesh.devices.size
+    out_cap = n * cap
+    n_arrays = sum(3 if t.var_width else 2 for t in col_dtypes)
+
+    def per_worker(*args):
+        args = [a[0] for a in args]
+        *arrays, pids, local_n = args
+        live = jnp.arange(cap) < local_n
+        owner = jnp.mod(pids, n)
+        payload = list(arrays) + [pids]
+        stacked, counts = bucket_rows_for_exchange(payload, owner, live,
+                                                   n, cap)
+        moved, moved_counts = exchange(stacked, counts, "workers")
+        flat, recv_n = flatten_received(moved, moved_counts, out_cap)
+        recv_pids = flat[-1]
+        recv_live = jnp.arange(out_cap) < recv_n
+        sort_key = jnp.where(recv_live, recv_pids, num_partitions)
+        order = jnp.argsort(sort_key, stable=True)
+        sorted_arrays = [a[order] for a in flat[:-1]]
+        pcounts = jnp.bincount(
+            jnp.clip(sort_key, 0, num_partitions),
+            length=num_partitions + 1)[:num_partitions].astype(jnp.int32)
+        return tuple(a[None] for a in sorted_arrays) + (pcounts[None],)
+
+    in_specs = tuple([P("workers")] * (n_arrays + 2))
+    return jax.jit(_shard_map(per_worker, mesh, in_specs, P("workers")))
+
+
+def run_partition_exchange(mesh: Mesh, batches: List[ColumnarBatch],
+                           pids: List[jnp.ndarray], num_partitions: int
+                           ) -> List[Tuple[List[Column], np.ndarray]]:
+    """Host driver for the ICI exchange plane: one shard + its int32[cap]
+    partition ids per worker in, per worker out ``(columns sorted by
+    reduce partition id, host counts int32[num_partitions])`` — worker w
+    holds exactly the partitions with ``p % n == w`` as contiguous runs.
+    The counts readback is the exchange's ONE host sync."""
+    n = mesh.devices.size
+    assert len(batches) == n and len(pids) == n, "one shard per worker"
+    cap = max(b.capacity for b in batches)
+    col_dtypes = [c.dtype for c in batches[0].columns]
+    stacked = _stack_shards(batches, cap)
+    pid_stack = jnp.stack([
+        p if p.shape[0] == cap else
+        jnp.zeros(cap, jnp.int32).at[:p.shape[0]].set(p)
+        for p in pids]).astype(jnp.int32)
+    counts = jnp.asarray([b.num_rows for b in batches], dtype=jnp.int32)
+    fn = _cached_fn(
+        ("pexch", _mesh_key(mesh), tuple(col_dtypes), cap, num_partitions),
+        lambda: partition_exchange_fn(mesh, col_dtypes, cap,
+                                      num_partitions))
+    outs = fn(*stacked, pid_stack, counts)
+    from ..analysis.sync_audit import allowed_host_transfer
+    with allowed_host_transfer("ici exchange sizing"):
+        pcounts = np.asarray(outs[-1])     # ONE readback per exchange
+    results: List[Tuple[List[Column], np.ndarray]] = []
+    for w in range(n):
+        arrays = [o[w] for o in outs[:-1]]
+        results.append((_rebuild_columns(col_dtypes, arrays), pcounts[w]))
+    return results
+
+
+# ---------------------------------------------------------------------------
 # Distributed group-by: the flagship SPMD pipeline
 # ---------------------------------------------------------------------------
 

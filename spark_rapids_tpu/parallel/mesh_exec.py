@@ -251,8 +251,16 @@ class TpuMeshJoinExec(TpuShuffledJoinExec):
                 for b in co]
 
     def execute(self) -> List[Partition]:
+        import time as _time
+        t0 = _time.perf_counter()
         with trace_span("mesh_exchange", self.metrics, "meshExchangeTime"):
             l_co = self._copartition(self.children[0], self.part_left_keys)
             r_co = self._copartition(self.children[1], self.part_right_keys)
+        # the copartition all_to_all IS an ICI shuffle exchange: account
+        # it in the process plane totals next to TpuShuffleExchangeExec
+        # (shuffle/exchange.note_plane -> tpu_shuffle_gbps{plane=ici})
+        from ..shuffle.exchange import note_plane
+        moved = sum(b.device_size_bytes() for b in l_co + r_co)
+        note_plane("ici", moved, _time.perf_counter() - t0)
         return [self._join_copart(iter([lb]), iter([rb]))
                 for lb, rb in zip(l_co, r_co)]

@@ -159,8 +159,13 @@ class _CacheOwner:
 
     def __init__(self, handle):
         import weakref
+        from ..exec.spill import defer_finalizer
         self.handle = handle
-        weakref.finalize(self, handle.close)
+        # enqueue-only finalizer: handle.close takes catalog/watermark
+        # locks, which a GC callback may interrupt MID-HOLD on its own
+        # thread (exec/spill.defer_finalizer — the inline close would
+        # self-deadlock); the engine drains at safe points
+        weakref.finalize(self, defer_finalizer, handle.close)
 
 
 class CachedScan(LogicalPlan):

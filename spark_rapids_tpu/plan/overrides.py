@@ -600,7 +600,9 @@ class Overrides:
             return ph.TpuRangeExec(p.start, p.end, p.step, p.num_partitions)
         if isinstance(p, lp.Repartition):
             from ..shuffle.exchange import TpuShuffleExchangeExec
-            return TpuShuffleExchangeExec(kids[0], p.num_partitions, p.by)
+            return TpuShuffleExchangeExec(
+                kids[0], p.num_partitions, p.by,
+                **self._exchange_kwargs(p.children[0].stats_bytes()))
         if isinstance(p, lp.Expand):
             return ph.TpuExpandExec(kids[0], p.projections, p.output_names)
         if isinstance(p, lp.Window):
@@ -626,9 +628,12 @@ class Overrides:
             left, right = kids
             if need and p.left_grouping and p.right_grouping:
                 n = self.conf.shuffle_partitions
-                left = TpuHashExchangeExec(left, n, list(p.left_grouping))
+                xkw = self._exchange_kwargs(
+                    p.children[0].stats_bytes(), p.children[1].stats_bytes())
+                left = TpuHashExchangeExec(left, n, list(p.left_grouping),
+                                           **xkw)
                 right = TpuHashExchangeExec(right, n,
-                                            list(p.right_grouping))
+                                            list(p.right_grouping), **xkw)
             return ph.TpuFlatMapCoGroupsInPandasExec(left, right, p)
         if isinstance(p, lp.AggregateInPandas):
             return ph.TpuAggregateInPandasExec(
@@ -661,6 +666,30 @@ class Overrides:
             return None
         return mesh
 
+    def _exchange_kwargs(self, *stats: int) -> dict:
+        """Plan-time shuffle-plane routing for one exchange (conf
+        spark.rapids.tpu.sql.shuffle.plane, docs/shuffle.md): 'auto' hands
+        the exchange the active mesh when the stage is small enough to
+        stage device-resident (it resolves ici/dcn per shape at runtime),
+        'ici' forces the collective plane — failing LOUDLY at plan time
+        without a mesh — and 'dcn' pins the host/TCP path. The pipelined
+        map-split depth resolves here too (session conf, not globals)."""
+        plane = str(self.conf.get(cfg.SHUFFLE_PLANE)).lower()
+        if plane == "dcn":
+            mesh = None
+        elif plane == "ici":
+            mesh = self._mesh()            # forced: the size gate yields
+            if mesh is None:
+                raise RuntimeError(
+                    f"{cfg.SHUFFLE_PLANE.key}=ici but no device mesh is "
+                    f"active — enable {cfg.MESH_ENABLED.key} or use "
+                    "auto/dcn")
+        else:
+            mesh = self._mesh_for_stage(*stats)
+        return dict(
+            plane=plane, mesh=mesh,
+            split_depth=int(self.conf.get(cfg.SHUFFLE_PIPELINE_DEPTH)))
+
     def _cluster_by_keys(self, child: ph.TpuExec,
                          grouping: List[ex.Expression]) -> ph.TpuExec:
         """Clustered-distribution requirement for grouped pandas execs:
@@ -672,7 +701,8 @@ class Overrides:
         multiworker = WorkerContext.current is not None
         if (child.output_partitions > 1 or multiworker) and grouping:
             return TpuHashExchangeExec(child, self.conf.shuffle_partitions,
-                                       list(grouping))
+                                       list(grouping),
+                                       **self._exchange_kwargs())
         return child
 
     def _try_mesh_aggregate(self, child: ph.TpuExec,
@@ -763,6 +793,7 @@ class Overrides:
             partial = ph.TpuHashAggregateExec(child, grouping, outputs,
                                               mode="partial",
                                               pre_filter=pre_filter)
+            xkw = self._exchange_kwargs(stats_bytes)
             if grouping:
                 keys = [ex.ColumnRef(f"_k{i}") for i in range(len(grouping))]
                 # adaptive_ok: the final aggregate tolerates runtime
@@ -772,10 +803,11 @@ class Overrides:
                     partial, self.conf.shuffle_partitions, keys,
                     adaptive_ok=bool(self.conf.get(cfg.ADAPTIVE_ENABLED)),
                     adaptive_min_bytes=int(
-                        self.conf.get(cfg.ADAPTIVE_MIN_PARTITION_BYTES)))
+                        self.conf.get(cfg.ADAPTIVE_MIN_PARTITION_BYTES)),
+                    **xkw)
             else:
                 # global aggregate: all partials meet on one partition
-                exch = TpuShuffleExchangeExec(partial, 1)
+                exch = TpuShuffleExchangeExec(partial, 1, **xkw)
             return ph.TpuHashAggregateExec(exch, grouping, outputs,
                                            mode="final",
                                            per_partition_final=True)
@@ -941,9 +973,10 @@ class Overrides:
             # inherits the pipelined per-pair join loop
             mj.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
             return mj
+        xkw = self._exchange_kwargs(build_stats, stream_stats)
         j = ph.TpuShuffledJoinExec(
-            TpuHashExchangeExec(stream, n, pk_stream),
-            TpuHashExchangeExec(build, n, pk_build),
+            TpuHashExchangeExec(stream, n, pk_stream, **xkw),
+            TpuHashExchangeExec(build, n, pk_build, **xkw),
             how, stream_keys, build_keys, residual)
         j.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
         if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and threshold >= 0:

@@ -760,6 +760,16 @@ class TpuLocalScanExec(TpuExec):
 
     @classmethod
     def _evict_table(cls, cache_key: tuple) -> None:
+        # weakref-finalizer entry point: fires at an arbitrary bytecode,
+        # possibly inside a frame HOLDING the cache/catalog/watermark
+        # locks — taking them inline here self-deadlocks that thread
+        # (exec/spill.defer_finalizer). Enqueue only; the next scan-cache
+        # access or partition-task launch drains.
+        from ..exec.spill import defer_finalizer
+        defer_finalizer(cls._evict_table_now, cache_key)
+
+    @classmethod
+    def _evict_table_now(cls, cache_key: tuple) -> None:
         with cls._device_cache_lock:
             ent = cls._DEVICE_CACHE.pop(cache_key, None)
             if ent:
@@ -773,6 +783,8 @@ class TpuLocalScanExec(TpuExec):
 
     def _table_cache(self):
         import weakref
+        from ..exec.spill import drain_deferred_finalizers
+        drain_deferred_finalizers()
         cls = TpuLocalScanExec
         key = (id(self.base_data), tuple(self._schema.names()),
                self.batch_rows)
@@ -2739,6 +2751,11 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
             return None
         sx = self.children[0]
         if not isinstance(sx, TpuShuffleExchangeExec):
+            return None
+        if sx.would_use_ici():
+            # device-resident exchange (docs/shuffle.md): rows never stage
+            # as host slices, so there are no per-slice observed sizes to
+            # split on — skew splitting is a host-plane feature
             return None
         sgroups = sx.execute_skew(thr)
         if all(len(g) == 1 for g in sgroups):

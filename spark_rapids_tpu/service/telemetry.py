@@ -93,6 +93,12 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_shuffle_chunks_total",
     "tpu_shuffle_retries_total",
     "tpu_shuffle_bounce_misses_total",
+    "tpu_shuffle_bytes_sent_total",
+    "tpu_shuffle_chunks_sent_total",
+    "tpu_shuffle_exchanges_total",      # label plane=ici|dcn
+    "tpu_shuffle_plane_bytes_total",    # label plane=ici|dcn
+    "tpu_shuffle_plane_seconds_total",  # label plane=ici|dcn
+    "tpu_shuffle_gbps",                 # label plane=ici|dcn
     "tpu_hbm_bytes",                    # label store=device|host|...
     "tpu_hbm_peak_bytes",
     "tpu_hbm_peak_operator_info",       # labels store=..., operator=...
@@ -734,15 +740,41 @@ def _harvest(reg: MetricsRegistry) -> None:
         reg.gauge("tpu_spilled_host_bytes_total").set(cat.spilled_host_bytes)
         reg.gauge("tpu_spill_buffers").set(cat.buffer_count())
 
-    # shuffle transport process totals
+    # shuffle transport process totals (both wire directions)
     from ..shuffle import transport
     for key, val in transport.transport_totals().items():
         name = {"bytes_fetched": "tpu_shuffle_bytes_fetched_total",
                 "chunks": "tpu_shuffle_chunks_total",
                 "retries": "tpu_shuffle_retries_total",
-                "bounce_misses": "tpu_shuffle_bounce_misses_total"}.get(key)
+                "bounce_misses": "tpu_shuffle_bounce_misses_total",
+                "bytes_sent": "tpu_shuffle_bytes_sent_total",
+                "chunks_sent": "tpu_shuffle_chunks_sent_total"}.get(key)
         if name:
             reg.gauge(name).set(val)
+
+    # shuffle data-plane totals (shuffle/exchange.plane_totals): which
+    # plane exchanges took, bytes moved, and the resulting GB/s per plane
+    from ..shuffle import exchange as _exchange
+    pt = _exchange.plane_totals()
+    for plane in ("ici", "dcn"):
+        n_ex = pt.get(f"{plane}_exchanges", 0)
+        if not n_ex:
+            continue
+        secs = pt.get(f"{plane}_seconds", 0.0)
+        moved = pt.get(f"{plane}_bytes", 0)
+        reg.gauge("tpu_shuffle_exchanges_total",
+                  "completed shuffle exchanges per data plane",
+                  plane=plane).set(n_ex)
+        reg.gauge("tpu_shuffle_plane_bytes_total",
+                  "bytes entering the shuffle per data plane",
+                  plane=plane).set(moved)
+        reg.gauge("tpu_shuffle_plane_seconds_total",
+                  "wall seconds spent in exchanges per data plane",
+                  plane=plane).set(round(secs, 4))
+        if secs > 0:
+            reg.gauge("tpu_shuffle_gbps",
+                      "cumulative shuffle throughput per data plane",
+                      plane=plane).set(round(moved / secs / 1e9, 6))
 
     # watermarks (current + peak + peak-operator attribution)
     for wm in watermarks().values():
@@ -780,8 +812,28 @@ def compact_snapshot() -> Dict[str, Any]:
         "semaphoreHoldS": round(val("tpu_semaphore_hold_seconds_total"), 3),
         "spilledDeviceBytes": val("tpu_spilled_device_bytes_total"),
         "shuffleBytesFetched": val("tpu_shuffle_bytes_fetched_total"),
+        "shuffleBytesSent": val("tpu_shuffle_bytes_sent_total"),
         "flightEvents": val("tpu_flight_events_total"),
     }
+    # per-plane exchange counts + GB/s (shuffle/exchange plane totals):
+    # the one-line answer to "did the shuffle ride ICI, and how fast"
+    try:
+        from ..shuffle.exchange import plane_totals
+        pt = plane_totals()
+        planes = {}
+        for plane in ("ici", "dcn"):
+            if pt.get(f"{plane}_exchanges"):
+                entry = {"exchanges": int(pt[f"{plane}_exchanges"]),
+                         "bytes": int(pt[f"{plane}_bytes"])}
+                secs = pt.get(f"{plane}_seconds", 0.0)
+                if secs > 0:
+                    entry["gbps"] = round(
+                        pt[f"{plane}_bytes"] / secs / 1e9, 6)
+                planes[plane] = entry
+        if planes:
+            out["shufflePlanes"] = planes
+    except Exception:
+        pass
     dev = watermarks().get("device")
     if dev is not None:
         out["hbmPeakBytes"] = dev.peak

@@ -6,17 +6,30 @@ the shuffle manager; ``RapidsCachingWriter`` keeps slices in the spillable
 device store instead of writing shuffle files
 (RapidsShuffleInternalManager.scala:73-192).
 
-This local exchange does the same single-process: map side splits each batch
-with a partitioner and registers the slices as spillable buffers keyed by
-(map partition, reduce partition); reduce side pulls and concatenates its
-slices. The multi-host data plane (ICI all_to_all / DCN transfer server)
-lives in parallel/ and shuffle/transport.py."""
+The exchange is TWO-PLANE (docs/shuffle.md, conf
+``spark.rapids.tpu.sql.shuffle.plane``):
+
+* **ICI** — with an active device mesh, the whole exchange lowers to one
+  fused ``all_to_all`` program (parallel/mesh.run_partition_exchange):
+  partitioned rows move device->device over the interconnect, uncompressed,
+  and the host reads back ONE counts array per exchange. The TPU analog of
+  the reference's device store + RDMA transport (SURVEY.md §2.8, §5).
+* **DCN** — the host-staged path below: map side splits each batch with a
+  partitioner (slice sizing pipelined through a PipelineWindow so the map
+  phase pays O(1) host syncs, not one per batch) and registers the slices
+  as spillable buffers; reduce side pulls and concatenates. Multi-process,
+  the TCP transfer server (shuffle/transport.py) moves the bytes with the
+  shuffle/compression.py codec on the wire; this plane also carries the
+  elastic-retry and AQE skew-split machinery the ICI plane does not need.
+"""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis.contracts import exec_contract
+from ..analysis.lockdep import named_lock
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..exec.spill import (OUTPUT_FOR_SHUFFLE_PRIORITY, BufferCatalog,
@@ -27,6 +40,73 @@ from ..plan.physical import (Partition, TpuExec, bind_refs, concat_batches,
 from ..exec.tracing import trace_span
 from .partitioning import (HashPartitioner, RoundRobinPartitioner,
                            SinglePartitioner, TpuPartitioner)
+
+
+# ---------------------------------------------------------------------------
+# Process-lifetime plane totals (service/telemetry harvest): which plane
+# exchanges actually took, how many bytes each moved, and how long — the
+# numbers behind the ``tpu_shuffle_gbps{plane=...}`` gauge and the bench
+# artifacts' shuffle report. Bumped once per exchange at completion
+# boundaries, never per batch.
+# ---------------------------------------------------------------------------
+
+_PLANE_TOTALS: Dict[str, float] = {
+    "ici_exchanges": 0, "dcn_exchanges": 0,
+    "ici_bytes": 0, "dcn_bytes": 0,
+    "ici_seconds": 0.0, "dcn_seconds": 0.0,
+}
+_plane_mu = named_lock("shuffle.exchange._plane_mu")
+
+
+def note_plane(plane: str, bytes_moved: int, seconds: float) -> None:
+    """Record one completed exchange on ``plane`` ('ici' | 'dcn')."""
+    with _plane_mu:
+        _PLANE_TOTALS[f"{plane}_exchanges"] += 1
+        _PLANE_TOTALS[f"{plane}_bytes"] += int(bytes_moved)
+        _PLANE_TOTALS[f"{plane}_seconds"] += float(seconds)
+
+
+def plane_totals() -> Dict[str, float]:
+    """Cumulative per-plane exchange totals for this process."""
+    with _plane_mu:
+        return dict(_PLANE_TOTALS)
+
+
+def shuffle_report(root) -> List[Dict[str, Any]]:
+    """Per-exchange shuffle accounting for an executed plan tree: which
+    plane each exchange took, bytes written/read, write/fetch seconds and
+    the resulting GB/s — the bench artifacts' per-query shuffle story."""
+    out: List[Dict[str, Any]] = []
+
+    def walk(node) -> None:
+        if isinstance(node, TpuShuffleExchangeExec):
+            m = node.metrics
+            bw = m.get("shuffleBytesWritten", 0) or 0
+            br = m.get("shuffleBytesRead", 0) or 0
+            ws = m.get("shuffleWriteTime", 0.0) or 0.0
+            fw = m.get("fetchWaitTime", 0.0) or 0.0
+            entry: Dict[str, Any] = {
+                "exec": type(node).__name__,
+                "plane": getattr(node, "plane_used", None),
+                "partitions": node.num_partitions,
+                "bytesWritten": int(bw), "bytesRead": int(br),
+                "writeTimeS": round(float(ws), 4),
+                "fetchWaitS": round(float(fw), 4),
+            }
+            # GB/s definition matches note_plane / tpu_shuffle_gbps:
+            # bytes enter the exchange ONCE (the write side) over total
+            # exchange seconds — read bytes are reported but not summed
+            # into the rate, or the same byte would count twice
+            rate = m.gbps(("shuffleBytesWritten",),
+                          ("shuffleWriteTime", "fetchWaitTime"))
+            if rate is not None:
+                entry["gbps"] = round(rate, 6)
+            out.append(entry)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(root)
+    return out
 
 
 class LocalShuffle:
@@ -44,6 +124,27 @@ class LocalShuffle:
             if piece.num_rows > 0:
                 self.slices[p].append(SpillableColumnarBatch(
                     piece, OUTPUT_FOR_SHUFFLE_PRIORITY, self.catalog))
+
+    def write_deferred(self, window, partitioner: TpuPartitioner,
+                       batch: ColumnarBatch) -> None:
+        """Pipelined map-side write: dispatch the fused device split now,
+        park the slice-sizing scalar in ``window`` (a PipelineWindow), and
+        register the slices when the batched readback lands — batch k+1's
+        split dispatches before batch k's sizing resolves, so a map phase
+        of B batches pays O(1) packed syncs instead of B blocking ones."""
+        deferred = partitioner.split_deferred(batch)
+        if deferred is None:          # nothing to defer (empty / single)
+            self.write(partitioner, batch)
+            return
+        counts, make_pieces = deferred
+
+        def land(host_counts):
+            for p, piece in enumerate(make_pieces(host_counts)):
+                if piece.num_rows > 0:
+                    self.slices[p].append(SpillableColumnarBatch(
+                        piece, OUTPUT_FOR_SHUFFLE_PRIORITY, self.catalog))
+
+        window.push(land, counts)
 
     def read(self, p: int, schema: dt.Schema) -> Partition:
         pending = self.slices[p]
@@ -109,16 +210,20 @@ class TpuShuffleExchangeExec(TpuExec):
     (GpuOverrides.scala:1920). Join exchanges stay fixed: both sides must
     keep identical partitioning."""
 
-    CONTRACT = exec_contract(schema="passthrough", partitioning="defined")
-    METRICS = exec_metrics("dataSize", "shuffleWriteTime",
-                           "shuffleFetchTime", "skewSplitPartitions",
-                           "skewSplitTasks", "coalescedPartitions",
-                           "fetchFailedRetries")
+    CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
+                             extras=("exchange_plane",))
+    METRICS = exec_metrics("dataSize", "shuffleWriteTime", "fetchWaitTime",
+                           "shuffleBytesWritten", "shuffleBytesRead",
+                           "iciExchanges", "dcnExchanges",
+                           "skewSplitPartitions", "skewSplitTasks",
+                           "coalescedPartitions", "fetchFailedRetries")
 
     def __init__(self, child: TpuExec, num_partitions: int,
                  by: Optional[List[ex.Expression]] = None,
                  adaptive_ok: bool = False,
-                 adaptive_min_bytes: Optional[int] = None):
+                 adaptive_min_bytes: Optional[int] = None,
+                 plane: str = "auto", mesh=None,
+                 split_depth: Optional[int] = None):
         super().__init__(child)
         self.num_partitions = max(1, num_partitions)
         self.by = [bind_refs(e, child.schema) for e in by] if by else None
@@ -127,6 +232,15 @@ class TpuShuffleExchangeExec(TpuExec):
         # would read global defaults, not the session's settings)
         self.adaptive_min_bytes = adaptive_min_bytes
         self.coalesced_to: Optional[int] = None    # runtime observation
+        # data-plane routing (spark.rapids.tpu.sql.shuffle.plane), also
+        # plan-time-resolved: 'auto' rides the mesh the planner handed us
+        # (None when no mesh is active or the stage is too large to stage
+        # device-resident), 'ici' forces collectives, 'dcn' forces the
+        # host/TCP path. plane_used records the runtime decision.
+        self.plane = plane
+        self.mesh = mesh
+        self.split_depth = split_depth
+        self.plane_used: Optional[str] = None
 
     @property
     def schema(self):
@@ -143,30 +257,157 @@ class TpuShuffleExchangeExec(TpuExec):
             return HashPartitioner(self.num_partitions, self.by)
         return RoundRobinPartitioner(self.num_partitions)
 
+    def _split_window_depth(self) -> int:
+        if self.split_depth is not None:
+            return max(1, int(self.split_depth))
+        from .. import config as cfg
+        return max(1, int(cfg.TpuConf().get(cfg.SHUFFLE_PIPELINE_DEPTH)))
+
     def _run_map_phase(self, shuffle) -> None:
         """Map side: split every upstream batch and register the slices,
         one task per upstream partition, drained concurrently (shared by
-        the local, distributed, and skew-split execute forms)."""
+        the local, distributed, and skew-split execute forms). Slice
+        sizing is PIPELINED: each task parks its batches' packed split
+        counts in a PipelineWindow, so the sizing readbacks land in O(1)
+        batched resolves per task instead of one blocking readback per
+        batch (the host-plane half of the device-resident shuffle)."""
+        from ..exec.pipeline import PipelineWindow
         from ..exec.tasks import run_partition_tasks
         partitioner = self._make_partitioner()
+        depth = self._split_window_depth()
+        written: List[int] = []            # per-task input bytes
+        t0 = time.perf_counter()
 
         def map_task(pid, part):
+            win = PipelineWindow(depth, metrics=self.metrics)
+            local_bytes = 0
             for batch in part:
-                shuffle.write(partitioner, batch)
-                self.metrics.inc("dataSize", batch.device_size_bytes())
+                shuffle.write_deferred(win, partitioner, batch)
+                b = batch.device_size_bytes()
+                local_bytes += b
+                self.metrics.inc("dataSize", b)
+            win.flush()
+            self.metrics.inc("shuffleBytesWritten", local_bytes)
+            written.append(local_bytes)    # GIL-atomic append
 
         with trace_span("shuffle_write", self.metrics, "shuffleWriteTime"):
             run_partition_tasks(self.children[0].execute(), map_task)
+        self.metrics.inc("dcnExchanges")
+        note_plane("dcn", sum(written), time.perf_counter() - t0)
 
     def execute(self) -> List[Partition]:
         from .manager import WorkerContext
         ctx = WorkerContext.current
+        plane = self._resolve_plane(ctx)
+        self.plane_used = plane
         if ctx is not None:
             return self._execute_distributed(ctx)
+        if plane == "ici":
+            return self._execute_ici()
         shuffle = self._shuffle = LocalShuffle(self.num_partitions)
         self._run_map_phase(shuffle)
         groups = self._reduce_groups(shuffle)
         return [self._read_group(shuffle, g) for g in groups]
+
+    # -- plane routing -------------------------------------------------------
+
+    def _ici_capable(self) -> bool:
+        """The fused ICI exchange carries flat primitive/string columns
+        (mesh._rebuild_columns' array protocol); structs and other nested
+        layouts stay on the host plane."""
+        for f in self.schema:
+            t = f.dtype
+            if dt.is_struct(t) or dt.is_map(t) or dt.is_array(t):
+                return False
+            if t.var_width and t != dt.STRING:
+                return False
+        return True
+
+    def _resolve_plane(self, ctx) -> str:
+        """'ici' or 'dcn' for THIS execution. ``auto`` takes collectives
+        exactly when the planner handed us a mesh and the shape qualifies;
+        a forced ``ici`` that cannot run is a loud error, never a silent
+        downgrade (the mesh.enabled=true contract)."""
+        plane = (self.plane or "auto").lower()
+        if plane == "dcn":
+            return "dcn"
+        forced = plane == "ici"
+        if ctx is not None:
+            # multi-process workers reach each other over DCN only; their
+            # chips are not one mesh
+            if forced:
+                raise RuntimeError(
+                    "spark.rapids.tpu.sql.shuffle.plane=ici is invalid "
+                    "under a multi-process WorkerContext: peer chips are "
+                    "not one ICI mesh — use auto or dcn")
+            return "dcn"
+        if self.mesh is None or int(self.mesh.devices.size) < 2:
+            if forced:
+                raise RuntimeError(
+                    "spark.rapids.tpu.sql.shuffle.plane=ici but no device "
+                    "mesh is active (spark.rapids.tpu.sql.mesh.enabled)")
+            return "dcn"
+        if self.num_partitions == 1:
+            return "dcn"          # single sink: nothing to exchange
+        if not self._ici_capable():
+            if forced:
+                raise RuntimeError(
+                    "spark.rapids.tpu.sql.shuffle.plane=ici but the "
+                    f"exchange schema [{self.schema}] carries nested "
+                    "columns the fused collective cannot move")
+            return "dcn"
+        return "ici"
+
+    def would_use_ici(self) -> bool:
+        """Plane this exchange WILL take if executed now (consumers like
+        the AQE skew splitter ask before running the map phase: the
+        device-resident plane has no per-slice observed sizes to split
+        on, so skew handling stays a host-plane feature)."""
+        from .manager import WorkerContext
+        return self._resolve_plane(WorkerContext.current) == "ici"
+
+    def _execute_ici(self) -> List[Partition]:
+        """Device-resident exchange: shard the child across the mesh,
+        route every row to its reduce partition's owning worker through
+        one fused ``all_to_all`` program, and slice each worker's
+        pid-sorted rows into its owned partitions. Payload bytes never
+        touch the host; the one readback is the counts array."""
+        from ..parallel import mesh as M
+        from ..parallel.mesh_exec import shard_for_mesh
+        mesh = self.mesh
+        n = int(mesh.devices.size)
+        t0 = time.perf_counter()
+        with trace_span("shuffle_write", self.metrics, "shuffleWriteTime"):
+            shards = shard_for_mesh(self.children[0], n)
+            moved = 0
+            for s in shards:
+                moved += s.device_size_bytes()
+                self.metrics.inc("dataSize", s.device_size_bytes())
+            self.metrics.inc("shuffleBytesWritten", moved)
+            partitioner = self._make_partitioner()
+            pids = [partitioner.partition_ids(s) for s in shards]
+            results = self._ici_results = M.run_partition_exchange(
+                mesh, shards, pids, self.num_partitions)
+        self.metrics.inc("iciExchanges")
+        note_plane("ici", moved, time.perf_counter() - t0)
+
+        def gen(p: int) -> Partition:
+            from ..columnar.column import bucket
+            from ..ops import kernels as K
+            cols_w, counts_w = self._ici_results[p % n]
+            count = int(counts_w[p])
+            if count <= 0:
+                return
+            offset = int(counts_w[:p].sum())
+            with trace_span("shuffle_fetch", self.metrics, "fetchWaitTime"):
+                pcap = bucket(count)
+                cols = [K.slice_column(c, offset, pcap, count)
+                        for c in cols_w]
+                out = ColumnarBatch(self.schema, cols, count)
+            self.metrics.inc("shuffleBytesRead", out.device_size_bytes())
+            yield out
+
+        return [gen(p) for p in range(self.num_partitions)]
 
     def execute_skew(self, threshold: int) -> List[List[Partition]]:
         """AQE skew-split form of :meth:`execute` (local mode): run the
@@ -182,6 +423,7 @@ class TpuShuffleExchangeExec(TpuExec):
         from .manager import WorkerContext
         assert WorkerContext.current is None, \
             "skew split is a local-mode path"
+        self.plane_used = "dcn"       # skew split is a host-plane feature
         shuffle = self._shuffle = LocalShuffle(self.num_partitions)
         self._run_map_phase(shuffle)
         out: List[List[Partition]] = []
@@ -267,8 +509,11 @@ class TpuShuffleExchangeExec(TpuExec):
         shuffle.finish_writes()
 
         def owned(p):
-            with trace_span("shuffle_fetch", self.metrics, "shuffleFetchTime"):
-                yield from shuffle.read(p, self.schema)
+            with trace_span("shuffle_fetch", self.metrics, "fetchWaitTime"):
+                for b in shuffle.read(p, self.schema):
+                    self.metrics.inc("shuffleBytesRead",
+                                     b.device_size_bytes())
+                    yield b
 
         def empty():
             return
@@ -315,7 +560,9 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..exec.spill import BufferLostError
         from .transport import ShuffleFetchError
         try:
-            batches = self._pull_group(shuffle, group)
+            with trace_span("shuffle_fetch", self.metrics, "fetchWaitTime"):
+                batches = self._count_read(
+                    self._pull_group(shuffle, group))
         except (ShuffleFetchError, BufferLostError) as e:
             if not self.children[0].subtree_deterministic():
                 # re-executing an indeterminate map stage re-partitions
@@ -329,9 +576,18 @@ class TpuShuffleExchangeExec(TpuExec):
                 "the map stage for them", group, e)
             self.metrics.inc("fetchFailedRetries")
             self._refill(shuffle, group)
-            batches = self._pull_group(shuffle, group)
+            batches = self._count_read(self._pull_group(shuffle, group))
         if batches:
             yield concat_batches(self.schema, batches)
+
+    def _count_read(self, batches: List[ColumnarBatch]
+                    ) -> List[ColumnarBatch]:
+        """Meter shuffleBytesRead AFTER a group pull succeeds: counting
+        inside the pull would leave a failed mid-group attempt's bytes in
+        the counter and re-count them on the elastic retry."""
+        for b in batches:
+            self.metrics.inc("shuffleBytesRead", b.device_size_bytes())
+        return batches
 
     def _pull_group(self, shuffle: LocalShuffle,
                     group: List[int]) -> List[ColumnarBatch]:
@@ -370,21 +626,26 @@ class TpuShuffleExchangeExec(TpuExec):
         if sh is not None:
             sh.close_pending()
             self._shuffle = None
+        if getattr(self, "_ici_results", None) is not None:
+            self._ici_results = None       # release the device arrays
 
 
 class TpuHashExchangeExec(TpuShuffleExchangeExec):
     """Hash exchange for aggregate/join key distribution (partial->final)."""
 
     CONTRACT = exec_contract(schema="passthrough", partitioning="defined",
-                             bound={"by": 0})
+                             bound={"by": 0}, extras=("exchange_plane",))
     METRICS = TpuShuffleExchangeExec.METRICS   # emits only inherited keys
 
     def __init__(self, child: TpuExec, num_partitions: int,
                  keys: List[ex.Expression], adaptive_ok: bool = False,
-                 adaptive_min_bytes: Optional[int] = None):
+                 adaptive_min_bytes: Optional[int] = None,
+                 plane: str = "auto", mesh=None,
+                 split_depth: Optional[int] = None):
         super().__init__(child, num_partitions, by=keys,
                          adaptive_ok=adaptive_ok,
-                         adaptive_min_bytes=adaptive_min_bytes)
+                         adaptive_min_bytes=adaptive_min_bytes,
+                         plane=plane, mesh=mesh, split_depth=split_depth)
 
 
 class TpuRangeExchangeExec(TpuExec):
@@ -455,10 +716,16 @@ class TpuRangeExchangeExec(TpuExec):
         partitioner = RangePartitioner(self.num_partitions, self.orders,
                                        samples)
         shuffle = self._shuffle = LocalShuffle(self.num_partitions)
+        from .. import config as cfg
+        from ..exec.pipeline import PipelineWindow
+        win = PipelineWindow(
+            max(1, int(cfg.TpuConf().get(cfg.SHUFFLE_PIPELINE_DEPTH))),
+            metrics=self.metrics)
         with trace_span("shuffle_write", self.metrics, "shuffleWriteTime"):
             for s in spillables:
-                shuffle.write(partitioner, s.get_batch())
+                shuffle.write_deferred(win, partitioner, s.get_batch())
                 s.close()
+            win.flush()
         return [shuffle.read(p, self.schema)
                 for p in range(self.num_partitions)]
 

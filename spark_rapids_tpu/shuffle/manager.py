@@ -210,6 +210,28 @@ class DistributedShuffle:
                                               piece.fetch_to_host())
         self._wrote = True
 
+    def write_deferred(self, window, partitioner,
+                       batch: ColumnarBatch) -> None:
+        """Pipelined map-side write (LocalShuffle.write_deferred's store
+        twin): the fused device split dispatches now, the slice-sizing
+        scalar parks in ``window``, and the host staging transfer runs at
+        landing — so the per-batch sizing readbacks pack into O(1)
+        resolves per map task while the store still serves host bytes."""
+        deferred = partitioner.split_deferred(batch)
+        if deferred is None:
+            self.write(partitioner, batch)
+            return
+        counts, make_pieces = deferred
+
+        def land(host_counts):
+            for p, piece in enumerate(make_pieces(host_counts)):
+                if piece.num_rows > 0:
+                    self.ctx.store.register_batch(self.shuffle_id, p,
+                                                  piece.fetch_to_host())
+            self._wrote = True  # lint: unguarded-ok single-writer flag: each task's window lands on its own thread; True is the only value ever written
+
+        window.push(land, counts)
+
     def finish_writes(self) -> None:
         self.ctx.store.mark_complete(self.shuffle_id)
 

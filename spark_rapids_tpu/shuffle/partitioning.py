@@ -12,7 +12,7 @@ hash path uses the bit-compatible Murmur3 from ops/hashing.py with Spark's
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,6 +24,40 @@ from ..ops import expressions as ex
 from ..ops import kernels as K
 from ..ops.hashing import murmur3_batch
 
+# fused map-side split kernels, keyed by (num_partitions, cap, array
+# signature): partition-id mask -> stable sort by partition -> gather of
+# every payload array -> per-partition counts, ONE compiled program per
+# shape class instead of a chain of eager dispatches per batch
+_SPLIT_FN_CACHE: Dict[tuple, Any] = {}
+
+
+def _fused_split_fn(num_partitions: int, cap: int, sig: tuple):
+    """One jitted program: (pids, live, *arrays) -> (*sorted_arrays,
+    counts). Rows sort stably by partition id (padding rows last), so
+    partition p occupies rows [offsets[p], offsets[p]+counts[p])."""
+    import jax
+
+    def fn(pids, live, *arrays):
+        pids = jnp.where(live, pids, num_partitions)      # padding last
+        order = jnp.argsort(pids, stable=True)
+        sorted_arrays = [a[order] for a in arrays]
+        counts = jnp.bincount(
+            jnp.clip(pids, 0, num_partitions),
+            length=num_partitions + 1)[:num_partitions]
+        return tuple(sorted_arrays) + (counts.astype(jnp.int32),)
+    return jax.jit(fn)
+
+
+def _split_kernel(num_partitions: int, cap: int, arrays: List[jnp.ndarray]):
+    sig = tuple((str(a.dtype), tuple(a.shape[1:])) for a in arrays)
+    key = (num_partitions, cap, sig)
+    fn = _SPLIT_FN_CACHE.get(key)
+    if fn is None:
+        if len(_SPLIT_FN_CACHE) > 256:
+            _SPLIT_FN_CACHE.clear()  # lint: unguarded-ok idempotent jit cache: a racing refill rebuilds the same function
+        fn = _SPLIT_FN_CACHE[key] = _fused_split_fn(num_partitions, cap, sig)  # lint: unguarded-ok idempotent jit cache: a racing refill rebuilds the same function
+    return fn
+
 
 class TpuPartitioner:
     num_partitions: int
@@ -32,36 +66,90 @@ class TpuPartitioner:
         """int32[cap] partition id per row (live rows)."""
         raise NotImplementedError
 
-    def split(self, batch: ColumnarBatch) -> List[ColumnarBatch]:
-        """Slice a batch into per-partition batches (contiguous_split analog:
-        one stable sort by partition id + counted slices)."""
-        if batch.num_rows == 0:
-            return [ColumnarBatch.empty(batch.schema)
-                    for _ in range(self.num_partitions)]
+    def split_deferred(self, batch: ColumnarBatch
+                       ) -> Optional[Tuple[jnp.ndarray, Callable]]:
+        """Device half of :meth:`split`, sizing readback deferred.
+
+        Dispatches the fused split kernel (partition-id hash -> stable
+        sort by partition -> counts) and returns ``(counts_device,
+        make_pieces)`` WITHOUT reading the counts back: the caller parks
+        ``counts_device`` in a :class:`~..exec.pipeline.PipelineWindow`
+        so batch k+1's split dispatches before batch k's sizing lands,
+        and calls ``make_pieces(host_counts)`` once resolved (``None``
+        host counts re-read blocking — the window's degraded-resolve
+        contract). Returns ``None`` when there is nothing to defer
+        (empty batch / single partition): the caller should fall back to
+        the blocking :meth:`split`, which is then readback-free."""
+        if batch.num_rows == 0 or self.num_partitions == 1:
+            return None
+        from ..columnar.column import StructColumn
         cap = batch.capacity
         pids = self.partition_ids(batch)
         live = batch.row_mask()
-        pids = jnp.where(live, pids, self.num_partitions)  # padding last
-        order = jnp.argsort(pids, stable=True)
-        sorted_cols = [K.gather_column(c, order) for c in batch.columns]
+        if any(isinstance(c, StructColumn) for c in batch.columns):
+            # struct payloads have a nested array layout the flat fused
+            # kernel cannot carry: sort+count eagerly, gather through the
+            # struct-aware gather (rare path; exchanges over structs)
+            pids_m = jnp.where(live, pids, self.num_partitions)
+            order = jnp.argsort(pids_m, stable=True)
+            counts = jnp.bincount(
+                jnp.clip(pids_m, 0, self.num_partitions),
+                length=self.num_partitions + 1
+            )[:self.num_partitions].astype(jnp.int32)
+            sorted_cols = [K.gather_column(c, order) for c in batch.columns]
+        else:
+            arrays = [a for c in batch.columns for a in c.arrays()]
+            outs = _split_kernel(self.num_partitions, cap, arrays)(
+                pids, live, *arrays)
+            counts = outs[-1]
+            sorted_cols = []
+            i = 0
+            for c in batch.columns:
+                n = len(c.arrays())
+                sorted_cols.append(Column(
+                    c.dtype, outs[i], outs[i + 1],
+                    outs[i + 2] if c.dtype.var_width else None,
+                    outs[i + 3] if n == 4 else None))
+                i += n
+
+        def make_pieces(host_counts) -> List[ColumnarBatch]:
+            if host_counts is None:      # degraded resolve: re-read
+                from ..analysis.sync_audit import allowed_host_transfer
+                with allowed_host_transfer("map-side split sizing"):
+                    host_counts = np.asarray(counts)  # lint: host-sync-ok map-side split sizing: degraded-resolve fallback, one readback for this batch
+            host_counts = np.asarray(host_counts).reshape(-1)
+            out: List[ColumnarBatch] = []
+            offset = 0
+            for p in range(self.num_partitions):
+                n = int(host_counts[p])
+                if n == 0:
+                    out.append(ColumnarBatch.empty(batch.schema))
+                    continue
+                pcap = bucket(n)
+                cols = [K.slice_column(c, offset, pcap, n)
+                        for c in sorted_cols]
+                out.append(ColumnarBatch(batch.schema, cols, n))
+                offset += n
+            return out
+
+        return counts, make_pieces
+
+    def split(self, batch: ColumnarBatch) -> List[ColumnarBatch]:
+        """Slice a batch into per-partition batches (contiguous_split analog:
+        one stable sort by partition id + counted slices). Blocking form:
+        the sizing readback resolves immediately — the pipelined map path
+        uses :meth:`split_deferred` instead."""
+        if batch.num_rows == 0:
+            return [ColumnarBatch.empty(batch.schema)
+                    for _ in range(self.num_partitions)]
+        deferred = self.split_deferred(batch)
+        if deferred is None:
+            return [batch]                       # single partition
+        counts, make_pieces = deferred
         from ..analysis.sync_audit import allowed_host_transfer
         with allowed_host_transfer("map-side split sizing"):
-            counts = np.asarray(jnp.bincount(  # lint: host-sync-ok map-side split sizing: one readback sizes every slice of this batch
-                jnp.clip(pids, 0, self.num_partitions),
-                length=self.num_partitions + 1))[:self.num_partitions]
-        out: List[ColumnarBatch] = []
-        offset = 0
-        for p in range(self.num_partitions):
-            n = int(counts[p])
-            if n == 0:
-                out.append(ColumnarBatch.empty(batch.schema))
-                offset += n
-                continue
-            pcap = bucket(n)
-            cols = [K.slice_column(c, offset, pcap, n) for c in sorted_cols]
-            out.append(ColumnarBatch(batch.schema, cols, n))
-            offset += n
-        return out
+            host_counts = np.asarray(counts)  # lint: host-sync-ok map-side split sizing: one readback sizes every slice of this batch
+        return make_pieces(host_counts)
 
 
 class SinglePartitioner(TpuPartitioner):
@@ -89,14 +177,30 @@ class HashPartitioner(TpuPartitioner):
         return jnp.mod(jnp.mod(h, n) + n, n)
 
 
+#: device round-robin index per (capacity, num_partitions, start%n):
+#: rebuilding arange+mod per batch re-uploads/re-dispatches an array that
+#: is a pure function of the shape class (the columnar/batch.py
+#: ``_UNPACK_CACHE`` pattern applied to pick indices)
+_RR_IDX_CACHE: Dict[Tuple[int, int, int], jnp.ndarray] = {}
+
+
 class RoundRobinPartitioner(TpuPartitioner):
     def __init__(self, num_partitions: int, start: int = 0):
         self.num_partitions = num_partitions
         self.start = start
 
     def partition_ids(self, batch: ColumnarBatch) -> jnp.ndarray:
-        idx = jnp.arange(batch.capacity, dtype=jnp.int32)
-        return jnp.mod(idx + self.start, self.num_partitions)
+        key = (batch.capacity, self.num_partitions,
+               self.start % self.num_partitions)
+        idx = _RR_IDX_CACHE.get(key)
+        if idx is None:
+            if len(_RR_IDX_CACHE) > 256:
+                _RR_IDX_CACHE.clear()  # lint: unguarded-ok idempotent device-constant cache: a racing refill recomputes the same array
+            idx = jnp.mod(
+                jnp.arange(batch.capacity, dtype=jnp.int32) + key[2],
+                self.num_partitions)
+            _RR_IDX_CACHE[key] = idx  # lint: unguarded-ok idempotent device-constant cache: a racing refill recomputes the same array
+        return idx
 
 
 class RangePartitioner(TpuPartitioner):

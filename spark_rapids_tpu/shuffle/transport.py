@@ -40,9 +40,13 @@ from .wire import (ERROR, META_REQ, META_RESP, RELEASE, XFER_CHUNK,
 
 # process-lifetime transport totals (service/telemetry harvest): client
 # instances are per-peer and short-lived, so cumulative counters live at
-# module level, bumped at buffer-completion / retry boundaries
+# module level, bumped at buffer-completion / retry boundaries. The send
+# side (bytes_sent/chunks_sent, bumped by the SERVER at send-window
+# completion) mirrors the fetch side so the telemetry shuffle gauges are
+# symmetric: a worker that serves much more than it fetches is visible.
 _TOTALS: Dict[str, int] = {"retries": 0, "bytes_fetched": 0, "chunks": 0,
-                           "bounce_misses": 0}
+                           "bounce_misses": 0,
+                           "bytes_sent": 0, "chunks_sent": 0}
 _totals_mu = named_lock("shuffle.transport._totals_mu")
 
 
@@ -52,8 +56,9 @@ def _note_total(key: str, amount: int = 1) -> None:
 
 
 def transport_totals() -> Dict[str, int]:
-    """Cumulative fetch-side transport counters across every client this
-    process created (the telemetry registry's shuffle gauges)."""
+    """Cumulative transport counters (both directions) across every
+    client/server this process created (the telemetry registry's shuffle
+    gauges)."""
     with _totals_mu:
         return dict(_TOTALS)
 
@@ -372,7 +377,11 @@ class ShuffleServer:
 
     def _send_buffers(self, conn: Connection, buffer_ids: List[int]) -> None:
         """Stream each buffer through fixed-size chunk windows
-        (BufferSendState.next windowing)."""
+        (BufferSendState.next windowing). Send-side totals bump once per
+        buffer at send-window completion (the flush-boundary rule the
+        fetch side already follows), never per chunk."""
+        sent_bytes = 0
+        sent_chunks = 0
         for bid in buffer_ids:
             try:
                 desc, payload = self.store.payload(bid)
@@ -389,7 +398,14 @@ class ShuffleServer:
                     "offset": off, "raw_len": ln,
                     "codec": self.codec.name,
                     "crc32": wire.chunk_crc(body)}, body))
-        conn.send(encode_frame(XFER_DONE, {"buffer_ids": buffer_ids}))
+            # this buffer's send window completed
+            _note_total("bytes_sent", len(payload))
+            _note_total("chunks_sent", len(ranges))
+            sent_bytes += len(payload)
+            sent_chunks += len(ranges)
+        conn.send(encode_frame(XFER_DONE, {"buffer_ids": buffer_ids,
+                                           "bytes_sent": sent_bytes,
+                                           "chunks_sent": sent_chunks}))
 
     def stop(self, join_timeout_s: float = 2.0) -> None:
         """Stop accepting and join the transport threads BOUNDED: the

@@ -27,7 +27,9 @@ META_REQ = 1       # {shuffle_id, reduce_ids[], fingerprint?}
 META_RESP = 2      # {buffers: [BufferDesc...]}
 XFER_REQ = 3       # {buffer_ids[]}
 XFER_CHUNK = 4     # {buffer_id, seq, n_chunks, offset, crc32} + payload
-XFER_DONE = 5      # {buffer_ids[]}
+XFER_DONE = 5      # {buffer_ids[], bytes_sent, chunks_sent} — the server's
+                   # send-window totals for this transfer (the client may
+                   # cross-check its reassembly; older peers omit them)
 ERROR = 6          # {message, code?}  code in {"desync", "released"}
 RELEASE = 7        # {shuffle_id, worker_id} — reduce-side done-reading ack
 

@@ -273,3 +273,65 @@ def test_shuffle_server_threads_named_and_joined():
     srv.stop()
     assert not srv._accept_thread.is_alive()
     assert srv.alive_threads() == []
+
+
+def test_lockdep_bookkeeping_reentry_shield():
+    """A GC weakref finalizer can fire INSIDE a lockdep bookkeeping
+    section (the state mutex held) and acquire engine locks — e.g. the
+    scan-cache eviction closing a spillable. Such an acquisition must
+    BYPASS lockdep (raw lock only) instead of re-entering the
+    non-reentrant state mutex and hanging the process (observed hang:
+    _evict_table -> BufferCatalog.free inside _note_acquired)."""
+    from spark_rapids_tpu.analysis import lockdep
+    prev = lockdep.lockdep_mode()
+    lockdep.refresh_mode("record")
+    try:
+        lk = lockdep.named_lock("test.shield.reentry")
+        with lockdep._mu_section():        # simulate: inside bookkeeping
+            assert lockdep._bookkeeping_busy()
+            with lk:                       # finalizer-style acquisition:
+                pass                       # must not deadlock, untracked
+            # creating a lock mid-bookkeeping must not deadlock either
+            lockdep.named_lock("test.shield.created-inside")
+        assert not lockdep._bookkeeping_busy()
+        # the shielded acquisition left no held residue and no stats...
+        assert lockdep.stats().get("test.shield.reentry",
+                                   {}).get("acquires", 0) == 0
+        with lk:                           # ...and normal tracking resumed
+            pass
+        assert lockdep.stats()["test.shield.reentry"]["acquires"] == 1
+    finally:
+        lockdep.refresh_mode(prev)
+
+
+def test_gc_finalizers_enqueue_instead_of_taking_locks():
+    """Weakref finalizers (scan-cache eviction, cache-owner close) must
+    only ENQUEUE their lock-taking cleanup: fired inline they can
+    interrupt a frame that already holds the catalog/watermark locks and
+    self-deadlock the thread. The engine drains the queue at safe
+    points (partition-task launch, scan-cache access)."""
+    from spark_rapids_tpu.exec import spill
+    from spark_rapids_tpu.plan.physical import TpuLocalScanExec as Scan
+    spill.drain_deferred_finalizers()           # start clean
+    closed = []
+
+    class FakeHandle:
+        size_bytes = 64
+
+        def close(self):
+            closed.append(True)
+
+    key = ("test-evict", ("a",), 1024)
+    with Scan._device_cache_lock:
+        Scan._DEVICE_CACHE[key] = {"h": FakeHandle()}
+        Scan._device_cache_bytes += 64
+    # the GC-callback entry point: must not close inline — the frame it
+    # interrupts may hold the very locks close() needs
+    Scan._evict_table(key)
+    assert not closed
+    with Scan._device_cache_lock:
+        assert key in Scan._DEVICE_CACHE        # still cached: deferred
+    spill.drain_deferred_finalizers()           # the safe-point drain
+    assert closed == [True]
+    with Scan._device_cache_lock:
+        assert key not in Scan._DEVICE_CACHE

@@ -107,6 +107,77 @@ def test_exchange_exec_roundtrip():
     assert sorted(rows, key=repr) == sorted(orig, key=repr)
 
 
+def test_split_deferred_matches_blocking():
+    """The fused deferred split must produce exactly the blocking
+    split's pieces once its counts resolve."""
+    b = _batch(150, seed=3)
+    p = HashPartitioner(4, [BoundReference(0, dt.INT64)])
+    blocking = p.split(b)
+    counts, make_pieces = p.split_deferred(b)
+    pieces = make_pieces(np.asarray(counts))
+    assert len(pieces) == len(blocking) == 4
+    for got, want in zip(pieces, blocking):
+        assert got.num_rows == want.num_rows
+        assert got.to_pydict() == want.to_pydict()
+
+
+def test_split_deferred_degraded_resolve_rereads():
+    """make_pieces(None) — the PipelineWindow degraded-resolve contract —
+    re-reads the counts itself and still yields correct pieces."""
+    b = _batch(80, seed=8)
+    p = HashPartitioner(3, [BoundReference(0, dt.INT64)])
+    _counts, make_pieces = p.split_deferred(b)
+    pieces = make_pieces(None)
+    assert sum(x.num_rows for x in pieces) == 80
+
+
+def test_split_deferred_through_pipeline_window():
+    """Deferred splits ride the window: pushes stay pending until the
+    depth fills, the flush lands everything, and the landed pieces
+    round-trip all rows."""
+    from spark_rapids_tpu.exec.pipeline import PipelineWindow
+    p = HashPartitioner(4, [BoundReference(0, dt.INT64)])
+    win = PipelineWindow(8)
+    landed = []
+    batches = [_batch(64, seed=s) for s in range(3)]
+    for b in batches:
+        counts, make_pieces = p.split_deferred(b)
+        win.push(lambda hc, mk=make_pieces: landed.append(mk(hc)), counts)
+    assert len(landed) == 0          # nothing resolved yet: all in flight
+    win.flush()
+    assert len(landed) == 3
+    assert win.resolves <= 2         # packed, not one readback per batch
+    got = sorted((r for pieces in landed for piece in pieces
+                  for r in zip(*[piece.to_pydict()[c]
+                                 for c in ("k", "v", "s")])), key=repr)
+    exp = sorted((r for b in batches
+                  for r in zip(*[b.to_pydict()[c]
+                                 for c in ("k", "v", "s")])), key=repr)
+    assert got == exp
+
+
+def test_single_partitioner_has_nothing_to_defer():
+    b = _batch(10)
+    assert SinglePartitioner().split_deferred(b) is None
+
+
+def test_round_robin_pick_index_cached():
+    """The device pick-index array is cached per (capacity,
+    num_partitions, start) — repeated batches reuse the same device
+    array instead of rebuilding it."""
+    from spark_rapids_tpu.shuffle.partitioning import _RR_IDX_CACHE
+    _RR_IDX_CACHE.clear()
+    p = RoundRobinPartitioner(4)
+    b1, b2 = _batch(100), _batch(100, seed=1)
+    ids1 = p.partition_ids(b1)
+    ids2 = p.partition_ids(b2)
+    assert ids1 is ids2              # same cached device array
+    assert len(_RR_IDX_CACHE) == 1
+    # a different partition count is a different cache entry
+    RoundRobinPartitioner(3).partition_ids(b1)
+    assert len(_RR_IDX_CACHE) == 2
+
+
 def test_mesh_distributed_groupby():
     """SPMD all_to_all groupby on the virtual 8-device mesh (the
     dryrun_multichip path as a unit test)."""

@@ -169,6 +169,26 @@ def test_fetch_string_columns():
     assert _rows(got[0]) == _rows(b)
 
 
+def test_transport_totals_symmetric_send_and_fetch():
+    """The server's send-side totals (bumped at send-window completion)
+    must mirror the client's fetch-side totals: over a clean loopback
+    fetch, bytes_sent == bytes_fetched and chunks_sent == chunks."""
+    from spark_rapids_tpu.shuffle.transport import transport_totals
+    before = transport_totals()
+    batches = [(r, _batch(1500, base=r * 1000)) for r in range(2)]
+    srv = _server_with(batches, chunk_bytes=2048)
+    client = loopback_client(srv)
+    got = client.fetch(7, [0, 1])
+    assert len(got) == 2
+    after = transport_totals()
+    sent_b = after["bytes_sent"] - before["bytes_sent"]
+    fetched_b = after["bytes_fetched"] - before["bytes_fetched"]
+    assert sent_b == fetched_b > 0, (sent_b, fetched_b)
+    sent_c = after["chunks_sent"] - before["chunks_sent"]
+    fetched_c = after["chunks"] - before["chunks"]
+    assert sent_c == fetched_c > 2, (sent_c, fetched_c)
+
+
 def test_inflight_throttling_tiny_window():
     """max_inflight_bytes below a single buffer still makes progress (the
     throttle always admits at least one), and many buffers complete."""
