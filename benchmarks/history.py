@@ -38,6 +38,21 @@ FAIL_PCT = 0.25
 #: same gate a pipeline-throughput regression does (docs/shuffle.md)
 SHUFFLE_GBPS = "shuffle_gbps"
 
+#: compile-time series stamped by bench.py (docs/compile.md): total
+#: first-call compile seconds of the cold engine run (COMPILE_S) and the
+#: wall seconds of a warm-restart child process replaying the same query
+#: against the same compile.cacheDir (WARM_RESTART_S). Both are
+#: lower-is-better INSIDE the otherwise higher-is-better ``bench`` kind —
+#: round_entry records the per-query direction override so the gate
+#: judges them correctly.
+COMPILE_S = "compile_s"
+WARM_RESTART_S = "warm_restart_s"
+
+#: queries whose direction flips relative to their round's
+#: ``higherIsBetter`` flag (seconds-valued series riding a throughput
+#: round): recorded per entry so old history lines stay judgeable
+INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S})
+
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)
 DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -100,11 +115,26 @@ def round_entry(kind: str, queries: Dict[str, float], *, backend: str,
         "higherIsBetter": bool(higher_is_better),
         "queries": {q: v for q, v in queries.items() if v is not None},
     }
+    inverted = sorted(q for q in entry["queries"] if q in INVERTED_QUERIES)
+    if inverted:
+        # per-query direction override (seconds series inside a
+        # throughput round): the gate flips higherIsBetter for these
+        entry["invertedQueries"] = inverted
     if error:
         entry["error"] = str(error)[:400]
     if meta:
         entry["meta"] = meta
     return entry
+
+
+def _hib_for(entry: Dict, query: str) -> bool:
+    """Effective direction for one query in one round: the round's
+    ``higherIsBetter`` flag, flipped for its ``invertedQueries``."""
+    hib = bool(entry.get("higherIsBetter", True))
+    if query in entry.get("invertedQueries", ()) or \
+            query in INVERTED_QUERIES:
+        return not hib
+    return hib
 
 
 def _clean(entry: Dict, kind: str, backend: str) -> bool:
@@ -162,7 +192,6 @@ def verdicts(history: List[Dict], entry: Dict) -> Dict[str, Dict]:
     every query reads ``excluded``."""
     kind = entry["kind"]
     backend = entry["backend"]
-    hib = entry.get("higherIsBetter", True)
     out: Dict[str, Dict] = {}
     for q, v in entry["queries"].items():
         if entry.get("degraded") or entry.get("error"):
@@ -171,6 +200,7 @@ def verdicts(history: List[Dict], entry: Dict) -> Dict[str, Dict]:
                                 "recorded, never judged or used as "
                                 "baseline"}
             continue
+        hib = _hib_for(entry, q)
         out[q] = verdict_for(v, baseline(history, kind, backend, q, hib),
                              hib)
     return out

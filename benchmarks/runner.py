@@ -25,7 +25,8 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                   concurrent_tasks: Optional[int] = None,
                   trace_dir: Optional[str] = None,
                   probe_timeout_s: float = 30.0,
-                  history_path: Optional[str] = None) -> Dict:
+                  history_path: Optional[str] = None,
+                  compile_cache_dir: Optional[str] = None) -> Dict:
     import os
     # device preflight BEFORE any engine/jax use: a dead tunnel degrades
     # this run to an explicit cpu-degraded measurement instead of hanging
@@ -50,7 +51,11 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         "true" if trace_dir else "false").config(
         # lock-order graph + per-lock wait/hold attribution on for bench
         # runs (the documented tests/bench default for analysis.lockdep)
-        "spark.rapids.tpu.sql.analysis.lockdep", "record").getOrCreate()
+        "spark.rapids.tpu.sql.analysis.lockdep", "record").config(
+        # persistent compile cache: repeated runner invocations against
+        # the same dir pay disk hits instead of cold builds
+        "spark.rapids.tpu.sql.compile.cacheDir",
+        compile_cache_dir or "").getOrCreate()
     if trace_dir:
         # defensive: --trace-dir may name a nested path that does not
         # exist yet; a failed trace write must never fail the run
@@ -126,6 +131,20 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                 # means its shapes never hit the fused cache
                 "recompiles": recompile.delta(rc0),
             }
+            # compile-time summary (exec/compile_cache): seconds this
+            # query paid building programs, split cold vs persistent-
+            # cache disk hit — with compile.cacheDir set, a repeat run
+            # should show cold == 0
+            rc = entry["recompiles"]
+            compile_summary = {
+                "coldCompiles": sum(v.get("coldCompiles", 0)
+                                    for v in rc.values()),
+                "diskHits": sum(v.get("diskHits", 0) for v in rc.values()),
+                "compileS": round(sum(v.get("compileS", 0.0)
+                                      for v in rc.values()), 4),
+            }
+            if any(compile_summary.values()):
+                entry["compile"] = compile_summary
             flags = recompile.flagged(entry["recompiles"])
             if flags:
                 entry["recompileFlags"] = flags
@@ -182,6 +201,14 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             report["queries"][name] = entry
     finally:
         session.unregister_query_listener(captures.append)
+    # run-level size-class audit (analysis/recompile.size_class_report):
+    # every compiled signature carrying a dimension that escaped the
+    # power-of-two bucket discipline, traced to the leaking ints — the
+    # "which un-bucketed dimension caused this recompile" answer
+    from spark_rapids_tpu.analysis import recompile as _recompile
+    leaks = _recompile.size_class_report()
+    if leaks:
+        report["sizeClassLeaks"] = leaks
     # run-level lockdep findings: order-inversion cycles (with both
     # acquisition stacks) and lock-held-across-transfer events
     from spark_rapids_tpu.analysis import lockdep
@@ -283,6 +310,10 @@ def main():
     ap.add_argument("--history", type=str, default=None,
                     help="bench-history JSONL for the regression gate "
                          "(default: benchmarks/reports/bench_history.jsonl)")
+    ap.add_argument("--compile-cache-dir", type=str, default=None,
+                    help="persistent compile cache directory "
+                         "(spark.rapids.tpu.sql.compile.cacheDir): repeat "
+                         "runs against the same dir pay zero cold compiles")
     args = ap.parse_args()
     report = run_benchmark(args.sf,
                            args.queries.split(",") if args.queries else None,
@@ -291,7 +322,8 @@ def main():
                            concurrent_tasks=args.concurrent_tasks,
                            trace_dir=args.trace_dir,
                            probe_timeout_s=args.probe_timeout,
-                           history_path=args.history)
+                           history_path=args.history,
+                           compile_cache_dir=args.compile_cache_dir)
     print(json.dumps(report, indent=2))
 
 

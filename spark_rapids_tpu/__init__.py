@@ -16,7 +16,12 @@ jax.config.update("jax_enable_x64", True)
 # Persistent XLA compilation cache: fused-stage programs (sort-based
 # group-bys especially) can take minutes to compile, and every fresh
 # process would otherwise pay that again. Opt out / relocate with
-# SPARK_RAPIDS_TPU_COMPILE_CACHE=off|<dir>.
+# SPARK_RAPIDS_TPU_COMPILE_CACHE=off|<dir>. This import-time default is
+# the XLA-level substrate only (>=2s compiles); setting
+# spark.rapids.tpu.sql.compile.cacheDir upgrades it to the full managed
+# cache — engine signature index, cold-vs-disk classification, compile
+# seconds metering, and persistence of EVERY program
+# (exec/compile_cache.py, docs/compile.md).
 _cache_dir = os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE", "")
 if _cache_dir.lower() != "off":
     try:

@@ -82,9 +82,10 @@ EXEC_BASE_CLASSES = {"TpuExec"}       # abstract root: no contract of its own
 # GpuMetricNames basics plus the attributed cross-cutting keys
 BASE_METRIC_KEYS = {"numOutputRows", "numOutputBatches", "opTime",
                     "hostSyncs", "recompiles", "spillBytes",
-                    "peakDeviceBytes"}
+                    "peakDeviceBytes", "compileSeconds"}
 
 PRAGMA_RE = re.compile(r"#\s*lint:\s*host-sync-ok(.*)$")
+NAKED_JIT_PRAGMA_RE = re.compile(r"#\s*lint:\s*naked-jit-ok(.*)$")
 
 
 @dataclass
@@ -212,6 +213,11 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
             out.append(LintViolation(path, line, "host-sync",
                                      f"{qual}: {msg}"))
 
+    # naked-jit (whole package): every jax.jit( call site must sit inside
+    # a _fused_fn builder — the one funnel the recompile audit and the
+    # persistent compile cache watch — or carry a reasoned pragma
+    out.extend(_check_naked_jit(tree, source, path))
+
     if rel in EXEC_MODULES:
         for node in ast.walk(tree):
             if isinstance(node, ast.ClassDef) and \
@@ -235,6 +241,90 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # concurrency.py imports LintViolation from here
     from . import concurrency
     out.extend(concurrency.lint_source(source, rel, path=path))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# naked-jit: every jax.jit( call inside a _fused_fn builder or pragma'd
+# ---------------------------------------------------------------------------
+
+class _JitVisitor(ast.NodeVisitor):
+    """Collects ``jax.jit(`` call sites with their enclosing function-name
+    stack (the builder-funnel membership check is name-based)."""
+
+    def __init__(self) -> None:
+        self.hits: List[Tuple[int, Tuple[str, ...]]] = []
+        self._stack: List[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "jit" and \
+                isinstance(f.value, ast.Name) and f.value.id == "jax":
+            self.hits.append((node.lineno, tuple(self._stack)))
+        self.generic_visit(node)
+
+
+def _fused_builder_names(tree: ast.AST) -> set:
+    """Function names passed (directly, as a bound method, or wrapped in
+    a lambda) as the builder argument of a ``_fused_fn(key, builder)``
+    call: a jax.jit inside one of these IS inside the audit funnel."""
+    names: set = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if fname != "_fused_fn":
+            continue
+        for sub in ast.walk(node.args[1]):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                names.add(sub.attr)
+    return names
+
+
+def _check_naked_jit(tree: ast.AST, source: str, path: str
+                     ) -> List[LintViolation]:
+    """``naked-jit``: a ``jax.jit(`` call site outside every _fused_fn
+    builder and without a ``# lint: naked-jit-ok <reason>`` pragma — a
+    compile the recompile audit and the persistent compile cache would
+    never see."""
+    out: List[LintViolation] = []
+    sanctioned = _fused_builder_names(tree)
+    pragmas: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = NAKED_JIT_PRAGMA_RE.search(line)
+        if m:
+            reason = m.group(1).strip()
+            if not reason:
+                out.append(LintViolation(
+                    path, i, "pragma-reason",
+                    "naked-jit-ok pragma missing its justification "
+                    "(format: `# lint: naked-jit-ok <reason>`)"))
+            pragmas[i] = reason
+    v = _JitVisitor()
+    v.visit(tree)
+    for line, stack in v.hits:
+        if any(name in sanctioned for name in stack):
+            continue
+        if any(l in pragmas and pragmas[l] for l in (line, line - 1)):
+            continue
+        out.append(LintViolation(
+            path, line, "naked-jit",
+            "jax.jit( outside a _fused_fn builder: this compile escapes "
+            "the recompile audit and the persistent compile cache — "
+            "route it through plan/physical._fused_fn (or a cache that "
+            "calls exec/compile_cache.note_build) or pragma with "
+            "`# lint: naked-jit-ok <reason>`"))
     return out
 
 

@@ -30,9 +30,16 @@ from .lockdep import named_lock
 FLAG_MIN_COMPILES = 8
 
 _lock = named_lock("analysis.recompile._lock")
-# name -> {keys: set, compiles: int, calls: int}. ``compiles`` counts
-# EVERY cache-miss build (a same-key recompile after the fused cache
-# evicts is real churn and must show), ``keys`` counts distinct shapes.
+# name -> {keys: set, compiles: int, calls: int, coldCompiles: int,
+# diskHits: int, compileS: float}. ``compiles`` counts EVERY cache-miss
+# build (a same-key recompile after the fused cache evicts is real churn
+# and must show), ``keys`` counts distinct shapes. ``coldCompiles`` vs
+# ``diskHits`` splits builds by the persistent-cache classification
+# (exec/compile_cache.classify): a disk hit loads the executable from
+# the on-disk XLA cache instead of recompiling, so a warm restart with
+# ``compile.cacheDir`` set should show coldCompiles == 0 for repeated
+# shapes. ``compileS`` accumulates first-call (compile-dominated) wall
+# seconds per family.
 _kernels: Dict[str, Dict[str, Any]] = {}
 _enabled_cache: Optional[bool] = None
 
@@ -72,12 +79,16 @@ def kernel_of(key: Any) -> str:
 
 def _ent(kernel: str) -> Dict[str, Any]:
     return _kernels.setdefault(kernel,
-                               {"keys": set(), "compiles": 0, "calls": 0})
+                               {"keys": set(), "compiles": 0, "calls": 0,
+                                "coldCompiles": 0, "diskHits": 0,
+                                "compileS": 0.0})
 
 
-def note_compile(kernel: str, key: Any) -> None:
+def note_compile(kernel: str, key: Any, kind: str = "cold") -> None:
     """Record a cache miss: a program built (new shape OR a same-key
-    rebuild after eviction — both are paid compile time)."""
+    rebuild after eviction — both are paid compile time). ``kind`` is
+    the persistent-cache classification (``cold`` build vs ``disk``
+    hit, exec/compile_cache.classify)."""
     if not _enabled():
         return
     with _lock:
@@ -85,6 +96,7 @@ def note_compile(kernel: str, key: Any) -> None:
         ent["keys"].add(key)
         ent["compiles"] += 1
         ent["calls"] += 1
+        ent["diskHits" if kind == "disk" else "coldCompiles"] += 1
     # charge the innermost open exec's metrics bag so EXPLAIN ANALYZE
     # shows which plan node paid the compile (exec/metrics attribution)
     from ..exec.metrics import attribute
@@ -103,11 +115,23 @@ def note_call(kernel: str) -> None:
         _ent(kernel)["calls"] += 1
 
 
+def note_compile_time(kernel: str, seconds: float) -> None:
+    """Accumulate one built program's first-call (compile-dominated)
+    wall seconds onto its family (exec/compile_cache.TimedFirstCall)."""
+    if not _enabled():
+        return
+    with _lock:
+        _ent(kernel)["compileS"] += float(seconds)
+
+
 def report() -> Dict[str, Dict[str, int]]:
     with _lock:
         return {k: {"compiles": v["compiles"],
                     "distinctShapes": len(v["keys"]),
-                    "calls": v["calls"]}
+                    "calls": v["calls"],
+                    "coldCompiles": v.get("coldCompiles", 0),
+                    "diskHits": v.get("diskHits", 0),
+                    "compileS": round(v.get("compileS", 0.0), 4)}
                 for k, v in sorted(_kernels.items())}
 
 
@@ -136,13 +160,93 @@ def flagged(counters: Optional[Dict[str, Dict[str, int]]] = None
     churn (same shapes rebuilt after _FUSED_CACHE clears)."""
     counters = report() if counters is None else counters
     out: Dict[str, str] = {}
+    leaks = size_class_report()
     for k, c in counters.items():
         n, calls = c["compiles"], max(c["calls"], 1)
-        if n >= FLAG_MIN_COMPILES and n * 2 >= calls:
-            out[k] = (f"{n} compiles ({c.get('distinctShapes', n)} distinct "
-                      f"shapes) over {calls} calls — compiling per batch "
-                      "shape or churning the fused cache (check capacity "
-                      "bucketing)")
+        # STRICTLY more than half the calls: the cold+hot two-iteration
+        # pattern with perfect cache reuse lands exactly at
+        # calls == 2*compiles, which is the healthy baseline the bench
+        # runner produces — only compiling beyond it is churn
+        if n >= FLAG_MIN_COMPILES and n * 2 > calls:
+            msg = (f"{n} compiles ({c.get('distinctShapes', n)} distinct "
+                   f"shapes) over {calls} calls — compiling per batch "
+                   "shape or churning the fused cache (check capacity "
+                   "bucketing)")
+            if k in leaks:
+                msg += (f"; un-bucketed dimensions in its signatures: "
+                        f"{leaks[k]['dims']}")
+            out[k] = msg
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Size-class audit: trace signatures back to un-bucketed dimensions
+# ---------------------------------------------------------------------------
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def unbucketed_dims(key: Any) -> list:
+    """Integer dimensions inside one compiled signature that escaped the
+    power-of-two size-class discipline: every shape-bearing int in a
+    fused-cache key (capacities, padded string widths, group buckets
+    ``Kb``, window frames) is supposed to be a power of two >= its
+    class minimum, so a stream of ragged batches reuses ONE program.
+    Anything >= 8 and not a power of two is a leak — the dimension that
+    made this signature distinct. Small ints (< 8) are op counts and
+    flags, not shapes; bools are flags."""
+    out = []
+
+    def walk(v):
+        if isinstance(v, bool):
+            return
+        if isinstance(v, int):
+            if v >= 8 and not _is_pow2(v):
+                out.append(v)
+            return
+        if isinstance(v, tuple):
+            for x in v:
+                walk(x)
+    walk(key)
+    return out
+
+
+#: families whose signatures legitimately carry non-power-of-two ints:
+#: scan_unpack keys hold 8-byte-aligned staging-buffer OFFSETS — sums of
+#: bucketed per-column footprints (each pow2-derived, the sum not) — so
+#: their distinctness is bounded by #tables x #cap-buckets, never by the
+#: per-batch row count the bucket discipline exists to absorb
+SIZE_CLASS_EXEMPT = ("scan_unpack",)
+
+
+def size_class_report() -> Dict[str, Dict[str, Any]]:
+    """Per-kernel-family audit of signatures carrying un-bucketed
+    dimensions: ``{family: {"dims": [ints], "signatures": n}}`` for every
+    family where at least one compiled signature leaked past the bucket
+    discipline — the 'which dimension caused this recompile' answer the
+    flag message alone cannot give."""
+    with _lock:
+        snap = {k: list(v["keys"]) for k, v in _kernels.items()}
+    out: Dict[str, Dict[str, Any]] = {}
+    for kernel, keys in sorted(snap.items()):
+        if kernel in SIZE_CLASS_EXEMPT:
+            continue
+        dims: set = set()
+        hit = 0
+        for key in keys:
+            # unkeyable per-instance builds carry id(self) in their key
+            # (FusedStage's note_compile) — a memory address is not a
+            # shape dimension
+            if isinstance(key, tuple) and "unkeyable" in [
+                    p for p in key if isinstance(p, str)]:
+                continue
+            d = unbucketed_dims(key)
+            if d:
+                hit += 1
+                dims.update(d)
+        if hit:
+            out[kernel] = {"dims": sorted(dims), "signatures": hit}
     return out
 
 

@@ -51,6 +51,18 @@ class RuntimeConf:
         # dead run needs to know which knobs moved right before it died
         from ..service.telemetry import flight_record
         flight_record("conf", key, {"value": str(value)})
+        # the audits cache their gates per process (conf reads on hot
+        # paths would defeat them); a runtime change to an analysis.* key
+        # must re-prime those caches or the first-primed value latches
+        # for the rest of the process
+        if ".analysis." in key:
+            from ..analysis import recompile, sync_audit
+            recompile.reset_cache()
+            sync_audit.reset_cache()
+        # compile.* keys reconfigure the persistent cache + donation gate
+        if ".compile." in key:
+            from ..exec import compile_cache
+            compile_cache.configure(self._session.conf)
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._session.conf.get_key(key, default)
@@ -155,6 +167,11 @@ class TpuSession:
         # and starts the scrape endpoint when telemetry.port is set
         from ..service import telemetry
         telemetry.refresh(self.conf)
+        # persistent compile cache + donation gate (compile.cacheDir /
+        # compile.donate): wires jax's on-disk compilation cache and
+        # loads the fused-program signature index; degrades gracefully
+        from ..exec import compile_cache
+        compile_cache.configure(self.conf)
 
     @classmethod
     def active(cls) -> "TpuSession":

@@ -22,8 +22,12 @@ class ColumnarBatch:
     # ``origin``: the open catalog registration (SpillableColumnarBatch)
     # that already OWNS this batch's device arrays — set by the scan device
     # cache so downstream spillable-drain layers borrow that registration
-    # instead of double-counting the same HBM under a second buffer id
-    __slots__ = ("schema", "columns", "_num_rows", "origin")
+    # instead of double-counting the same HBM under a second buffer id.
+    # ``shared``: the arrays are owned by a live catalog entry that may
+    # re-read them (set by BufferCatalog.acquire_batch) — such a batch
+    # must NEVER have its buffers donated to a fused program
+    # (exec/compile_cache donation gate; docs/compile.md)
+    __slots__ = ("schema", "columns", "_num_rows", "origin", "shared")
 
     def __init__(self, schema: dt.Schema, columns: List[Column], num_rows: int):
         assert len(schema) == len(columns), "schema/column arity mismatch"
@@ -32,6 +36,7 @@ class ColumnarBatch:
         self.schema = schema
         self.columns = columns
         self.origin = None
+        self.shared = False
         if isinstance(num_rows, (int, np.integer)):
             self._num_rows = int(num_rows)
         else:
@@ -288,6 +293,12 @@ class ColumnarBatch:
 
 _UNPACK_CACHE: Dict[tuple, Any] = {}
 
+# registered with the JIT map-pressure relief valve: each cached unpack
+# program pins a loaded executable (exec/compile_cache.jit_map_guard)
+from ..exec.compile_cache import register_program_cache as _rpc  # noqa: E402
+_rpc(_UNPACK_CACHE.clear)
+del _rpc
+
 
 def _upload_packed(hosts) -> List[Column]:
     """Pack every column's padded host arrays into one aligned uint8
@@ -310,7 +321,12 @@ def _upload_packed(hosts) -> List[Column]:
     for a, (_d, _s, off, nbytes) in zip(arrays, spec):
         buf[off:off + nbytes] = a.view(np.uint8).ravel()
 
-    key = (tuple(spec), pos)
+    from ..exec import compile_cache as _cc
+    # donate the staging buffer: the unpack is its only consumer, and at
+    # one full batch of bytes it is exactly the transient the HBM
+    # watermark blames on scans (baked into the program -> keyed)
+    donate = (0,) if _cc.donate_enabled() else ()
+    key = (tuple(spec), pos, bool(donate))
     fn = _UNPACK_CACHE.get(key)
     if fn is None:
         if len(_UNPACK_CACHE) > 256:
@@ -330,7 +346,14 @@ def _upload_packed(hosts) -> List[Column]:
                         seg.reshape(-1, npdt.itemsize), jnp.dtype(npdt))
                     outs.append(flat.reshape(shape))
             return tuple(outs)
-        fn = _UNPACK_CACHE[key] = jax.jit(unpack)
+        # audited + persisted like every _fused_fn program (the naked-jit
+        # rule: no compile escapes the recompile/compile-cache funnel)
+        _kind, wrap = _cc.note_build(("scan_unpack",) + key, "scan_unpack")
+        fn = _UNPACK_CACHE[key] = wrap(
+            jax.jit(unpack, donate_argnums=donate))  # lint: naked-jit-ok scan unpack cache: audited via compile_cache.note_build above
+    else:
+        from ..analysis import recompile as _recompile
+        _recompile.note_call("scan_unpack")
 
     dev = fn(jnp.asarray(buf))               # ONE upload + ONE dispatch
     cols: List[Column] = []

@@ -461,6 +461,27 @@ ANALYSIS_RECOMPILE_AUDIT = _conf(
     "padding); the bench runner reports per-query deltas "
     "(analysis/recompile.py)").boolean_conf.create_with_default(True)
 
+COMPILE_CACHE_DIR = _conf("spark.rapids.tpu.sql.compile.cacheDir").doc(
+    "Directory for the persistent (on-disk) XLA compilation cache plus "
+    "the engine's fused-program signature index: a fresh process serving "
+    "query shapes it has served before loads compiled executables from "
+    "disk instead of paying seconds of cold compile per shape (session "
+    "bootstrap wires jax.config.jax_compilation_cache_dir; the recompile "
+    "audit then splits builds into cold builds vs disk hits with compile "
+    "seconds per kernel family). Empty disables; an unusable directory "
+    "logs a loud warning and degrades to in-memory caching, never a "
+    "query failure (exec/compile_cache.py, docs/compile.md)"
+).string_conf.create_with_default("")
+
+COMPILE_DONATE = _conf("spark.rapids.tpu.sql.compile.donate").doc(
+    "Donate consumed batch columns to the fused programs that ingest "
+    "them (jax donate_argnums): XLA may reuse the input HBM for outputs "
+    "and frees donated buffers the moment the program consumes them, "
+    "lowering peak device bytes on multi-operator pipelines by ~one "
+    "batch per stage. Spill-store-registered and scan-cache-served "
+    "batches are never donated — their arrays are re-read through the "
+    "catalog (docs/compile.md)").boolean_conf.create_with_default(True)
+
 ANALYSIS_LOCKDEP = _conf("spark.rapids.tpu.sql.analysis.lockdep").doc(
     "Runtime lock-order tracking over the engine's named locks "
     "(analysis/lockdep.py): off, record (build the lock-order graph, log "

@@ -1,0 +1,407 @@
+"""Persistent compile cache + buffer donation gate (docs/compile.md).
+
+Compile time and device memory are managed resources at the ``_fused_fn``
+funnel (``plan/physical.py``), not side effects:
+
+* **Persistent compile cache** — ``spark.rapids.tpu.sql.compile.cacheDir``
+  points JAX's on-disk XLA compilation cache at a directory
+  (``jax.config.jax_compilation_cache_dir``) AND keeps an engine-level
+  *signature index* (one JSONL line per fused-program cache key ever
+  built) beside it. A fresh process serving query shapes it has served
+  before classifies each build as a **disk** hit (the executable loads
+  from the XLA cache instead of recompiling — the millions-of-users
+  restart scenario pays zero cold builds) versus a **cold** build, and
+  the recompile audit reports the split per kernel family with compile
+  *seconds*, not just counts. An unwritable/unusable cache dir logs a
+  loud warning and degrades to in-memory-only caching — never a query
+  failure.
+
+* **Buffer donation** — ``spark.rapids.tpu.sql.compile.donate`` (default
+  on) lets the fused programs that *consume* a batch take its column
+  arrays as donated jit arguments (``donate_argnums``): XLA may reuse
+  the input HBM for outputs and frees the rest the moment the program
+  ingests them, so peak device residency on multi-operator pipelines
+  drops by roughly one batch per pipeline stage. Spill-store-registered
+  and scan-cache-served batches are NEVER donated — their arrays are
+  owned by a catalog entry that re-reads them (``ColumnarBatch.origin``
+  / ``.shared``).
+
+First-call wall time of every freshly-built program is metered
+(compile-dominated on every real backend) into the recompile audit, the
+``tpu_compile_seconds{kind}`` telemetry histogram, and the innermost
+open exec's ``compileSeconds`` metric.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+import warnings
+from typing import Any, Optional, Set
+
+from ..analysis.lockdep import named_lock
+
+# Donating a buffer whose shape/layout XLA cannot reuse for an output
+# still FREES it the moment the program ingests it — that eager free IS
+# the point of the donation discipline, so jax's per-compile "not
+# usable" advisory is expected steady state here, not a defect signal.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+log = logging.getLogger("spark_rapids_tpu.compile")
+
+#: file (inside the cache dir) holding one JSON line per fused-program
+#: signature ever built against this cache — the engine-level index that
+#: lets a fresh process distinguish disk hits from cold builds
+INDEX_NAME = "fused_signature_index.jsonl"
+
+_lock = named_lock("exec.compile_cache._lock")
+_cache_dir: Optional[str] = None     # active persistent dir (None = off)
+_index: Set[str] = set()             # signature hashes known on disk
+_index_path: Optional[str] = None
+_writable: bool = False
+_warned_unwritable: bool = False
+_donate_cache: Optional[bool] = None
+
+
+def configure(conf=None) -> None:
+    """Prime the persistent cache + donation gate from a session conf
+    (session bootstrap; re-run by ``RuntimeConf.set`` on ``compile.*``
+    changes). Degrades gracefully: any failure to use the cache dir logs
+    a loud warning and leaves the engine on in-memory caching only."""
+    global _cache_dir, _index_path, _writable, _warned_unwritable
+    global _donate_cache
+    from .. import config as cfg
+    if conf is None:
+        conf = cfg.TpuConf()
+    try:
+        donate = bool(conf.get(cfg.COMPILE_DONATE))
+    except Exception:
+        donate = True
+    with _lock:
+        _donate_cache = donate
+    try:
+        d = str(conf.get(cfg.COMPILE_CACHE_DIR) or "").strip()
+    except Exception:
+        d = ""
+    if not d:
+        with _lock:
+            _cache_dir = None
+            _index_path = None
+            _writable = False
+            _index.clear()
+        return
+    d = os.path.abspath(os.path.expanduser(d))
+    index_path = os.path.join(d, INDEX_NAME)
+    try:
+        os.makedirs(d, exist_ok=True)
+        # probe writability up front so the first compile is not the one
+        # discovering a read-only volume
+        with open(index_path, "a"):
+            pass
+        writable = True
+    except OSError as e:
+        log.warning(
+            "compile.cacheDir %r is not usable (%s): persistent compile "
+            "cache DISABLED for this process — queries run correctly but "
+            "every restart pays full cold compiles", d, e)
+        with _lock:
+            _warned_unwritable = True
+            _cache_dir = None
+            _index_path = None
+            _writable = False
+        return
+    # point XLA's own on-disk compilation cache at the dir; each knob is
+    # best-effort (older jax lacks some, CPU backends gained support
+    # late) — a missing knob degrades that feature, never the session
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", d)
+    except Exception as e:
+        log.warning("jax compilation cache unavailable (%s): signature "
+                    "index still recorded, executables recompile", e)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            import jax
+            jax.config.update(knob, val)
+        except Exception:
+            pass
+    loaded: Set[str] = set()
+    try:
+        with open(index_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ent = json.loads(line)
+                except ValueError:
+                    continue     # torn write from a killed process
+                sig = ent.get("sig") if isinstance(ent, dict) else None
+                if sig:
+                    loaded.add(sig)
+    except OSError:
+        pass
+    with _lock:
+        _cache_dir = d
+        _index_path = index_path
+        _writable = writable
+        _index.clear()
+        _index.update(loaded)
+
+
+def reset_cache() -> None:
+    """Drop the donation-gate prime (tests; session bootstrap calls
+    :func:`configure`, which re-primes everything)."""
+    global _donate_cache
+    with _lock:
+        _donate_cache = None
+
+
+def active_dir() -> Optional[str]:
+    return _cache_dir
+
+
+def donate_enabled() -> bool:
+    """Whether consumed-batch donation is on (cached; primed eagerly by
+    :func:`configure` at session bootstrap — a lazy conf read here would
+    run on the per-batch hot path)."""
+    global _donate_cache
+    if _donate_cache is None:
+        try:
+            from .. import config as cfg
+            donate = bool(cfg.TpuConf().get(cfg.COMPILE_DONATE))
+        except Exception:
+            donate = True
+        with _lock:
+            _donate_cache = donate
+    return _donate_cache
+
+
+def sig_hash(key: Any) -> str:
+    """Stable cross-process hash of a fused-program cache key. Keys are
+    tuples of strings/ints/structural expression keys (anything carrying
+    a memory address is unkeyable and never reaches the cache), so their
+    repr is deterministic across processes."""
+    return hashlib.sha256(repr(key).encode()).hexdigest()
+
+
+def classify(key: Any) -> str:
+    """``disk`` when this signature was built against the active cache
+    dir by a previous process (XLA serves the executable from disk),
+    ``cold`` otherwise (including when no cache dir is configured)."""
+    if _cache_dir is None:
+        return "cold"
+    return "disk" if sig_hash(key) in _index else "cold"
+
+
+def record(key: Any, kernel: str) -> None:
+    """Persist one built signature into the index (idempotent; a failed
+    write warns once and stops persisting, never raises)."""
+    global _writable, _warned_unwritable
+    if _cache_dir is None or not _writable:
+        return
+    h = sig_hash(key)
+    with _lock:
+        if h in _index:
+            return
+        _index.add(h)
+        path = _index_path
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"sig": h, "kernel": kernel}) + "\n")
+    except OSError as e:
+        with _lock:
+            warn = not _warned_unwritable
+            _writable = False
+            _warned_unwritable = True
+        if warn:
+            log.warning("compile signature index %r became unwritable "
+                        "(%s): restart-classification degrades to 'cold' "
+                        "for new shapes", path, e)
+
+
+# ---------------------------------------------------------------------------
+# JIT map-pressure relief
+# ---------------------------------------------------------------------------
+#
+# Every live XLA CPU executable pins JIT code mappings, and a process has
+# a finite mmap budget (vm.max_map_count, default 65530 on Linux): a
+# long-lived engine that keeps compiling new shapes runs LLVM's mmap
+# into the wall and SEGFAULTS mid-compile — measured at maps=65520 on
+# this repo's own tier-1 suite. Bytes are not the binding resource;
+# mappings are. The relief valve below counts /proc/self/maps every few
+# builds and, past a soft fraction of the limit, clears every registered
+# program cache (fused, scan unpack, shuffle split, mesh SPMD) and GCs —
+# traffic rebuilds what it still needs (disk hits when cacheDir is set),
+# and the recompile audit reports the rebuilds honestly.
+
+#: program caches to drop under map pressure (each registers its clear)
+_PROGRAM_CACHE_CLEARS: list = []
+_RELIEF_CHECK_EVERY = 32         # builds between /proc/self/maps reads
+_RELIEF_FRACTION = 0.7           # relieve past this fraction of the limit
+_builds_since_check = 0
+_map_limit: Optional[int] = None
+_relief_count = 0
+
+
+def register_program_cache(clear_fn) -> None:
+    """Register a compiled-program cache's clear() with the relief valve
+    (module import time; the registry is append-only)."""
+    _PROGRAM_CACHE_CLEARS.append(clear_fn)
+
+
+def relief_count() -> int:
+    """How many times the valve fired this process (tests use this to
+    detect a relief landing inside a timing-sensitive window)."""
+    return _relief_count
+
+
+def _read_map_limit() -> int:
+    try:
+        with open("/proc/sys/vm/max_map_count") as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0                  # non-Linux: valve disabled
+
+
+def _map_count() -> int:
+    try:
+        with open("/proc/self/maps") as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return -1
+
+
+def jit_map_guard() -> None:
+    """Pre-compile check (TimedFirstCall first call): every
+    ``_RELIEF_CHECK_EVERY`` builds, read the process map count and
+    relieve pressure before LLVM hits the hard limit."""
+    global _builds_since_check, _map_limit, _relief_count
+    _builds_since_check += 1  # lint: unguarded-ok monotone counter; a racing lost increment only delays one check interval
+    if _builds_since_check < _RELIEF_CHECK_EVERY:
+        return
+    _builds_since_check = 0  # lint: unguarded-ok monotone counter; a racing lost increment only delays one check interval
+    if _map_limit is None:
+        _map_limit = _read_map_limit()  # lint: unguarded-ok idempotent lazy prime; a racing double read stores the same value
+    if not _map_limit:
+        return
+    n = _map_count()
+    if n < 0 or n < _RELIEF_FRACTION * _map_limit:
+        return
+    # cooldown: live plans can pin executables past our caches, so one
+    # relief may not get fully below threshold — re-firing every check
+    # interval would thrash the caches for no mapping gain
+    _builds_since_check = -(_RELIEF_CHECK_EVERY * 7)  # lint: unguarded-ok monotone counter; a racing lost write only shortens one cooldown
+    with _lock:
+        _relief_count += 1
+        count = _relief_count
+    log.warning(
+        "JIT map pressure: %d/%d process mappings — dropping %d compiled-"
+        "program caches before LLVM's mmap fails (relief #%d). Rebuilds "
+        "are %s.", n, _map_limit, len(_PROGRAM_CACHE_CLEARS), count,
+        "disk hits (compile.cacheDir set)" if _cache_dir
+        else "cold (set compile.cacheDir to make them disk hits)")
+    for clear in list(_PROGRAM_CACHE_CLEARS):
+        try:
+            clear()
+        except Exception:
+            log.exception("program-cache clear failed during map relief")
+    import gc
+    gc.collect()
+    # NOTE: deliberately NOT jax.clear_caches() here — it would also
+    # invalidate every LIVE jitted function's traced cache, turning one
+    # relief into a process-wide retrace storm. Dropping the program
+    # caches + GC releases the executables (and their mappings); the few
+    # residual per-program mappings jax's internals keep only matter
+    # after many cycles, and the next check fires again if they do.
+    try:
+        from ..service.telemetry import MetricsRegistry, flight_record
+        flight_record("jit_relief", "maps", {"maps": n, "limit": _map_limit})
+        MetricsRegistry.get().counter(
+            "tpu_jit_map_relief_total",
+            "compiled-program cache drops forced by process map-count "
+            "pressure").inc()
+    except Exception:
+        pass
+
+
+def note_compile_seconds(kernel: str, seconds: float, kind: str) -> None:
+    """Meter one program's first-call wall seconds: recompile audit
+    (per-family ``compileS``), the ``tpu_compile_seconds{kind}``
+    histogram, and the innermost open exec's ``compileSeconds``."""
+    from ..analysis import recompile
+    recompile.note_compile_time(kernel, seconds)
+    from . import metrics as em
+    em.attribute("compileSeconds", seconds)
+    try:
+        from ..service.telemetry import MetricsRegistry
+        MetricsRegistry.get().histogram(
+            "tpu_compile_seconds",
+            "first-call wall seconds of freshly built fused programs "
+            "(compile-dominated), by cold build vs persistent-cache disk "
+            "hit", kind=kind).observe(seconds)
+    except Exception:
+        pass         # telemetry must never fail a compile
+
+
+class TimedFirstCall:
+    """Wraps a freshly-built jitted program so its FIRST invocation —
+    the one that pays tracing + XLA compilation (or the disk-cache
+    load) — is timed and metered. Later calls pay one attribute check."""
+
+    __slots__ = ("_fn", "_kernel", "_kind", "_timed")
+
+    def __init__(self, fn, kernel: str, kind: str):
+        self._fn = fn
+        self._kernel = kernel
+        self._kind = kind
+        self._timed = False
+
+    def __call__(self, *args, **kwargs):
+        if self._timed:
+            return self._fn(*args, **kwargs)
+        jit_map_guard()     # relieve map pressure BEFORE the compile
+        trace = os.environ.get("SRT_COMPILE_TRACE")
+        if trace:
+            # crash-forensics breadcrumb: the last line names the program
+            # whose first call (the XLA compile) never returned; maps =
+            # /proc/self/maps entries (JIT mmap exhaustion shows here)
+            try:
+                with open("/proc/self/maps") as mf:
+                    nmaps = sum(1 for _ in mf)
+            except OSError:
+                nmaps = -1
+            with open(trace, "a") as f:
+                f.write(f"BEGIN {self._kind} {self._kernel} maps={nmaps} "
+                        f"args={[getattr(a, 'shape', a) for a in args]}\n")
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        self._timed = True
+        note_compile_seconds(self._kernel, time.perf_counter() - t0,
+                             self._kind)
+        if trace:
+            with open(trace, "a") as f:
+                f.write(f"END {self._kernel}\n")
+        return out
+
+
+def timed(fn, kernel: str, kind: str):
+    return TimedFirstCall(fn, kernel, kind)
+
+
+def note_build(key: Any, kernel: str):
+    """One-call integration for program caches OUTSIDE the ``_fused_fn``
+    funnel (mesh SPMD stages, the scan unpack cache, the shuffle split
+    cache): classify the build against the persistent index, account it
+    in the recompile audit, persist the signature, and return
+    ``(kind, wrap)`` where ``wrap(fn)`` adds first-call timing."""
+    from ..analysis import recompile
+    kind = classify(key)
+    recompile.note_compile(kernel, key, kind=kind)
+    record(key, kernel)
+    return kind, (lambda fn: TimedFirstCall(fn, kernel, kind))

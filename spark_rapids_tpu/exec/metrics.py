@@ -47,6 +47,7 @@ from typing import Iterator, Optional, Tuple
 BASE_METRICS: Tuple[str, ...] = (
     "numOutputRows", "numOutputBatches", "opTime",
     "hostSyncs", "recompiles", "spillBytes", "peakDeviceBytes",
+    "compileSeconds",
 )
 
 

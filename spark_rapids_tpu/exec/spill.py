@@ -388,7 +388,11 @@ class BufferCatalog:
                 self._note_residency()
         # device-tier rebuild happens OUTSIDE the catalog lock so concurrent
         # task threads on the (common) unspilled path never serialize here
-        return buf.get_batch()
+        batch = buf.get_batch()
+        # the catalog still owns (and may re-serve) these arrays: mark
+        # the batch so fused programs never take them as donated buffers
+        batch.shared = True
+        return batch
 
     def remove(self, buffer_id: int) -> None:
         with self._mu:

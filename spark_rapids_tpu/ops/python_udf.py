@@ -102,6 +102,51 @@ class PandasAggUDF(Expression):
         return f"{self.udf_name}({args})"
 
 
+def _split_head_rest(merged: ColumnarBatch, take: int,
+                     owned: bool = False):
+    """Head ``[0, take)`` + rest ``[take, n)`` in ONE cached fused
+    program per (schema, capacity, take-bucket, rest-bucket) shape class
+    — the ``_RR_IDX_CACHE`` discipline applied to the UDF rebatch slicer,
+    which previously re-dispatched a chain of eager gather programs per
+    column per batch for BOTH halves. Routed through ``_fused_fn`` so the
+    recompile audit and persistent compile cache see it. ``owned=True``
+    (the merged batch was built inside the rebatch loop — never a
+    caller's batch) additionally donates its buffers: the split is then
+    provably its only consumer. Returns ``(head, rest)``; ``rest`` is
+    None when nothing remains."""
+    import jax
+    from ..ops import kernels as K
+    from ..plan.physical import (_donate_argnums, _dev_count, _fused_fn,
+                                 _schema_sig)
+    schema = merged.schema
+    n = merged.num_rows
+    rest = n - take
+    head_cap = bucket(take)
+    rest_cap = bucket(max(rest, 1))
+    donate = _donate_argnums(merged, 1) if owned else ()
+    sig = ("udf_rebatch", _schema_sig(schema), merged.capacity, take,
+           head_cap, rest_cap, ("donate", bool(donate)))
+
+    def build():
+        def fn(num_rows, *arrays):
+            b = ColumnarBatch.from_flat_arrays(schema, arrays, num_rows)
+            head = [K.slice_column(c, 0, head_cap, take)
+                    for c in b.columns]
+            tail = [K.slice_column(c, take, rest_cap, num_rows - take)
+                    for c in b.columns]
+            return tuple(a for c in head + tail for a in c.arrays())
+        return jax.jit(fn, donate_argnums=donate)
+
+    outs = _fused_fn(sig, build)(_dev_count(merged),
+                                 *merged.flat_arrays())
+    nh = len(outs) // 2
+    head = ColumnarBatch.from_flat_arrays(schema, list(outs[:nh]), take)
+    if rest <= 0:
+        return head, None
+    return head, ColumnarBatch.from_flat_arrays(schema, list(outs[nh:]),
+                                                rest)
+
+
 def rebatch_iterator(batches, target_rows: int):
     """Align batch sizes to ~target_rows (RebatchingRoundoffIterator,
     GpuArrowEvalPythonExec.scala): concat small batches, slice large ones,
@@ -110,26 +155,43 @@ def rebatch_iterator(batches, target_rows: int):
     from ..ops import kernels as K
     pending: List[ColumnarBatch] = []
     pending_rows = 0
+    # True while every batch in ``pending`` was built HERE (a rest
+    # slice): only then may the split donate the merged buffers — a
+    # caller's batch must never be freed under it
+    pending_owned = False
     schema = None
     for b in batches:
         if b.num_rows == 0:
             continue
         schema = b.schema
         pending.append(b)
+        pending_owned = False
         pending_rows += b.num_rows
         while pending_rows >= target_rows:
             merged = concat_batches(schema, pending)
             take = target_rows
-            head_cols = [K.slice_column(c, 0, bucket(take), take)
-                         for c in merged.columns]
-            yield ColumnarBatch(schema, head_cols, take)
-            rest = merged.num_rows - take
-            if rest > 0:
-                rest_cols = [K.slice_column(c, take, bucket(rest), rest)
+            owned = pending_owned or all(merged is not p for p in pending)
+            try:
+                head, rest_b = _split_head_rest(merged, take, owned)
+            except Exception:
+                from ..plan.physical import _donation_consumed
+                if owned and _donation_consumed(merged):
+                    raise      # executed-and-donated: no eager re-read
+                # host-payload columns (ObjectColumn) and other
+                # untraceables keep the per-column eager slice path
+                head_cols = [K.slice_column(c, 0, bucket(take), take)
                              for c in merged.columns]
-                pending = [ColumnarBatch(schema, rest_cols, rest)]
-            else:
-                pending = []
-            pending_rows = rest
+                head = ColumnarBatch(schema, head_cols, take)
+                rest = merged.num_rows - take
+                rest_b = None
+                if rest > 0:
+                    rest_cols = [K.slice_column(c, take, bucket(rest),
+                                                rest)
+                                 for c in merged.columns]
+                    rest_b = ColumnarBatch(schema, rest_cols, rest)
+            yield head
+            pending = [rest_b] if rest_b is not None else []
+            pending_owned = rest_b is not None
+            pending_rows = rest_b.num_rows if rest_b is not None else 0
     if pending:
         yield concat_batches(schema, pending)

@@ -430,6 +430,12 @@ def _concat_fused(schema: dt.Schema, batches: List[ColumnarBatch],
     widths = tuple(
         max(int(fb[ai].shape[1]) for fb in flats_per_batch)
         if two_d[ai] else 0 for ai in range(n_arr))
+    # NO donation at this funnel: concat is called with batches whose
+    # provenance it cannot know (range-partitioner bound samples, UDF
+    # rebatch pendings, coalesce accumulations) and several callers
+    # legitimately re-read their inputs — the exec-stream ownership
+    # argument that justifies FusedStage/aggregate donation does not
+    # hold here
     sig = ("concat", _schema_sig(schema), caps, widths, out_cap)
 
     def build():
@@ -504,6 +510,19 @@ def _fusion_enabled(node) -> bool:
 # shapes): repeated queries reuse compiled stages across exec instances —
 # per-exec closures would force a recompile every query.
 _FUSED_CACHE: Dict[tuple, Any] = {}
+# Bound on retained programs. The old behavior cleared the WHOLE cache
+# past the bound — the recompile audit measured the fallout as same-key
+# REBUILDS (distinctShapes 0) on tpcds_q65 mid-corpus. Eviction now
+# drops only the oldest half (dict preserves insertion order), so the
+# working set survives; the bound itself stays moderate because every
+# retained program pins an XLA CPU executable (JIT code mappings are a
+# finite process resource, not just bytes — see the map-pressure relief
+# valve in exec/compile_cache, which this cache registers with below).
+_FUSED_CACHE_MAX = 512
+
+from ..exec.compile_cache import register_program_cache as _rpc  # noqa: E402
+_rpc(_FUSED_CACHE.clear)
+del _rpc
 
 # Cached fused programs must NOT close over an exec instance: the cache is
 # process-global, so a captured exec would pin its whole plan tree (and any
@@ -536,15 +555,67 @@ class _trace_exec:
 
 def _fused_fn(key: tuple, builder):
     from ..analysis import recompile as _recompile
+    from ..exec import compile_cache as _cc
     fn = _FUSED_CACHE.get(key)
     if fn is None:
-        if len(_FUSED_CACHE) > 256:
-            _FUSED_CACHE.clear()
-        fn = _FUSED_CACHE[key] = builder()
-        _recompile.note_compile(_recompile.kernel_of(key), key)
+        if len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+            for old in list(_FUSED_CACHE)[:_FUSED_CACHE_MAX // 2]:
+                _FUSED_CACHE.pop(old, None)
+        kernel = _recompile.kernel_of(key)
+        # classify against the persistent signature index (a 'disk' build
+        # loads its executable from the on-disk XLA cache instead of
+        # recompiling), meter the first call's compile-dominated wall
+        # seconds, and persist the signature for the next process
+        kind = _cc.classify(key)
+        fn = _FUSED_CACHE[key] = _cc.timed(builder(), kernel, kind)
+        _recompile.note_compile(kernel, key, kind=kind)
+        _cc.record(key, kernel)
     else:
+        # LRU touch (dict order = insertion order): eviction drops the
+        # oldest half, so a hot program must not age by its build date.
+        # The pop/reinsert pair is not atomic across task threads — the
+        # worst case is a racing miss rebuilding one program, which the
+        # audit then honestly counts.
+        if _FUSED_CACHE.pop(key, None) is not None:
+            _FUSED_CACHE[key] = fn
         _recompile.note_call(_recompile.kernel_of(key))
     return fn
+
+
+def _donate_argnums(batch: ColumnarBatch, start: int) -> tuple:
+    """jit argnums donating ``batch``'s flat arrays to a fused program
+    that CONSUMES the batch (XLA reuses/frees the HBM eagerly), or ()
+    when donation is off or unsafe. Safe only for exclusively-owned
+    batches: scan-cache-served (``origin``) and catalog-acquired
+    (``shared``) arrays are re-read later, and an array aliased into two
+    argument slots cannot be donated twice. The donate bit must ride the
+    fused-cache key — donation is baked into the compiled program."""
+    from ..exec import compile_cache as _cc
+    if not _cc.donate_enabled():
+        return ()
+    if batch.origin is not None or getattr(batch, "shared", False):
+        return ()
+    flat = batch.flat_arrays()
+    seen = set()
+    for a in flat:
+        if id(a) in seen:
+            return ()
+        seen.add(id(a))
+    return tuple(range(start, start + len(flat)))
+
+
+def _donation_consumed(batch: ColumnarBatch) -> bool:
+    """After a FAILED fused call: True when a donating execution already
+    deleted the batch's buffers — the eager fallback cannot re-read them,
+    so the caller must re-raise the real error instead of letting the
+    fallback crash on 'Array has been deleted'. (Trace-time failures
+    never execute, so donated inputs survive them and fallback stays
+    available — the common fusion-fallback case.)"""
+    try:
+        return any(getattr(a, "is_deleted", lambda: False)()
+                   for a in batch.flat_arrays())
+    except Exception:
+        return True
 
 
 def _schema_sig(schema: dt.Schema) -> tuple:
@@ -597,7 +668,11 @@ class FusedStage:
         self.out_schema = out_schema
         self.mode = mode
         self.broken = False
-        self._fn = None
+        # donate-bit -> jitted program: donation is baked into a compiled
+        # program, and a stream can mix donatable (fresh) batches with
+        # cache-served ones, so each stage holds up to two variants
+        self._fns: Dict[bool, Any] = {}
+        self._ekeys = None
 
     @staticmethod
     def maybe(node, exprs, in_schema, out_schema, stateful,
@@ -611,7 +686,7 @@ class FusedStage:
             return None
         return FusedStage(exprs, in_schema, out_schema, mode)
 
-    def _build(self):
+    def _build(self, donate: tuple = ()):
         import jax
 
         def run_project(num_rows, *arrays):
@@ -631,7 +706,7 @@ class FusedStage:
             return tuple(a for c in cols for a in c.arrays()) + (count,)
 
         return jax.jit(run_project if self.mode == "project"
-                       else run_filter)
+                       else run_filter, donate_argnums=donate)
 
     def __call__(self, batch: ColumnarBatch):
         """project -> ColumnarBatch | filter -> (ColumnarBatch, count) |
@@ -642,19 +717,28 @@ class FusedStage:
         from ..exec.tracing import trace_span
         try:
             from ..analysis import recompile as _recompile
-            if self._fn is None:
-                ekeys = [_expr_cache_key(e) for e in self.exprs]
+            # consumed-batch donation (exec/compile_cache): the stage's
+            # program frees/reuses the input column HBM on ingestion;
+            # cache-served batches (origin/shared) keep the plain variant
+            donate = _donate_argnums(batch, 1)
+            fn = self._fns.get(bool(donate))
+            if fn is None:
+                if self._ekeys is None:
+                    self._ekeys = [_expr_cache_key(e) for e in self.exprs]
+                ekeys = self._ekeys
                 if any(k is None for k in ekeys):
-                    self._fn = self._build()      # unkeyable: per-exec jit
+                    fn = self._build(donate)      # unkeyable: per-exec jit
                     self._kernel = f"fused_{self.mode}_unkeyable"
                     _recompile.note_compile(
-                        self._kernel, ("unkeyable", self.mode, id(self)))
+                        self._kernel,
+                        ("unkeyable", self.mode, id(self), bool(donate)))
                 else:
                     key = (self.mode, _schema_sig(self.in_schema),
-                           tuple(ekeys))
+                           tuple(ekeys), ("donate", bool(donate)))
                     self._kernel = _recompile.kernel_of(key)
                     # _fused_fn accounts this first call (compile or hit)
-                    self._fn = _fused_fn(key, self._build)
+                    fn = _fused_fn(key, lambda: self._build(donate))
+                self._fns[bool(donate)] = fn
             else:
                 # later batches bypass the cache consult: count the call
                 # here or `calls` would track stage INSTANCES, not
@@ -662,12 +746,14 @@ class FusedStage:
                 # fire spuriously for fused project/filter families
                 _recompile.note_call(self._kernel)
             with trace_span(f"fused_{self.mode}"):
-                outs = self._fn(_dev_count(batch),
-                                *batch.flat_arrays())
+                outs = fn(_dev_count(batch),
+                          *batch.flat_arrays())
         except _ScalarPredicate:
             self.broken = True
             return None
         except Exception as e:
+            if _donation_consumed(batch):
+                raise          # executed-and-donated: no eager re-read
             # host-side expression slipped through the fusable gate
             import logging
             logging.getLogger("spark_rapids_tpu.fusion").warning(
@@ -1538,6 +1624,8 @@ class TpuHashAggregateExec(TpuExec):
 
         try:
             if not self.grouping:
+                donate = _donate_argnums(batch, 1)
+
                 def build_reduce():
                     def fn(num_rows, *arrays):
                         b = ColumnarBatch.from_flat_arrays(
@@ -1547,8 +1635,10 @@ class TpuHashAggregateExec(TpuExec):
                                                       b.capacity,
                                                       live_mask=mask)
                         return tuple(a for c in aggs for a in c.arrays())
-                    return jax.jit(fn)
-                fn = _fused_fn(sig + ("reduce", cap), build_reduce)
+                    return jax.jit(fn, donate_argnums=donate)
+                fn = _fused_fn(sig + ("reduce", cap,
+                                      ("donate", bool(donate))),
+                               build_reduce)
                 with _trace_exec(self):
                     outs = fn(_dev_count(batch), *batch.flat_arrays())
                 return ("done", ColumnarBatch.from_flat_arrays(
@@ -1595,6 +1685,8 @@ class TpuHashAggregateExec(TpuExec):
 
             return self._dispatch_sort(batch, phase, sig, in_schema, cap)
         except Exception as e:
+            if _donation_consumed(batch):
+                raise          # executed-and-donated: no eager re-read
             import logging
             logging.getLogger("spark_rapids_tpu.fusion").warning(
                 "fused %s group-by fell back to eager: %s", phase, e)
@@ -1656,6 +1748,7 @@ class TpuHashAggregateExec(TpuExec):
         device-resident (no probe, no readback)."""
         import jax
         pschema = self._partial_schema()
+        donate = _donate_argnums(batch, 1)
 
         def build_sort():
             def fn(num_rows, *arrays):
@@ -1666,8 +1759,9 @@ class TpuHashAggregateExec(TpuExec):
                     keys, specs, n_eff, b.capacity, live_mask=mask)
                 flat = [a for c in ok + oa for a in c.arrays()]
                 return tuple(flat) + (ng,)
-            return jax.jit(fn)
-        fn = _fused_fn(sig + ("sort", cap), build_sort)
+            return jax.jit(fn, donate_argnums=donate)
+        fn = _fused_fn(sig + ("sort", cap, ("donate", bool(donate))),
+                       build_sort)
         with _trace_exec(self):
             outs = fn(_dev_count(batch), *batch.flat_arrays())
         pb = ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
@@ -1699,6 +1793,9 @@ class TpuHashAggregateExec(TpuExec):
             assert kind == "sortmm", kind
             return self._finish_sortmm(tok, stats)
         except Exception as e:
+            if len(tok) > 1 and isinstance(tok[1], ColumnarBatch) and \
+                    _donation_consumed(tok[1]):
+                raise          # executed-and-donated: no eager re-read
             import logging
             logging.getLogger("spark_rapids_tpu.fusion").warning(
                 "fused group-by finish fell back to eager: %s", e)
@@ -1722,6 +1819,9 @@ class TpuHashAggregateExec(TpuExec):
         if not (span + 2 <= agg_k.DENSE_MAX_SLOTS and f32_safe):
             return None
         Kb = _bucket(int(span) + 2, 128)
+        # the dense kernel is this batch's LAST consumer (the probe only
+        # read it): donate the columns so HBM frees on ingestion
+        donate = _donate_argnums(batch, 2)
 
         def build_dense():
             def fn(num_rows, rmin_d, *arrays):
@@ -1734,8 +1834,9 @@ class TpuHashAggregateExec(TpuExec):
                     extra_mask=mask)
                 flat = [a for c in ok + oa for a in c.arrays()]
                 return tuple(flat) + (ng,)
-            return jax.jit(fn)
-        fn = _fused_fn(sig + ("dense", cap, Kb), build_dense)
+            return jax.jit(fn, donate_argnums=donate)
+        fn = _fused_fn(sig + ("dense", cap, Kb, ("donate", bool(donate))),
+                       build_dense)
         with _trace_exec(self):
             outs = fn(_dev_count(batch), rmin, *batch.flat_arrays())
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
@@ -1758,6 +1859,11 @@ class TpuHashAggregateExec(TpuExec):
         # per-spec mixing below: matmul where supported (count, float
         # sum/avg), scatter-at-Kb otherwise (min/max, int sums)
         use_mm = Kb <= agg_k.MATMUL_MAX_GROUPS and f32_safe
+        # last consumer of the batch columns AND of the probe's order/
+        # starts arrays (args 1-2): donate them together
+        donate = _donate_argnums(batch, 4)
+        if donate:
+            donate = (1, 2) + donate
 
         def build_sort_kernel(Kb=Kb, use_mm=use_mm):
             def fn(num_rows, order, starts, n_eff, *arrays):
@@ -1790,8 +1896,9 @@ class TpuHashAggregateExec(TpuExec):
                     oa.append(agg_k._mask_to(agg, glive))
                 flat = [a for c in ok + oa for a in c.arrays()]
                 return tuple(flat) + (ng,)
-            return jax.jit(fn)
-        fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm),
+            return jax.jit(fn, donate_argnums=donate)
+        fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm,
+                              ("donate", bool(donate))),
                        build_sort_kernel)
         with _trace_exec(self):
             outs = fn(_dev_count(batch), order, starts,
@@ -1881,6 +1988,7 @@ class TpuHashAggregateExec(TpuExec):
             return None
         in_schema = batch.schema
         cap = batch.capacity
+        donate = _donate_argnums(batch, 1)
 
         def build():
             def fn(num_rows, *arrays):
@@ -1898,15 +2006,18 @@ class TpuHashAggregateExec(TpuExec):
                         keys, specs, num_rows, b.capacity)
                     out = node._project_results(ok, aggs, ng)
                 return tuple(out.flat_arrays()) + (ng,)
-            return jax.jit(fn)
+            return jax.jit(fn, donate_argnums=donate)
 
         try:
-            fn = _fused_fn(sig + ("final", tuple(rkeys), cap), build)
+            fn = _fused_fn(sig + ("final", tuple(rkeys), cap,
+                                  ("donate", bool(donate))), build)
             with _trace_exec(self):
                 outs = fn(_dev_count(batch), *batch.flat_arrays())
             return ColumnarBatch.from_flat_arrays(
                 self._out_schema, list(outs[:-1]), outs[-1])
         except Exception as e:
+            if _donation_consumed(batch):
+                raise          # executed-and-donated: no eager re-read
             import logging
             logging.getLogger("spark_rapids_tpu.fusion").warning(
                 "fused final group-by fell back to eager: %s", e)

@@ -69,6 +69,8 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_exec_metric_total",            # label key=<TpuMetrics key>
     "tpu_span_seconds",                 # histogram, label name=<span>
     "tpu_query_execute_seconds",        # histogram, per collect
+    "tpu_compile_seconds",              # histogram, label kind=cold|disk
+    "tpu_jit_map_relief_total",         # program-cache drops (map limit)
     "tpu_preflight_probe_seconds",
     "tpu_preflight_backend_info",       # label backend=..., value 1
     "tpu_flight_dumps_total",
@@ -815,6 +817,18 @@ def compact_snapshot() -> Dict[str, Any]:
         "shuffleBytesSent": val("tpu_shuffle_bytes_sent_total"),
         "flightEvents": val("tpu_flight_events_total"),
     }
+    # compile-time discipline (exec/compile_cache): seconds paid building
+    # programs this process, split cold build vs persistent-cache disk
+    # hit — the warm-restart story in one diffable entry
+    fam = snap.get("tpu_compile_seconds")
+    if fam and fam.get("samples"):
+        comp = {}
+        for s in fam["samples"]:
+            kind = dict(s.get("labels") or {}).get("kind", "cold")
+            comp[kind] = {"builds": s.get("count", 0),
+                          "seconds": round(s.get("sum", 0.0), 3)}
+        if comp:
+            out["compile"] = comp
     # per-plane exchange counts + GB/s (shuffle/exchange plane totals):
     # the one-line answer to "did the shuffle ride ICI, and how fast"
     try:

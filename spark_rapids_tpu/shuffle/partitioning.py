@@ -30,6 +30,13 @@ from ..ops.hashing import murmur3_batch
 # shape class instead of a chain of eager dispatches per batch
 _SPLIT_FN_CACHE: Dict[tuple, Any] = {}
 
+# registered with the JIT map-pressure relief valve
+# (exec/compile_cache.jit_map_guard): cached split programs pin loaded
+# executables
+from ..exec.compile_cache import register_program_cache as _rpc  # noqa: E402
+_rpc(_SPLIT_FN_CACHE.clear)
+del _rpc
+
 
 def _fused_split_fn(num_partitions: int, cap: int, sig: tuple):
     """One jitted program: (pids, live, *arrays) -> (*sorted_arrays,
@@ -45,6 +52,7 @@ def _fused_split_fn(num_partitions: int, cap: int, sig: tuple):
             jnp.clip(pids, 0, num_partitions),
             length=num_partitions + 1)[:num_partitions]
         return tuple(sorted_arrays) + (counts.astype(jnp.int32),)
+    # lint: naked-jit-ok map-side split builder: every call rides _split_kernel -> compile_cache.note_build (audited + persisted)
     return jax.jit(fn)
 
 
@@ -55,7 +63,15 @@ def _split_kernel(num_partitions: int, cap: int, arrays: List[jnp.ndarray]):
     if fn is None:
         if len(_SPLIT_FN_CACHE) > 256:
             _SPLIT_FN_CACHE.clear()  # lint: unguarded-ok idempotent jit cache: a racing refill rebuilds the same function
-        fn = _SPLIT_FN_CACHE[key] = _fused_split_fn(num_partitions, cap, sig)  # lint: unguarded-ok idempotent jit cache: a racing refill rebuilds the same function
+        # shuffle split compiles ride the recompile audit + persistent
+        # compile cache like every _fused_fn program
+        from ..exec import compile_cache as _cc
+        _kind, wrap = _cc.note_build(("shuffle_split",) + key,
+                                     "shuffle_split")
+        fn = _SPLIT_FN_CACHE[key] = wrap(_fused_split_fn(num_partitions, cap, sig))  # lint: unguarded-ok idempotent jit cache: a racing refill rebuilds the same function
+    else:
+        from ..analysis import recompile as _recompile
+        _recompile.note_call("shuffle_split")
     return fn
 
 

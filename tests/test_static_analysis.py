@@ -429,3 +429,45 @@ def test_generated_docs_not_stale():
         cwd=ROOT, capture_output=True, text=True, timeout=180,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# naked-jit: every jax.jit( call inside a _fused_fn builder or pragma'd
+# ---------------------------------------------------------------------------
+
+def test_rule_naked_jit_flags_escaped_compile():
+    src = ("import jax\n\ndef f(x):\n"
+           "    return jax.jit(lambda y: y + 1)(x)\n")
+    v = lint.lint_source(src, "ops/fixture.py")
+    assert "naked-jit" in _rules(v)
+    assert any("recompile audit" in x.message for x in v)
+
+
+def test_rule_naked_jit_sanctions_fused_fn_builders():
+    """A jit inside a function passed as _fused_fn's builder argument —
+    directly, as a bound method, or wrapped in a lambda — is inside the
+    audit funnel and clean."""
+    direct = ("import jax\n\ndef go(sig):\n"
+              "    def build():\n"
+              "        def fn(x):\n"
+              "            return x\n"
+              "        return jax.jit(fn)\n"
+              "    return _fused_fn(sig, build)\n")
+    assert lint.lint_source(direct, "plan/fixture.py") == []
+    wrapped = ("import jax\n\nclass Stage:\n"
+               "    def _build(self, donate):\n"
+               "        return jax.jit(lambda x: x, donate_argnums=donate)\n"
+               "    def run(self, key, donate):\n"
+               "        return _fused_fn(key, lambda: self._build(donate))\n")
+    assert lint.lint_source(wrapped, "plan/fixture.py") == []
+
+
+def test_rule_naked_jit_pragma_requires_reason():
+    ok = ("import jax\n\ndef f(x):\n"
+          "    return jax.jit(lambda y: y)(x)  "
+          "# lint: naked-jit-ok own cache audited via note_build\n")
+    assert lint.lint_source(ok, "ops/fixture.py") == []
+    bare = ("import jax\n\ndef f(x):\n"
+            "    return jax.jit(lambda y: y)(x)  # lint: naked-jit-ok\n")
+    v = lint.lint_source(bare, "ops/fixture.py")
+    assert _rules(v) == {"naked-jit", "pragma-reason"}
