@@ -134,15 +134,18 @@ def validate(sample, pd_res):
     return len(got)
 
 
-def bench_engine(sf: float, query: str, iters: int = 2):
+def bench_engine(sf: float, query: str, iters: int = 2,
+                 extra_conf=None, with_oracle: bool = True):
     """End-to-end ENGINE throughput: the query runs through the API /
-    planner / fused execution (not a hand-built kernel), timed hot after
-    one cold (compile) iteration; baseline is pandas running the same
-    query. Returns (rows/s, pandas rows/s, cold_s)."""
+    planner / fused execution (not a hand-built kernel), timed WARM (min
+    of post-cold iterations — the steady-state number the history gate
+    judges) after one cold (compile) iteration; baseline is pandas
+    running the same query. Returns (rows/s, pandas rows/s, cold_s)."""
     from benchmarks import datagen, queries as Q
     from spark_rapids_tpu.api.session import TpuSession
-    session = TpuSession.builder.config(
-        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    conf = {"spark.rapids.tpu.sql.explain": "NONE"}
+    conf.update(extra_conf or {})
+    session = TpuSession.builder.config(conf).getOrCreate()
     tables = datagen.register_tables(session, sf)
     n_rows = int(datagen.LINEITEM_PER_SF * sf)
     qfn = Q.QUERIES[query]
@@ -156,6 +159,8 @@ def bench_engine(sf: float, query: str, iters: int = 2):
         hots.append(time.perf_counter() - t0)
     hot_s = min(hots)
 
+    if not with_oracle:
+        return n_rows / hot_s, 0.0, cold_s
     # pandas oracle on the same data (single-core, like the r01 baseline)
     li = __import__("pandas").DataFrame(datagen.gen_lineitem(sf))
     t0 = time.perf_counter()
@@ -381,6 +386,23 @@ def main():
         except Exception as e:            # engine bench must not kill the line
             engine[f"engine_{q}_error"] = str(e)[:120]
 
+    # fusion A/B (ISSUE 11): warm engine q6 with the stage compiler OFF —
+    # the on/off speedup rides the history gate so a regression in what
+    # whole-stage fusion buys is judged, not just remembered
+    if "engine_q6_mrows_per_s" in engine:
+        try:
+            off_rps, _pd, _cold = bench_engine(
+                engine_sf, "q6", with_oracle=False,
+                extra_conf={"spark.rapids.tpu.sql.fusion.wholeStage":
+                            "false"})
+            engine["engine_q6_fusion_off_mrows_per_s"] = round(
+                off_rps / 1e6, 3)
+            if off_rps > 0:
+                engine["fusion_ab_q6"] = round(
+                    engine["engine_q6_mrows_per_s"] / (off_rps / 1e6), 2)
+        except Exception as e:
+            engine["fusion_ab_error"] = str(e)[:120]
+
     # shuffle-exchange throughput (ISSUE 8: shuffle GB/s + plane in every
     # bench artifact; judged by the same regression gate as the pipeline)
     shuffle = None
@@ -452,6 +474,18 @@ def main():
             v = engine.get(f"engine_{q}_mrows_per_s")
             if v is not None:
                 queries[f"engine_{q}"] = v
+        # whole-query orchestration series (ISSUE 11): the fused-microbench
+        # to warm-engine-q6 gap (lower is better — this is the ~500x of
+        # BENCH_r03) and the fusion on/off A/B speedup
+        q6 = engine.get("engine_q6_mrows_per_s")
+        if q6:
+            from benchmarks.history import WHOLE_QUERY_GAP
+            gap = line["value"] / q6
+            queries[WHOLE_QUERY_GAP] = round(gap, 3)
+            line["whole_query_gap"] = round(gap, 3)
+        if engine.get("fusion_ab_q6"):
+            from benchmarks.history import FUSION_AB_Q6
+            queries[FUSION_AB_Q6] = engine["fusion_ab_q6"]
         if shuffle and shuffle.get("shuffle_gbps"):
             # shuffle GB/s rides the same higher-is-better gate
             # (benchmarks/history.SHUFFLE_GBPS series)

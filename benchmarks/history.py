@@ -48,10 +48,20 @@ SHUFFLE_GBPS = "shuffle_gbps"
 COMPILE_S = "compile_s"
 WARM_RESTART_S = "warm_restart_s"
 
+#: whole-query orchestration series stamped by bench.py (ISSUE 11,
+#: docs/fusion.md): WHOLE_QUERY_GAP is the ratio of the fused-microbench
+#: Mrows/s to the warm engine q6 Mrows/s — the ~500x orchestration gap
+#: BENCH_r03 measured, judged as a lower-is-better series so the gate
+#: fails when whole-query throughput falls behind kernel throughput
+#: again. FUSION_AB_Q6 is the q6 fusion on/off A/B speedup (>= 1 means
+#: stage fusion pays), higher is better.
+WHOLE_QUERY_GAP = "whole_query_gap"
+FUSION_AB_Q6 = "fusion_ab_q6"
+
 #: queries whose direction flips relative to their round's
 #: ``higherIsBetter`` flag (seconds-valued series riding a throughput
 #: round): recorded per entry so old history lines stay judgeable
-INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S})
+INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP})
 
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)

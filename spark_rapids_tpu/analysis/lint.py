@@ -60,7 +60,7 @@ from typing import Dict, List, Optional, Tuple
 
 # hot-path membership by path relative to the spark_rapids_tpu package
 HOT_PATH_PREFIXES = ("ops/", "exec/", "shuffle/")
-HOT_PATH_FILES = ("plan/physical.py",)
+HOT_PATH_FILES = ("plan/physical.py", "plan/stage_compiler.py")
 
 # (relative module, enclosing qualname): sanctioned sync helpers — the
 # batched readback funnels every other site must go through
@@ -72,8 +72,8 @@ HOST_SYNC_ALLOWLIST = {
 # modules whose *Exec classes must declare a CONTRACT
 EXEC_MODULES = (
     "plan/physical.py", "plan/overrides.py", "plan/window_exec.py",
-    "shuffle/exchange.py", "io/scan.py", "io/write.py",
-    "parallel/mesh_exec.py",
+    "plan/stage_compiler.py", "shuffle/exchange.py", "io/scan.py",
+    "io/write.py", "parallel/mesh_exec.py",
 )
 EXEC_BASE_CLASSES = {"TpuExec"}       # abstract root: no contract of its own
 

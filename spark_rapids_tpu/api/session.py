@@ -76,6 +76,22 @@ class _BuilderAccessor:
         return TpuSessionBuilder()
 
 
+def _annotated_plan_lines(plan, violations) -> List[str]:
+    """Executed-plan tree with runtime metrics plus the per-node
+    annotations EXPLAIN ANALYZE renders — contract diagnostics keyed by
+    validator path, and fused-stage membership / decline reasons
+    (plan/stage_compiler.fusion_annotations). One implementation for
+    both the session-level and captured-QueryExecution renderings."""
+    by_path: Dict[str, List[str]] = {}
+    for v in violations:
+        by_path.setdefault(v.path, []).append(f"! contract: {v.message}")
+    from ..plan.stage_compiler import fusion_annotations
+    for path, notes in fusion_annotations(plan).items():
+        by_path.setdefault(path, []).extend(notes)
+    return plan.metrics_lines(
+        annotate=lambda path: list(by_path.get(path, ())))
+
+
 class QueryExecution:
     """Everything a query-execution listener receives for ONE executed
     query (the ExecutionPlanCaptureCallback analog, Plugin.scala:211-300,
@@ -107,15 +123,11 @@ class QueryExecution:
         return self._metrics_tree
 
     def explain_analyze(self) -> str:
-        """THIS query's executed plan annotated with runtime metrics and
-        its captured contract diagnostics (rendered on demand)."""
-        by_path = {}
-        for v in self.violations:
-            by_path.setdefault(v.path, []).append(v.message)
+        """THIS query's executed plan annotated with runtime metrics,
+        its captured contract diagnostics, and fused-stage membership
+        (rendered on demand)."""
         lines = ["== Executed Plan (analyzed) =="]
-        lines += self.plan.metrics_lines(
-            annotate=lambda path: [f"! contract: {m}"
-                                   for m in by_path.get(path, ())])
+        lines += _annotated_plan_lines(self.plan, self.violations)
         lines.append(
             f"query: hostSyncs={self.sync.get('hostSyncs', 0)} "
             f"spanWallS={self.spans.get('wallS', 0.0)} "
@@ -356,15 +368,13 @@ class TpuSession:
         # contract violations keyed by root->node path (the same path
         # contracts.validate_plan builds and metrics_tree(with_path=True)
         # reproduces)
-        by_path: Dict[str, List[str]] = {}
+        # annotations computed from the EXECUTED tree so runtime fusion
+        # fallbacks (stage broken -> per-op eager) show too
         ov = self._last_overrides
-        for v in getattr(ov, "last_violations", []) if ov else []:
-            by_path.setdefault(v.path, []).append(v.message)
-
         lines: List[str] = ["== Executed Plan (analyzed) =="]
-        lines += self._last_exec_plan.metrics_lines(
-            annotate=lambda path: [f"! contract: {m}"
-                                   for m in by_path.get(path, ())])
+        lines += _annotated_plan_lines(
+            self._last_exec_plan,
+            getattr(ov, "last_violations", []) if ov else [])
         rep = self.last_query_metrics()
         sync = rep.get("sync", {})
         spans = rep.get("spans", {})

@@ -164,6 +164,24 @@ class ColumnarBatch:
         return ("packed", schema, hosts, cap, n, nbytes)
 
     @staticmethod
+    def stage_prepped(prep, acquire=None):
+        """Optional host half 2 of ``from_arrow``: PACK a 'packed' prep
+        into its one contiguous staging buffer on the CALLING thread — a
+        scan prefetch thread pays the memcpy so the task thread only
+        uploads. ``acquire(nbytes)`` may return a writable window from a
+        pinned bounce-buffer arena (exec/native_alloc); the returned prep
+        then carries the window and ``upload_prepped`` force-copies to
+        device so the caller can release the window right after upload.
+        Non-'packed' preps pass through unchanged."""
+        if prep[0] != "packed":
+            return prep
+        _tag, schema, hosts, cap, n, nbytes = prep
+        spec, total, buf, window = _pack_staging(hosts, acquire)
+        layout = [(dtype, len(arrs)) for dtype, arrs in hosts]
+        return ("staged", schema, layout, spec, total, buf, window, cap, n,
+                nbytes)
+
+    @staticmethod
     def upload_prepped(prep) -> "ColumnarBatch":
         """Device half of ``from_arrow``: one packed staging upload + one
         cached unpack program (or the per-column fallback path)."""
@@ -171,6 +189,14 @@ class ColumnarBatch:
             _tag, schema, table, cap, n = prep
             cols = [Column.from_arrow(table.column(i), capacity=cap)
                     for i in range(table.num_columns)]
+            return ColumnarBatch(schema, cols, n)
+        if prep[0] == "staged":
+            (_tag, schema, layout, spec, total, buf, window, _cap, n,
+             _nbytes) = prep
+            # arena-windowed buffers force a device-owned copy: the window
+            # is released (and reused) as soon as this returns
+            cols = _unpack_staged(layout, spec, total, buf,
+                                  force_copy=window is not None)
             return ColumnarBatch(schema, cols, n)
         _tag, schema, hosts, _cap, n, _nbytes = prep
         return ColumnarBatch(schema, _upload_packed(hosts), n)
@@ -181,8 +207,16 @@ class ColumnarBatch:
         admission before the upload)."""
         if prep[0] == "packed":
             return prep[5]
+        if prep[0] == "staged":
+            return prep[9]
         table = prep[2]
         return int(getattr(table, "nbytes", 0)) * 2
+
+    @staticmethod
+    def staged_window(prep):
+        """The arena window a 'staged' prep holds (None otherwise) — the
+        scan releases it after ``upload_prepped``."""
+        return prep[6] if prep[0] == "staged" else None
 
     @staticmethod
     def empty(schema: dt.Schema, capacity: int = 128) -> "ColumnarBatch":
@@ -300,13 +334,12 @@ _rpc(_UNPACK_CACHE.clear)
 del _rpc
 
 
-def _upload_packed(hosts) -> List[Column]:
+def _pack_staging(hosts, acquire=None):
     """Pack every column's padded host arrays into one aligned uint8
-    staging buffer, upload it in a single transfer, and carve the device
-    arrays back out with one cached jitted unpack (slice + bitcast)."""
-    import jax
-    import jax.lax as lax
-
+    staging buffer. ``acquire(nbytes)`` may hand back a writable window
+    from the pinned bounce-buffer arena (exec/native_alloc) — the staging
+    tier of the streaming scan; None (or an exhausted arena) falls back
+    to a transient numpy buffer. Returns (spec, total, buf, window)."""
     arrays: List[np.ndarray] = []
     spec: List[tuple] = []        # (np dtype str, shape, offset, nbytes)
     pos = 0
@@ -317,10 +350,21 @@ def _upload_packed(hosts) -> List[Column]:
             spec.append((a.dtype.str, a.shape, pos, nbytes))
             arrays.append(a)
             pos += (nbytes + 7) & ~7          # 8-byte aligned segments
-    buf = np.zeros(pos, dtype=np.uint8)
+    window = acquire(pos) if acquire is not None else None
+    if window is not None:
+        buf = np.frombuffer(window, dtype=np.uint8, count=pos)
+        buf[:] = 0
+    else:
+        buf = np.zeros(pos, dtype=np.uint8)
     for a, (_d, _s, off, nbytes) in zip(arrays, spec):
         buf[off:off + nbytes] = a.view(np.uint8).ravel()
+    return tuple(spec), pos, buf, window
 
+
+def _unpack_program(spec, pos):
+    """The cached jitted unpack (slice + bitcast) for one staging layout."""
+    import jax
+    import jax.lax as lax
     from ..exec import compile_cache as _cc
     # donate the staging buffer: the unpack is its only consumer, and at
     # one full batch of bytes it is exactly the transient the HBM
@@ -354,14 +398,33 @@ def _upload_packed(hosts) -> List[Column]:
     else:
         from ..analysis import recompile as _recompile
         _recompile.note_call("scan_unpack")
+    return fn
 
-    dev = fn(jnp.asarray(buf))               # ONE upload + ONE dispatch
+
+def _unpack_staged(layout, spec, pos, buf, force_copy: bool) -> List[Column]:
+    """Upload one pre-packed staging buffer and carve the device columns
+    out (the device half shared by _upload_packed and 'staged' preps).
+    ``force_copy`` guarantees a device-OWNED buffer when ``buf`` views a
+    reusable arena window (jnp.asarray may alias host memory on the CPU
+    backend — an aliased window would be clobbered on reuse)."""
+    fn = _unpack_program(spec, pos)
+    src = jnp.array(buf) if force_copy else jnp.asarray(buf)
+    dev = fn(src)                            # ONE upload + ONE dispatch
     cols: List[Column] = []
     i = 0
-    for dtype, arrs in hosts:
-        cols.append(Column(dtype, *dev[i:i + len(arrs)]))
-        i += len(arrs)
+    for dtype, arity in layout:
+        cols.append(Column(dtype, *dev[i:i + arity]))
+        i += arity
     return cols
+
+
+def _upload_packed(hosts) -> List[Column]:
+    """Pack every column's padded host arrays into one aligned uint8
+    staging buffer, upload it in a single transfer, and carve the device
+    arrays back out with one cached jitted unpack (slice + bitcast)."""
+    spec, pos, buf, _window = _pack_staging(hosts)
+    layout = [(dtype, len(arrs)) for dtype, arrs in hosts]
+    return _unpack_staged(layout, spec, pos, buf, force_copy=False)
 
 
 def resolve_counts(batches: Sequence["ColumnarBatch"]) -> None:

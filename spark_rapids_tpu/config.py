@@ -339,8 +339,47 @@ MAX_STRING_BYTES = _conf("spark.rapids.tpu.sql.maxStringBytes").doc(
 ).integer_conf.create_with_default(1024)
 
 WHOLESTAGE_FUSION = _conf("spark.rapids.tpu.sql.wholeStageFusion.enabled").doc(
-    "Fuse filter/project/partial-agg pipelines into a single XLA computation "
-    "(TPU-specific; see DESIGN.md §2)").boolean_conf.create_with_default(True)
+    "MASTER fusion switch: per-operator fused programs (FusedStage and "
+    "the fused aggregate phases) into single XLA computations "
+    "(TPU-specific; see DESIGN.md §2). Off also disables the stage-level "
+    "compiler gated by fusion.wholeStage"
+).boolean_conf.create_with_default(True)
+
+FUSION_WHOLE_STAGE = _conf("spark.rapids.tpu.sql.fusion.wholeStage").doc(
+    "STAGE-level fusion (plan/stage_compiler.py, docs/fusion.md): compile "
+    "a pipeline-breaker-free operator CHAIN (scan-unpack -> filter -> "
+    "project -> partial-agg) into ONE fused program per stage instead of "
+    "one per operator — the whole-stage-codegen analog (SURVEY §3.3). "
+    "Off falls back to the per-OPERATOR fused path, which stays governed "
+    "by the master switch wholeStageFusion.enabled; per-node decline "
+    "reasons surface in EXPLAIN ANALYZE either way"
+).boolean_conf.create_with_default(True)
+
+SCAN_PREFETCH_THREADS = _conf("spark.rapids.tpu.sql.scan.prefetchThreads").doc(
+    "CPU decode/prefetch threads for the streaming file scan "
+    "(io/scan.py): background threads named tpu-scan-prefetch-N read, "
+    "decode and stage batches ahead of device upload, overlapping host "
+    "decode with device compute; joined with a bounded timeout on "
+    "shutdown (the transport-thread discipline)"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(4)
+
+BATCH_AUTOTUNE = _conf("spark.rapids.tpu.sql.batch.autotune").doc(
+    "Autotune the scan/coalesce target batch rows from the device HBM "
+    "budget and the live device watermark (service/telemetry): fused "
+    "stages run at the largest safe batch — "
+    "min(batchSizeBytes, available-HBM share) / row bytes, quantized to "
+    "a power of two (plan/stage_compiler.tuned_batch_rows, "
+    "docs/fusion.md §4). An explicitly-set reader.batchSizeRows stays a "
+    "hard cap; off reproduces the legacy bytes-derived target"
+).boolean_conf.create_with_default(True)
+
+BATCH_AUTOTUNE_MAX_ROWS = _conf(
+    "spark.rapids.tpu.sql.batch.autotuneMaxRows").doc(
+    "Ceiling on the autotuned rows-per-batch pick (fused programs "
+    "compile per capacity bucket; this bounds worst-case compile shapes "
+    "and per-batch HBM)"
+).integer_conf.check(lambda v: int(v) >= (1 << 14)
+                     ).create_with_default(1 << 23)
 
 TEST_CONF = _conf("spark.rapids.tpu.sql.test.enabled").doc(
     "Test mode: assert everything that should be on TPU is on TPU "

@@ -29,7 +29,8 @@ def _release_semaphore() -> None:
 
 
 def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
-                 depth: int = 2) -> Iterable[T]:
+                 depth: int = 2,
+                 name: str = "spark-rapids-tpu-prefetch") -> Iterable[T]:
     """Map ``fn`` over ``items`` on a background thread, keeping up to
     ``depth`` results ready ahead of the consumer — overlaps host-side
     work (arrow decode/conversion) with downstream device compute, the
@@ -64,8 +65,7 @@ def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
                 except queue.Full:
                     continue
 
-    t = threading.Thread(target=worker, daemon=True,
-                         name="spark-rapids-tpu-prefetch")
+    t = threading.Thread(target=worker, daemon=True, name=name)
     t.start()
     try:
         while True:
@@ -77,6 +77,91 @@ def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
             yield v
     finally:
         stop.set()                          # unblock the worker on early exit
+
+
+def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
+                     threads: int = 2, depth: int = 2,
+                     name: str = "tpu-prefetch") -> Iterable[T]:
+    """Map ``fn`` over ``items`` on ``threads`` named background threads
+    (``<name>-N``), yielding results in INPUT ORDER with at most ``depth``
+    completed results buffered ahead of the consumer — the multi-worker
+    generalization of :func:`prefetch_map` the streaming scan drains
+    batch-by-batch (double-buffered CPU decode overlapping device
+    compute; MultiFileCloudParquetPartitionReader's pool role).
+
+    Workers join with a bounded timeout on shutdown (the PR 4
+    transport-thread discipline); a worker exception re-raises on the
+    consumer side; closing the generator early stops the workers."""
+    import queue
+
+    items = list(items)
+    if not items:
+        return
+    threads = max(1, min(threads, len(items)))
+    # depth >= threads or in-flight workers for LATER items could hold
+    # every result slot while the next-to-yield item's worker starves on
+    # acquire (the consumer only frees slots in order)
+    depth = max(1, depth, threads)
+    idx_q: "queue.SimpleQueue[int]" = queue.SimpleQueue()
+    for i in range(len(items)):
+        idx_q.put(i)
+    results: dict = {}
+    cond = threading.Condition()  # lint: raw-lock-ok per-iterator transient coordination, dies with the generator — not shared engine state
+    state = {"next": 0}            # next index the consumer will yield
+    stop = threading.Event()
+    errs: List[BaseException] = []
+
+    def worker() -> None:
+        while not stop.is_set():
+            try:
+                i = idx_q.get_nowait()
+            except queue.Empty:
+                return
+            # window admission ordered on the CONSUMER's position: index i
+            # may compute only once i < next+depth. The worker holding the
+            # next-to-yield index always passes, so (unlike a shared
+            # semaphore, whose unfair wakeups let later-index workers
+            # starve it — a real deadlock) progress is guaranteed while
+            # buffered results stay bounded at `depth`.
+            with cond:
+                while not stop.is_set() and i >= state["next"] + depth:
+                    cond.wait(0.2)
+            if stop.is_set():
+                return
+            try:
+                res = fn(items[i])
+            except BaseException as e:   # re-raised on the consumer side
+                with cond:
+                    errs.append(e)
+                    stop.set()
+                    cond.notify_all()
+                return
+            with cond:
+                results[i] = res
+                cond.notify_all()
+
+    workers = [threading.Thread(target=worker, daemon=True,
+                                name=f"{name}-{i}")
+               for i in range(threads)]
+    for t in workers:
+        t.start()
+    try:
+        for i in range(len(items)):
+            with cond:
+                while i not in results and not errs:
+                    cond.wait(0.2)
+                if errs:
+                    raise errs[0]
+                res = results.pop(i)
+                state["next"] = i + 1
+                cond.notify_all()
+            yield res
+    finally:
+        stop.set()
+        with cond:
+            cond.notify_all()
+        for t in workers:                # bounded join on shutdown
+            t.join(timeout=5.0)
 
 
 def run_partition_tasks(parts: Sequence[Any],

@@ -1,4 +1,4 @@
-"""File scan exec with the reference's three reader strategies.
+"""File scan exec with the reference's three reader strategies, streaming.
 
 Reference: ``GpuParquetScan.scala`` — PERFILE (``ParquetPartitionReader:1451``,
 one file per batch), COALESCING (``MultiFileParquetPartitionReader:824``,
@@ -9,6 +9,17 @@ prefetch+decode for high-latency storage; pool ``MultiFileThreadPoolFactory``).
 Strategy conf: ``spark.rapids.tpu.sql.format.parquet.reader.type``
 (RapidsConf.scala:510), thread count (RapidsConf.scala:548).
 
+Streaming (ISSUE 11): no strategy materializes a whole partition before
+compute. Decode runs on named ``tpu-scan-prefetch-N`` threads
+(``spark.rapids.tpu.sql.scan.prefetchThreads``; bounded join on shutdown —
+the transport-thread discipline), batches are packed into the pinned
+bounce-buffer staging arena on the prefetch thread, and the task thread
+only performs the device upload — BEHIND semaphore admission and memory
+reservation (GpuSemaphore.scala:74: acquire after host IO, before device
+work) — while the pool decodes the next batches. Each yielded batch is
+sliced to the autotuned target rows (plan/stage_compiler.tuned_batch_rows)
+so downstream fused stages run at the largest safe capacity.
+
 Predicate pushdown: pyarrow's parquet reader prunes row groups with min/max
 stats from pushed filters — the same CPU-side ``filterBlocks`` role
 (GpuParquetScan.scala:239-297).
@@ -16,7 +27,7 @@ stats from pushed filters — the same CPU-side ``filterBlocks`` role
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
+import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 from .. import config as cfg
@@ -25,9 +36,85 @@ from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..ops import expressions as ex
 from ..plan import logical as lp
-from ..plan.physical import Partition, TpuExec, exec_metrics
+from ..plan.physical import (Partition, TpuExec, _reserve, _task_begin,
+                             exec_metrics)
 from . import expand_paths, read_file_to_arrow
 from ..exec.tracing import trace_span
+
+# pinned staging arena for scan uploads (exec/native_alloc bounce buffers):
+# prefetch threads pack decoded batches here; oversize batches fall back to
+# transient buffers (acquire returns None)
+_STAGING_LOCK = threading.Lock()
+_STAGING = None
+_STAGING_ARENA_BYTES = 128 << 20
+
+
+def _staging_acquire(nbytes: int):
+    global _STAGING
+    with _STAGING_LOCK:
+        if _STAGING is None:
+            from ..exec.native_alloc import BounceBufferManager
+            _STAGING = BounceBufferManager(_STAGING_ARENA_BYTES)
+        if nbytes > _STAGING_ARENA_BYTES // 2:
+            return None
+        return _STAGING.acquire(nbytes)
+
+
+def _staging_release(window) -> None:
+    if window is None:
+        return
+    with _STAGING_LOCK:
+        if _STAGING is not None:
+            _STAGING.release(window)
+
+
+class _StagingTracker:
+    """Owns every arena window one partition drain has acquired but not
+    yet released. Staged preps can sit buffered ahead of the consumer (in
+    the prefetch pipeline, or in a half-consumed prep list) — if the
+    drain generator is abandoned mid-stream (limit early-exit, a failing
+    sibling read), those windows would otherwise leak and permanently
+    shrink the process-global arena. ``release_all`` runs in the drain's
+    ``finally``. Windows are keyed by identity: memoryview equality
+    compares CONTENT, and two zero-filled windows are equal."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # lint: raw-lock-ok per-partition-drain transient bookkeeping, dies with the generator
+        self._open: Dict[int, Any] = {}
+        self._closed = False
+
+    def acquire(self, nbytes: int):
+        with self._lock:
+            if self._closed:
+                return None
+        w = _staging_acquire(nbytes)
+        if w is None:
+            return None
+        with self._lock:
+            if not self._closed:
+                self._open[id(w)] = w
+                return w
+        # a straggler pack thread lost the race with release_all: hand the
+        # window straight back (the prep falls back to a transient buffer)
+        _staging_release(w)
+        return None
+
+    def release(self, w) -> None:
+        if w is None:
+            return
+        with self._lock:
+            if self._open.pop(id(w), None) is None:
+                return                 # release_all already returned it
+        _staging_release(w)
+
+    def release_all(self) -> None:
+        """Terminal: returns every outstanding window and refuses new
+        acquisitions, so late prefetch-side packs cannot leak."""
+        with self._lock:
+            self._closed = True
+            ws, self._open = list(self._open.values()), {}
+        for w in ws:
+            _staging_release(w)
 
 
 def _pushdown_filters(exprs: List[ex.Expression]):
@@ -47,7 +134,9 @@ def _pushdown_filters(exprs: List[ex.Expression]):
 
 
 class TpuFileScanExec(TpuExec):
-    """GpuFileSourceScanExec / GpuBatchScanExec analog."""
+    """GpuFileSourceScanExec / GpuBatchScanExec analog: a streaming batch
+    ITERATOR — decode-ahead threads feed double-buffered staged uploads
+    overlapping device compute; partitions never materialize."""
 
     CONTRACT = exec_contract(schema="defined", partitioning="source")
     METRICS = exec_metrics("bufferTime", "tpuDecodeTime")
@@ -71,10 +160,18 @@ class TpuFileScanExec(TpuExec):
         self.reader_type = str(
             self.conf.get_key("spark.rapids.tpu.sql.format.parquet.reader.type",
                               "COALESCING")).upper()
-        self.num_threads = int(self.conf.get_key(
-            "spark.rapids.tpu.sql.format.parquet.multiThreadedRead.numThreads", 4))
+        # prefetch pool size: scan.prefetchThreads, unless the legacy
+        # parquet multiThreadedRead.numThreads was set explicitly
+        legacy_key = cfg.READER_THREADS.key
+        if legacy_key in getattr(self.conf, "_settings", {}):
+            self.num_threads = int(self.conf.get(cfg.READER_THREADS))
+        else:
+            self.num_threads = int(self.conf.get(cfg.SCAN_PREFETCH_THREADS))
         self.filters = _pushdown_filters(plan.pushed_filters) \
             if plan.fmt == "parquet" else None
+        # autotuned rows per yielded batch (docs/fusion.md §4)
+        from ..plan.stage_compiler import tuned_batch_rows
+        self.target_rows = tuned_batch_rows(self.conf, self.plan.schema)
 
     @property
     def schema(self) -> dt.Schema:
@@ -110,53 +207,122 @@ class TpuFileScanExec(TpuExec):
         self.metrics.inc("bufferTime")
         return t
 
-    def _perfile(self, f: str) -> Partition:
-        table = self._read(f)
-        if table.num_rows == 0:
-            return
+    def _preps_of(self, table, tracker: _StagingTracker) -> List[Any]:
+        """Host half for one decoded table: slice to the autotuned batch
+        rows, convert to padded numpy, and pack each slice into the pinned
+        staging arena — all CPU work, safe on a prefetch thread before the
+        task holds the semaphore."""
+        out = []
+        n = table.num_rows
+        if n == 0:
+            return out
+        step = max(1, int(self.target_rows))
+        # metered even off the task thread: the bag is thread-safe, and a
+        # decode-bound scan must still show its cost in tpuDecodeTime
         with trace_span("scan_decode", self.metrics, "tpuDecodeTime"):
-            batch = ColumnarBatch.from_arrow(table)
-        self.metrics.inc("numOutputRows", batch.num_rows)
-        self.metrics.inc("numOutputBatches")
-        yield batch
+            for pos in range(0, n, step):
+                piece = table.slice(pos, min(step, n - pos))
+                prep = ColumnarBatch.prep_from_arrow(piece)
+                out.append(ColumnarBatch.stage_prepped(prep,
+                                                       tracker.acquire))
+        return out
 
-    def _coalescing(self) -> Partition:
-        """Combine files up to the batch byte target before one upload
-        (MultiFileParquetPartitionReader's coalesce behavior)."""
-        import pyarrow as pa
-        target = self.conf.batch_size_bytes
-        pending, pending_bytes = [], 0
-        for f in self.files:
-            t = self._read(f)
-            if t.num_rows == 0:
-                continue
-            pending.append(t)
-            pending_bytes += t.nbytes
-            if pending_bytes >= target:
-                yield self._upload(pending)
-                pending, pending_bytes = [], 0
-        if pending:
-            yield self._upload(pending)
-
-    def _multithreaded(self) -> Partition:
-        """Background prefetch threads (MultiFileCloudParquetPartitionReader)."""
-        with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
-            futures = [pool.submit(self._read, f) for f in self.files]
-            for fut in futures:
-                t = fut.result()
-                if t.num_rows == 0:
-                    continue
-                yield self._upload([t])
-
-    def _upload(self, tables) -> ColumnarBatch:
-        import pyarrow as pa
-        table = tables[0] if len(tables) == 1 else \
-            pa.concat_tables(tables, promote_options="permissive")
-        with trace_span("scan_decode", self.metrics, "tpuDecodeTime"):
-            batch = ColumnarBatch.from_arrow(table)
-        self.metrics.inc("numOutputRows", batch.num_rows)
+    def _upload(self, prep, tracker: _StagingTracker) -> ColumnarBatch:
+        """Device half: admission-checked single-transfer upload of one
+        staged batch (the task-thread side of the double buffer)."""
+        _reserve(ColumnarBatch.prepped_size_bytes(prep))
+        window = ColumnarBatch.staged_window(prep)
+        try:
+            with trace_span("scan_upload", self.metrics, "tpuDecodeTime"):
+                batch = ColumnarBatch.upload_prepped(prep)
+        finally:
+            tracker.release(window)
+        self.metrics.inc("numOutputRows", batch.num_rows_raw)
         self.metrics.inc("numOutputBatches")
         return batch
+
+    def _drain(self, prep_lists, tracker: _StagingTracker) -> Partition:
+        """Yield uploaded batches from an iterator of prep lists; the
+        semaphore is taken once host-side input exists (the reference's
+        acquire-after-host-IO ordering). Abandonment at any point —
+        early-exit consumers, upstream decode errors — returns every
+        still-staged arena window."""
+        first = True
+        try:
+            for preps in prep_lists:
+                for prep in preps:
+                    if first:
+                        _task_begin()
+                        first = False
+                    yield self._upload(prep, tracker)
+        finally:
+            # stop the upstream pipeline first (ordered_prefetch joins its
+            # workers bounded), then return every still-staged window
+            close = getattr(prep_lists, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+            tracker.release_all()
+
+    def _perfile(self, f: str) -> Partition:
+        tracker = _StagingTracker()
+
+        def lists():
+            yield self._preps_of(self._read(f), tracker)
+        return self._drain(lists(), tracker)
+
+    def _coalescing(self) -> Partition:
+        """Combine small files up to the batch byte target before one
+        staged upload (MultiFileParquetPartitionReader's coalesce
+        behavior): decode runs ahead on the prefetch pool, and the
+        concat/pad/pack of each coalesced group runs on a dedicated pack
+        thread, so the task thread pays only reserve+upload; large file
+        groups stream out in autotuned-row slices."""
+        import pyarrow as pa
+        from ..exec.tasks import ordered_prefetch, prefetch_map
+        target = self.conf.batch_size_bytes
+        tracker = _StagingTracker()
+
+        def groups():
+            pending, pending_bytes = [], 0
+            for t in ordered_prefetch(self.files, self._read,
+                                      threads=self.num_threads,
+                                      depth=max(2, self.num_threads),
+                                      name="tpu-scan-prefetch"):
+                if t.num_rows == 0:
+                    continue
+                pending.append(t)
+                pending_bytes += t.nbytes
+                if pending_bytes >= target:
+                    yield pending
+                    pending, pending_bytes = [], 0
+            if pending:
+                yield pending
+
+        def pack(tables):
+            table = tables[0] if len(tables) == 1 else \
+                pa.concat_tables(tables, promote_options="permissive")
+            return self._preps_of(table, tracker)
+
+        return self._drain(
+            prefetch_map(groups(), pack, depth=2,
+                         name="tpu-scan-prefetch-pack"),
+            tracker)
+
+    def _multithreaded(self) -> Partition:
+        """Background prefetch threads (MultiFileCloudParquetPartitionReader):
+        each ``tpu-scan-prefetch-N`` worker reads, decodes AND stages one
+        file's batches; the task thread drains uploads batch-by-batch with
+        at most ~2 files of staged batches buffered ahead (double
+        buffering) — a partition is never materialized."""
+        from ..exec.tasks import ordered_prefetch
+        tracker = _StagingTracker()
+        return self._drain(ordered_prefetch(
+            self.files, lambda f: self._preps_of(self._read(f), tracker),
+            threads=self.num_threads, depth=max(2, self.num_threads),
+            name="tpu-scan-prefetch"), tracker)
 
     def _node_string(self):
         return (f"TpuFileScanExec[{self.plan.fmt}, {len(self.files)} files, "

@@ -453,7 +453,17 @@ class Overrides:
         self.last_explain = meta.explain(all_ops=(mode == "ALL"))
         if mode != "NONE" and self.last_explain:
             print(self.last_explain)
-        node = self._insert_coalesce(self._convert(meta))
+        # whole-stage fusion (plan/stage_compiler.py, docs/fusion.md):
+        # aggregate folds happen during conversion (_make_aggregate); the
+        # post-pass collapses the remaining filter/project chains into
+        # TpuWholeStageExec nodes — BEFORE coalesce insertion, so batch
+        # coalescing lands below the fused stage on the raw scan stream
+        from . import stage_compiler as sc
+        self._fusion_decisions = sc.FusionDecisions()
+        node = self._convert(meta)
+        if sc.fusion_enabled(self.conf):
+            node = sc.fuse_stages(node, self.conf, self._fusion_decisions)
+        node = self._insert_coalesce(node)
         if self.conf.get(cfg.HASH_OPTIMIZE_SORT):
             node = self._insert_hash_optimize_sorts(node)
         # plan-contract validation (analysis/contracts.py): static checks
@@ -519,16 +529,13 @@ class Overrides:
         return node
 
     def _target_batch_rows(self, schema) -> int:
-        """Rows per batch approximating the configured batchSizeBytes,
-        capped at reader.batchSizeRows: fused whole-stage programs compile
-        per capacity, and compile cost grows steeply with shape on the
-        backends measured here — streaming more, smaller batches through one
-        compiled program beats one huge batch."""
-        row_bytes = 0
-        for f in schema:
-            row_bytes += (f.dtype.byte_width or 32) + 1
-        rows = max(1 << 14, self.conf.batch_size_bytes // max(row_bytes, 1))
-        return min(rows, int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)))
+        """Rows per batch for scans and coalesce targets: the HBM-budget
+        autotuned pick (plan/stage_compiler.tuned_batch_rows — largest
+        safe batch for a fused stage; docs/fusion.md §4), or the legacy
+        batchSizeBytes-derived value capped at reader.batchSizeRows when
+        ``spark.rapids.tpu.sql.batch.autotune`` is off."""
+        from . import stage_compiler as sc
+        return sc.tuned_batch_rows(self.conf, schema)
 
     def _convert(self, meta: PlanMeta) -> ph.TpuExec:
         p = meta.plan
@@ -550,7 +557,7 @@ class Overrides:
         if isinstance(p, lp.LocalScan):
             return ph.TpuLocalScanExec(
                 p.data, p.schema,
-                batch_rows=int(self.conf.get(cfg.MAX_READER_BATCH_SIZE_ROWS)),
+                batch_rows=self._target_batch_rows(p.schema),
                 base_data=p.base_data)
         if isinstance(p, lp.FileScan):
             from ..io.scan import TpuFileScanExec
@@ -774,12 +781,22 @@ class Overrides:
                                              stats_bytes)
         if mesh_exec is not None:
             return mesh_exec
-        # fold a direct Filter child into the aggregate's fused update:
-        # the whole scan->filter->aggregate stage becomes the agg's own
-        # programs — no separate filter dispatch, compaction, or count sync
-        # per batch (DESIGN.md §2 whole-stage pipeline)
+        # fold the fusable filter/project CHAIN below the aggregate into
+        # its fused update programs: the whole scan -> filter -> project ->
+        # partial-agg stage becomes the agg's own programs — no separate
+        # per-op dispatch, compaction, or count sync per batch
+        # (plan/stage_compiler.py; docs/fusion.md). With stage fusion off,
+        # today's single-filter fold (DESIGN.md §2) is kept as-is.
+        from . import stage_compiler as sc
         pre_filter = None
-        if (isinstance(child, ph.TpuFilterExec) and
+        pre_stage = None
+        stage_members: List[str] = []
+        if sc.fusion_enabled(self.conf):
+            if not hasattr(self, "_fusion_decisions"):
+                self._fusion_decisions = sc.FusionDecisions()
+            child, pre_stage, stage_members = sc.peel_for_aggregate(
+                child, self._fusion_decisions)
+        elif (isinstance(child, ph.TpuFilterExec) and
                 child.condition.tree_fusable() and
                 not child.condition.collect(
                     lambda x: not x.side_effect_free)):
@@ -787,12 +804,24 @@ class Overrides:
             child = child.children[0]
         from ..shuffle.manager import WorkerContext
         multiworker = WorkerContext.current is not None
+        def _mark_stage(agg: ph.TpuHashAggregateExec) -> ph.TpuHashAggregateExec:
+            # EXPLAIN ANALYZE membership: the folded chain compiled into
+            # this aggregate's stage program (stage_compiler.fusion_annotations)
+            if pre_stage is not None:
+                agg._fusion_stage = self._fusion_decisions.next_stage_id()
+                agg._fusion_members = list(stage_members)
+                self._fusion_decisions.note(
+                    f"stage #{agg._fusion_stage}: "
+                    f"{'+'.join(stage_members)} folded into "
+                    f"{type(agg).__name__}[{agg.mode}]")
+            return agg
+
         if child.output_partitions > 1 or multiworker:
             from ..shuffle.exchange import (TpuHashExchangeExec,
                                             TpuShuffleExchangeExec)
-            partial = ph.TpuHashAggregateExec(child, grouping, outputs,
-                                              mode="partial",
-                                              pre_filter=pre_filter)
+            partial = _mark_stage(ph.TpuHashAggregateExec(
+                child, grouping, outputs, mode="partial",
+                pre_filter=pre_filter, pre_stage=pre_stage))
             xkw = self._exchange_kwargs(stats_bytes)
             if grouping:
                 keys = [ex.ColumnRef(f"_k{i}") for i in range(len(grouping))]
@@ -811,8 +840,9 @@ class Overrides:
             return ph.TpuHashAggregateExec(exch, grouping, outputs,
                                            mode="final",
                                            per_partition_final=True)
-        return ph.TpuHashAggregateExec(child, grouping, outputs,
-                                       pre_filter=pre_filter)
+        return _mark_stage(ph.TpuHashAggregateExec(
+            child, grouping, outputs, pre_filter=pre_filter,
+            pre_stage=pre_stage))
 
     def _convert_distinct_agg(self, p: lp.Aggregate, child: ph.TpuExec,
                               leaves: List[lp.AggregateExpression]

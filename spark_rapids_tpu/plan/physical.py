@@ -190,7 +190,8 @@ class TpuExec:
 
         for attr in ("exprs", "grouping", "aggregate_exprs", "condition",
                      "orders", "projections", "left_keys", "right_keys",
-                     "generator", "pre_filter", "window_exprs", "by"):
+                     "generator", "pre_filter", "_pre_stage_exprs",
+                     "window_exprs", "by"):
             v = getattr(self, attr, None)
             if v is None:
                 continue
@@ -1227,15 +1228,32 @@ class TpuHashAggregateExec(TpuExec):
     def __init__(self, child: TpuExec, grouping: List[ex.Expression],
                  aggregate_exprs: List[ex.Expression], mode: str = "complete",
                  per_partition_final: bool = False,
-                 pre_filter: Optional[ex.Expression] = None):
+                 pre_filter: Optional[ex.Expression] = None,
+                 pre_stage=None):
         super().__init__(child)
         self.mode = mode
-        # pre_filter: a Filter condition the planner folded into this
-        # aggregate (bound to the child schema): the update phase compacts
-        # rows inside ITS OWN fused program, eliminating the separate
-        # filter program + count sync per batch (the whole-stage
-        # scan->filter->agg pipeline of DESIGN.md §2)
+        # pre_stage: a whole filter/project CHAIN the stage compiler folded
+        # into this aggregate (plan/stage_compiler.StageChain, bound along
+        # the original operator chain): the update phase evaluates the
+        # chain and compacts via live-row mask inside ITS OWN fused
+        # program, eliminating the separate per-op programs + count syncs
+        # per batch (the whole-stage scan->filter->project->partial-agg
+        # pipeline; docs/fusion.md). ``pre_filter`` is the legacy
+        # single-condition form and converts to a one-step chain.
+        if pre_stage is None and pre_filter is not None:
+            from .stage_compiler import chain_of_filter
+            pre_stage = chain_of_filter(pre_filter, child.schema)
+        self.pre_stage = pre_stage
+        # back-compat view: the folded condition when the chain is exactly
+        # one filter (planner tests and repr key off it)
         self.pre_filter = pre_filter
+        if pre_filter is None and pre_stage is not None and \
+                len(pre_stage.steps) == 1 and \
+                pre_stage.steps[0][0] == "filter":
+            self.pre_filter = pre_stage.steps[0][1]
+        # deterministic-subtree walk sees the chain's expressions
+        self._pre_stage_exprs = pre_stage.exprs() if pre_stage is not None \
+            else None
         # per_partition_final: the planner guarantees the child is hash-
         # partitioned on the grouping keys (an exchange directly below), so
         # each partition's groups are disjoint and the final merge runs
@@ -1258,9 +1276,14 @@ class TpuHashAggregateExec(TpuExec):
                              for i, g in enumerate(grouping)]
             self.bound_leaf_inputs = [None] * len(self.leaves)
         else:
-            self.grouping = [bind_refs(e, child.schema) for e in grouping]
+            # with a folded pre_stage the agg's inputs are the CHAIN's
+            # output rows, not the (now deeper) child's — bind against the
+            # chain output schema
+            in_schema = self.pre_stage.out_schema \
+                if self.pre_stage is not None else child.schema
+            self.grouping = [bind_refs(e, in_schema) for e in grouping]
             self.bound_leaf_inputs = [
-                bind_refs(l.children[0], child.schema) if l.children else None
+                bind_refs(l.children[0], in_schema) if l.children else None
                 for l in self.leaves]
         self._out_schema = dt.Schema([
             dt.Field(ex.output_name(e, i), e.dtype, e.nullable)
@@ -1458,7 +1481,7 @@ class TpuHashAggregateExec(TpuExec):
     def _update_partial_eager(self, batch: ColumnarBatch) -> ColumnarBatch:
         """Eager (per-op dispatch) update aggregation — the fallback when
         whole-stage fusion does not apply."""
-        batch = self._apply_pre_filter_eager(batch)
+        batch = self._apply_pre_stage_eager(batch)
         keys, specs = self._build_update_specs(batch)
         cap = batch.capacity
         if not self.grouping:
@@ -1483,38 +1506,21 @@ class TpuHashAggregateExec(TpuExec):
                 for c in batch.columns]
         return ColumnarBatch(batch.schema, cols, batch.num_rows)
 
-    def _apply_pre_filter_eager(self, batch: ColumnarBatch) -> ColumnarBatch:
-        """Eager fallback of the folded Filter (fused paths compact inside
-        their own traced programs)."""
-        if self.pre_filter is None or batch.num_rows == 0:
+    def _apply_pre_stage_eager(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Eager fallback of the folded filter/project chain (fused paths
+        evaluate the chain inside their own traced programs)."""
+        if self.pre_stage is None or batch.num_rows == 0:
             return batch
-        pred = self.pre_filter.eval(batch)
-        if isinstance(pred, Scalar):
-            if pred.value is True:
-                return batch
-            return ColumnarBatch(batch.schema, batch.columns, 0)
-        keep = pred.data & pred.validity & batch.row_mask()
-        cols, count = K.compact_columns(batch.columns, keep)
-        return ColumnarBatch(batch.schema, cols, int(count))
+        return self.pre_stage.eval_eager(batch)
 
-    def _traced_pre_filter(self, b: ColumnarBatch) -> ColumnarBatch:
-        """In-trace compaction by the folded Filter (eager fallback path —
-        the fused paths use ``_traced_filter_mask`` instead, which avoids
-        the compaction scatter entirely)."""
-        if self.pre_filter is None:
-            return b
-        keep = self._traced_filter_mask(b)
-        cols, count = K.compact_columns(b.columns, keep)
-        return ColumnarBatch(b.schema, cols, count)
-
-    def _traced_filter_mask(self, b: ColumnarBatch):
-        """Folded-Filter live-row mask (None when no filter is folded)."""
-        if self.pre_filter is None:
-            return None
-        pred = self.pre_filter.eval(b)
-        if isinstance(pred, Scalar):
-            raise _ScalarPredicate()
-        return pred.data & pred.validity & b.row_mask()
+    def _traced_pre_stage(self, b: ColumnarBatch):
+        """Folded-chain evaluation inside a fused trace: returns
+        (post-chain batch, live-row mask or None). The mask replaces
+        physical compaction — a scatter, the slowest TPU primitive — and
+        the agg kernels rank/mask dead rows for free."""
+        if self.pre_stage is None:
+            return b, None
+        return self.pre_stage.eval_traced(b)
 
     # -- whole-stage fused group-by (expression eval + kernel in <=2
     # device programs per batch; see the fusion section above) --------------
@@ -1570,19 +1576,20 @@ class TpuHashAggregateExec(TpuExec):
         # programs, and a strong self would leak the exec (+ its
         # CachedScan owners) forever
         def build_eval(b):
-            # the folded Filter becomes a LIVE-ROW MASK inside the traced
-            # program (update phase only: merge/final consume already-
-            # filtered partials); physical compaction would cost a scatter
-            # — the slowest TPU primitive — per batch, while the sort and
-            # dense kernels rank/mask dead rows for free. Returns
-            # (keys, specs, effective_row_count, live_mask); kernels must
-            # see the POST-filter count or dead rows would join the NULL
-            # group, and live_mask is None when no filter was folded.
+            # the folded filter/project CHAIN (pre_stage) evaluates inside
+            # the traced program (update phase only: merge/final consume
+            # already-filtered partials); its filters become a LIVE-ROW
+            # MASK — physical compaction would cost a scatter, the slowest
+            # TPU primitive, per batch, while the sort and dense kernels
+            # rank/mask dead rows for free. Returns (keys, specs,
+            # effective_row_count, live_mask); kernels must see the
+            # POST-filter count or dead rows would join the NULL group,
+            # and live_mask is None when the chain has no filter.
             node = _trace_exec_stack()[-1]
             n_eff = b.num_rows
             mask = None
             if phase == "update":
-                mask = node._traced_filter_mask(b)
+                b, mask = node._traced_pre_stage(b)
                 if mask is not None:
                     import jax.numpy as jnp
                     n_eff = jnp.sum(mask).astype(jnp.int32)
@@ -1604,7 +1611,7 @@ class TpuHashAggregateExec(TpuExec):
                 b is not None and not b.tree_fusable()
                 for b in self.bound_leaf_inputs):
             return None
-        if self.pre_filter is not None and not self.pre_filter.tree_fusable():
+        if self.pre_stage is not None and not self.pre_stage.fusable():
             return None
         import jax
         import jax.numpy as jnp
@@ -1614,11 +1621,11 @@ class TpuHashAggregateExec(TpuExec):
         sig = self._fusion_sig(phase, in_schema)
         if sig is None:
             return None
-        if self.pre_filter is not None:
-            fkey = _expr_cache_key(self.pre_filter)
-            if fkey is None:
+        if self.pre_stage is not None:
+            skey = self.pre_stage.cache_key()
+            if skey is None:
                 return None
-            sig = sig + ("pre_filter", fkey)
+            sig = sig + ("pre_stage", skey)
         build_eval = self._build_eval_fn(phase)
         pschema = self._partial_schema()
 

@@ -1,0 +1,350 @@
+"""Whole-stage fusion units (ISSUE 11): the stage compiler
+(plan/stage_compiler.py), the q6-shaped one-program-per-stage invariant,
+batch-size autotuning, and the streaming-scan prefetch discipline."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api.functions import col, lit
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSession
+
+
+def _session(extra=None):
+    conf = {"spark.rapids.tpu.sql.explain": "NONE"}
+    conf.update(extra or {})
+    return TpuSession.builder.config(conf).getOrCreate()
+
+
+def _rows(batch):
+    return sorted(batch.fetch_to_host().rows())
+
+
+def _df(session, n=20_000, seed=7):
+    rng = np.random.default_rng(seed)
+    return session.createDataFrame({
+        "k": [int(x) for x in rng.integers(0, 50, n)],
+        "a": [float(x) for x in rng.normal(0, 10, n)],
+        "b": [int(x) for x in rng.integers(0, 100, n)]})
+
+
+def _chain_query(df):
+    return (df.select((col("a") * lit(2.0)).alias("a2"), col("b"), col("k"))
+            .filter(col("a2") > lit(0.0))
+            .select((col("a2") + col("b")).alias("s"), col("k"))
+            .filter(col("k") < lit(40)))
+
+
+# ---------------------------------------------------------------------------
+# chain semantics + the fused exec
+# ---------------------------------------------------------------------------
+
+def test_chain_collapses_to_one_whole_stage_exec():
+    from spark_rapids_tpu.plan.stage_compiler import TpuWholeStageExec
+    session = _session()
+    q = _chain_query(_df(session))
+    got = _rows(q.collect_batch())
+    plan = session.last_plan()
+    stages = [n for n in _walk(plan) if isinstance(n, TpuWholeStageExec)]
+    assert len(stages) == 1, plan
+    assert stages[0].members == ["TpuProjectExec", "TpuFilterExec",
+                                 "TpuProjectExec", "TpuFilterExec"]
+    assert not stages[0].broken
+    # parity against the per-op path
+    session.conf.set("spark.rapids.tpu.sql.fusion.wholeStage", "false")
+    try:
+        assert _rows(q.collect_batch()) == got
+        plan_off = session.last_plan()
+        assert not [n for n in _walk(plan_off)
+                    if isinstance(n, TpuWholeStageExec)]
+    finally:
+        session.conf.set("spark.rapids.tpu.sql.fusion.wholeStage", "true")
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
+
+
+def test_stage_program_compiles_once_and_classifies():
+    """The stage program rides the _fused_fn funnel: ONE compile for the
+    whole chain (kernel family 'stage'), classified cold/disk like every
+    other kernel family, and a repeat run compiles nothing."""
+    from spark_rapids_tpu.analysis import recompile
+    session = _session()
+    df = _df(session, seed=11)
+    # structurally unique literals: the global fused cache is process-wide,
+    # and an expression chain another test already compiled would hit it
+    q = (df.select((col("a") * lit(2.125)).alias("a2"), col("b"))
+         .filter(col("a2") > lit(0.375))
+         .select((col("a2") + col("b") * lit(3.0)).alias("s"), col("b"))
+         .filter(col("b") < lit(47)))
+    base = recompile.snapshot()
+    q.collect_batch().fetch_to_host()
+    d = recompile.delta(base)
+    stage = {k: v for k, v in d.items() if k.startswith("stage")}
+    assert stage, d
+    (fam, ent), = stage.items()
+    assert ent["compiles"] == 1, ent
+    assert ent["coldCompiles"] + ent["diskHits"] == 1, ent
+    # no separate per-op project/filter programs were built for the chain
+    assert not any(k.startswith("fused_project") or
+                   k.startswith("fused_filter") or
+                   k.startswith("project") or k.startswith("filter")
+                   for k in d), d
+    snap = recompile.snapshot()
+    q.collect_batch().fetch_to_host()
+    rd = recompile.delta(snap)
+    assert not any(v.get("compiles") for v in rd.values()), rd
+
+
+def test_q6_shaped_stage_one_program_o1_syncs():
+    """The q6 shape — scan -> filter -> project -> aggregate — folds the
+    whole chain into the aggregate's update program: exactly ONE device
+    program family per batch and O(1) host syncs per partition even when
+    the scan streams many batches."""
+    from spark_rapids_tpu.analysis import recompile
+    from spark_rapids_tpu.plan import physical as ph
+    session = _session({
+        # pin small batches so the partition streams 8+ of them
+        "spark.rapids.tpu.sql.reader.batchSizeRows": 1 << 14})
+    df = _df(session, n=140_000, seed=13)
+    q = (df.filter((col("a") > lit(-5.0)) & (col("b") < lit(90)))
+         .select((col("a") * col("b")).alias("v"))
+         .agg(F.sum(col("v")).alias("s")))
+    base = recompile.snapshot()
+    res = q.collect_batch().fetch_to_host()
+    assert res.num_rows == 1
+    plan = session.last_plan()
+    aggs = [n for n in _walk(plan)
+            if isinstance(n, ph.TpuHashAggregateExec)]
+    assert aggs and aggs[0].pre_stage is not None
+    assert len(aggs[0].pre_stage.steps) == 2          # filter + project
+    assert getattr(aggs[0], "_fusion_members", []) == [
+        "TpuFilterExec", "TpuProjectExec"]
+    # chain members are GONE from the executed tree
+    assert not [n for n in _walk(plan)
+                if isinstance(n, (ph.TpuFilterExec, ph.TpuProjectExec))]
+    d = recompile.delta(base)
+    # the whole stage lowered to the agg's OWN update family: exactly one
+    # program per batch shape (donate variants are distinct shapes), and
+    # NO separate filter/project/stage programs were built for the chain
+    upd = [k for k in d if k.startswith("agg/update") and "pre_stage" in k]
+    assert len(upd) == 1, d
+    assert d[upd[0]]["compiles"] == d[upd[0]]["distinctShapes"], d
+    assert not any(k.startswith(("stage", "fused_project", "fused_filter",
+                                 "project", "filter")) for k in d), d
+    # O(1) syncs for the whole partition (many batches): the count sync
+    # of the final one-row fetch plus at most a couple of boundary syncs
+    sync = session.last_query_metrics()["sync"]
+    assert sync.get("hostSyncs", 0) <= 4, sync
+    # oracle
+    import pandas as pd
+    h = df.collect_batch().fetch_to_host().to_pandas()
+    sub = h[(h.a > -5.0) & (h.b < 90)]
+    expect = float((sub.a * sub.b).sum())
+    got = float(res.to_pydict()["s"][0])
+    assert abs(got - expect) <= 1e-6 * max(1.0, abs(expect))
+
+
+def test_fusion_decline_reason_surfaces_and_stays_correct():
+    """A stateful expression declines stage fusion with a per-node reason
+    in EXPLAIN ANALYZE, and the per-op path still answers correctly."""
+    session = _session()
+    df = _df(session, n=2_000)
+    q = (df.select((F.rand(42) * lit(0.0) + col("a")).alias("r"), col("b"))
+         .filter(col("b") < lit(50)))
+    out = q.collect_batch().fetch_to_host()
+    assert out.num_rows > 0
+    txt = session.explain_analyze()
+    assert "fusion declined" in txt, txt
+    assert "stateful expression" in txt, txt
+
+
+def test_explain_analyze_shows_stage_membership():
+    session = _session()
+    q = _chain_query(_df(session, seed=23))
+    q.collect_batch().fetch_to_host()
+    txt = session.explain_analyze()
+    assert "fused stage #" in txt, txt
+    assert "compiled into one program" in txt, txt
+    # the q6 shape shows the agg-folded membership too
+    q2 = (_df(session, seed=29).filter(col("a") > lit(0.0))
+          .select((col("a") + lit(1.0)).alias("v"))
+          .agg(F.sum(col("v")).alias("s")))
+    q2.collect_batch().fetch_to_host()
+    txt2 = session.explain_analyze()
+    assert "folded into this aggregate" in txt2, txt2
+
+
+def test_scalar_predicate_falls_back_to_per_op():
+    """A constant predicate inside a chain breaks the trace and degrades
+    permanently to the eager per-op path — same results."""
+    session = _session()
+    df = _df(session, n=4_000, seed=31)
+    q = (df.select((col("a") * lit(3.0)).alias("x"), col("b"))
+         .filter(lit(True))
+         .filter(col("b") >= lit(10)))
+    on_rows = _rows(q.collect_batch())
+    session.conf.set("spark.rapids.tpu.sql.fusion.wholeStage", "false")
+    try:
+        assert _rows(q.collect_batch()) == on_rows
+    finally:
+        session.conf.set("spark.rapids.tpu.sql.fusion.wholeStage", "true")
+
+
+# ---------------------------------------------------------------------------
+# batch-size autotuning
+# ---------------------------------------------------------------------------
+
+def test_tuned_batch_rows_properties():
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.plan import stage_compiler as sc
+    schema = dt.Schema([dt.Field("a", dt.FLOAT64), dt.Field("b", dt.INT64)])
+    sc.reset_tuning_cache()
+    conf = cfg.TpuConf()
+    rows = sc.tuned_batch_rows(conf, schema)
+    assert rows >= 1 << 14
+    assert rows & (rows - 1) == 0, rows          # power of two
+    assert rows <= int(conf.get(cfg.BATCH_AUTOTUNE_MAX_ROWS))
+    # deterministic across calls (the recompile gate needs stable shapes)
+    assert sc.tuned_batch_rows(conf, schema) == rows
+    # an explicit reader.batchSizeRows stays a hard cap
+    sc.reset_tuning_cache()
+    pinned = cfg.TpuConf({cfg.MAX_READER_BATCH_SIZE_ROWS.key: 1 << 15})
+    assert sc.tuned_batch_rows(pinned, schema) <= 1 << 15
+    # autotune off reproduces the legacy bytes-derived target
+    sc.reset_tuning_cache()
+    off = cfg.TpuConf({cfg.BATCH_AUTOTUNE.key: "false"})
+    legacy = sc.tuned_batch_rows(off, schema)
+    row_bytes = sum((f.dtype.byte_width or 32) + 1 for f in schema)
+    assert legacy == max(
+        1 << 14, min(int(off.batch_size_bytes) // row_bytes,
+                     int(off.get(cfg.MAX_READER_BATCH_SIZE_ROWS))))
+    sc.reset_tuning_cache()
+
+
+def test_tuned_batch_rows_shrinks_under_pressure():
+    """A nearly-exhausted device watermark shrinks the pick (never below
+    the floor) — the 'largest SAFE batch' half of the contract."""
+    from spark_rapids_tpu import config as cfg
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.plan import stage_compiler as sc
+    from spark_rapids_tpu.service.telemetry import watermark
+    schema = dt.Schema([dt.Field("a", dt.FLOAT64)])
+    conf = cfg.TpuConf()
+    sc.reset_tuning_cache()
+    free = sc.tuned_batch_rows(conf, schema)
+    wm = watermark("device")
+    before = wm.current
+    try:
+        sc.reset_tuning_cache()
+        wm.update(sc._device_budget_bytes())      # budget fully in use
+        pressed = sc.tuned_batch_rows(conf, schema)
+    finally:
+        wm.update(before)
+        sc.reset_tuning_cache()
+    assert pressed <= free
+    assert pressed >= 1 << 14
+
+
+# ---------------------------------------------------------------------------
+# streaming scan / prefetch discipline
+# ---------------------------------------------------------------------------
+
+def test_ordered_prefetch_order_error_and_naming():
+    from spark_rapids_tpu.exec.tasks import ordered_prefetch
+    seen_names = set()
+
+    def fn(i):
+        seen_names.add(threading.current_thread().name)
+        return i * i
+
+    out = list(ordered_prefetch(range(40), fn, threads=3, depth=2,
+                                name="tpu-scan-prefetch"))
+    assert out == [i * i for i in range(40)]
+    assert seen_names and all(n.startswith("tpu-scan-prefetch-")
+                              for n in seen_names), seen_names
+
+    def boom(i):
+        if i == 5:
+            raise RuntimeError("decode failed")
+        return i
+
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(ordered_prefetch(range(10), boom, threads=2))
+
+
+def test_ordered_prefetch_bounded_join_on_early_close():
+    """Closing the consumer early must stop and join the workers (bounded
+    join on shutdown — the transport-thread discipline)."""
+    from spark_rapids_tpu.exec.tasks import ordered_prefetch
+    gen = ordered_prefetch(range(100), lambda i: i, threads=2, depth=2,
+                           name="tpu-scan-prefetch")
+    assert next(iter(gen)) == 0
+    gen.close()
+    import time
+    deadline = time.time() + 6.0
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith("tpu-scan-prefetch-")]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, alive
+
+
+def test_abandoned_scan_partition_returns_staging_windows(tmp_path):
+    """A partition drain abandoned mid-stream (limit-style early exit)
+    must hand every pinned staging-arena window back — leaked windows
+    would permanently shrink the process-global arena."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.io import scan as scan_mod
+    rng = np.random.default_rng(17)
+    for i in range(4):
+        tbl = pa.table({"x": rng.integers(0, 100, 4000),
+                        "y": rng.normal(0, 1, 4000)})
+        pq.write_table(tbl, str(tmp_path / f"f{i}.parquet"))
+    session = _session({
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "MULTITHREADED"})
+    from spark_rapids_tpu.plan import logical as lp
+    from spark_rapids_tpu.io.scan import TpuFileScanExec
+    from spark_rapids_tpu.columnar import dtypes as dt
+    plan = lp.FileScan("parquet", [str(tmp_path)],
+                       dt.Schema([dt.Field("x", dt.INT64),
+                                  dt.Field("y", dt.FLOAT64)]))
+    exec_ = TpuFileScanExec(plan, session.conf)
+    part = exec_.execute()[0]
+    next(part)                      # one batch uploaded...
+    part.close()                    # ...then the consumer walks away
+    staging = scan_mod._STAGING
+    if staging is not None:         # arena was used: must be fully freed
+        assert staging.allocator.allocated_bytes == 0, \
+            staging.allocator.allocated_bytes
+
+
+def test_streaming_scan_strategies_agree(tmp_path):
+    """MULTITHREADED (streamed, prefetch pool) == COALESCING == PERFILE on
+    a multi-file parquet dataset, and the prefetch thread count follows
+    scan.prefetchThreads."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(5)
+    for i in range(6):
+        tbl = pa.table({"x": rng.integers(0, 1000, 500),
+                        "y": rng.normal(0, 1, 500)})
+        pq.write_table(tbl, str(tmp_path / f"part-{i}.parquet"))
+    got = {}
+    for strategy in ("MULTITHREADED", "COALESCING", "PERFILE"):
+        session = _session({
+            "spark.rapids.tpu.sql.format.parquet.reader.type": strategy,
+            "spark.rapids.tpu.sql.scan.prefetchThreads": 3})
+        df = session.read.parquet(str(tmp_path))
+        got[strategy] = sorted(df.collect_batch().fetch_to_host().rows())
+        assert len(got[strategy]) == 3000
+    assert got["MULTITHREADED"] == got["COALESCING"] == got["PERFILE"]

@@ -95,7 +95,11 @@ def test_seeded_schema_mismatch_warn_mode(session, df, monkeypatch):
     orig = Overrides._insert_coalesce
     monkeypatch.setattr(Overrides, "_insert_coalesce",
                         lambda self, n: _corrupt_filter_schema(orig(self, n)))
-    ov = Overrides(session.conf)                      # default mode: warn
+    # stage fusion off: the corruption targets the standalone filter exec,
+    # which whole-stage fusion would otherwise collapse away
+    ov = Overrides(session.conf.with_overrides(
+        {"spark.rapids.tpu.sql.fusion.wholeStage": "false"}))
+    # default mode: warn
     node = ov.apply(frame._analyzed())                # must NOT raise
     assert "contract" in ov.last_explain
     assert "TpuFilterExec" in ov.last_explain
@@ -108,7 +112,8 @@ def test_seeded_schema_mismatch_error_mode(session, df, monkeypatch):
     monkeypatch.setattr(Overrides, "_insert_coalesce",
                         lambda self, n: _corrupt_filter_schema(orig(self, n)))
     ov = Overrides(session.conf.with_overrides(
-        {"spark.rapids.tpu.sql.analysis.validatePlan": "error"}))
+        {"spark.rapids.tpu.sql.analysis.validatePlan": "error",
+         "spark.rapids.tpu.sql.fusion.wholeStage": "false"}))
     with pytest.raises(contracts.PlanContractError) as ei:
         ov.apply(frame._analyzed())
     assert "TpuFilterExec" in str(ei.value)

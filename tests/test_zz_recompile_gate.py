@@ -71,6 +71,51 @@ def test_recompile_flags_clean_over_bench_corpus():
         json.dumps(flagged, indent=1))
 
 
+def test_stage_programs_ride_the_compile_audit_funnel():
+    """ISSUE 11: whole-stage programs (plan/stage_compiler) classify
+    cold-build vs disk-hit through exec/compile_cache like every other
+    kernel family, and a repeat run of the same chain compiles nothing.
+    (The corpus gate above already runs the 60 bench plans with
+    ``fusion.wholeStage`` at its default ON — this pins the stage family
+    explicitly.)"""
+    import numpy as np
+    from spark_rapids_tpu.analysis import recompile
+    from spark_rapids_tpu.api.functions import col, lit
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import compile_cache
+    session = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    rng = np.random.default_rng(97)
+    df = session.createDataFrame({
+        "a": [float(x) for x in rng.normal(0, 10, 4096)],
+        "b": [int(x) for x in rng.integers(0, 100, 4096)]})
+    # literals unique to this test: the process-global fused cache must
+    # not already hold the chain
+    q = (df.select((col("a") * lit(7.03125)).alias("x"), col("b"))
+         .filter(col("x") > lit(0.15625))
+         .select((col("x") - col("b")).alias("y"), col("b"))
+         .filter(col("b") != lit(63)))
+    base = recompile.snapshot()
+    q.collect_batch().fetch_to_host()
+    d = recompile.delta(base)
+    stage = {k: v for k, v in d.items() if k.startswith("stage")}
+    assert stage, d
+    (_fam, ent), = stage.items()
+    assert ent["compiles"] == 1, ent
+    # classified through the persistent-cache funnel: exactly one of
+    # cold-build / disk-hit, with first-call wall seconds metered
+    assert ent["coldCompiles"] + ent["diskHits"] == 1, ent
+    assert ent["compileS"] >= 0.0
+    # the signature was recorded in the persistent index: a second
+    # process (or this one after an eviction) would classify 'disk'
+    # when a cache dir is configured, 'cold' otherwise — classify() is
+    # deterministic per key either way
+    snap = recompile.snapshot()
+    q.collect_batch().fetch_to_host()
+    rd = recompile.delta(snap)
+    assert not any(v.get("compiles") for v in rd.values()), rd
+
+
 def test_size_class_discipline_clean_over_corpus():
     """After the whole suite (and the corpus gate above) every compiled
     signature in the process traces back to bucketed dimensions only —
