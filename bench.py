@@ -262,6 +262,53 @@ print(json.dumps({
     }
 
 
+def bench_serving(sf: float = 0.01, iters: int = 24):
+    """Serving front-door micro-bench (ISSUE 12, docs/plan_cache.md):
+    steady-state q6 executions with ROTATING date-range literals through
+    a prepared statement — after one cold (plan + compile) iteration,
+    every execute is a parse-free plan-cache-served rebind+run, the warm
+    serving hot path a dashboard tier lives on. Reports plans served per
+    second (higher better) and the warm-traffic window wall seconds
+    (lower better), both stamped into the history gate, plus the
+    plan-cache counters as honesty checks (hits must cover the loop and
+    exactly ONE plan may have been built)."""
+    import datetime
+    from benchmarks import datagen
+    from spark_rapids_tpu.api.session import TpuSession
+    session = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    tables = datagen.register_tables(session, sf)
+    tables["lineitem"].createOrReplaceTempView("serving_lineitem")
+    stmt = session.prepare(
+        "SELECT sum(l_extendedprice * l_discount) AS revenue "
+        "FROM serving_lineitem "
+        "WHERE l_shipdate >= :lo AND l_shipdate < :hi "
+        "AND l_discount >= 0.05 AND l_discount <= 0.07 "
+        "AND l_quantity < 24")
+
+    def window(i):
+        lo = datetime.date(1993, 1, 1) + datetime.timedelta(
+            days=30 * (i % 24))
+        return lo, lo + datetime.timedelta(days=365)
+
+    lo, hi = window(0)
+    stmt.execute(lo=lo, hi=hi)          # cold: plans once, compiles
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):       # warm traffic, literals rotate
+        lo, hi = window(i)
+        stmt.execute(lo=lo, hi=hi)
+    wall = time.perf_counter() - t0
+    st = session.serving_stats()
+    return {
+        "plan_cache_plans_per_s": round(iters / wall, 2),
+        "warm_traffic_q6_s": round(wall, 4),
+        "serving_iters": iters,
+        "serving_plan_hits": st["planHits"],
+        "serving_plans_built": st["plansBuilt"],
+        "serving_ok": st["plansBuilt"] == 1 and st["planHits"] >= iters,
+    }
+
+
 def bench_donation_hbm(n_rows: int):
     """Peak live device bytes of a fused filter consuming one batch,
     donation on vs off: with ``compile.donate`` the input columns free
@@ -431,6 +478,15 @@ def main():
     except Exception as e:
         engine["donation_error"] = str(e)[:120]
 
+    # serving front door (ISSUE 12): steady-state plans/s + warm-traffic
+    # latency of literal-rotating q6 through the prepared path
+    serving = None
+    try:
+        serving = bench_serving(sf=0.01 if platform != "cpu" else 0.002)
+        engine.update(serving)
+    except Exception as e:
+        engine["serving_error"] = str(e)[:120]
+
     bytes_per_row = 8 + 1 + 8 + 1 + 1            # key, kvalid, val, vvalid, flag
     gbytes_per_s = tpu_rows_per_s * bytes_per_row / 1e9
     # one-hot matmul flops: rows x slots x 2 (mul+add) x planned feature
@@ -497,6 +553,14 @@ def main():
             from benchmarks.history import COMPILE_S, WARM_RESTART_S
             queries[COMPILE_S] = warm["compile_s"]
             queries[WARM_RESTART_S] = warm["warm_restart_s"]
+        if serving and serving.get("serving_ok"):
+            # serving front door (ISSUE 12): plans/s higher-is-better,
+            # warm-traffic wall lower-is-better (INVERTED_QUERIES)
+            from benchmarks.history import (PLAN_CACHE_PLANS_PER_S,
+                                            WARM_TRAFFIC_Q6_S)
+            queries[PLAN_CACHE_PLANS_PER_S] = \
+                serving["plan_cache_plans_per_s"]
+            queries[WARM_TRAFFIC_Q6_S] = serving["warm_traffic_q6_s"]
         gate = bh.stamp(
             "bench", queries, backend=line["backend"], degraded=degraded,
             error=probe.get("error") if degraded else None,

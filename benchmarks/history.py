@@ -58,10 +58,21 @@ WARM_RESTART_S = "warm_restart_s"
 WHOLE_QUERY_GAP = "whole_query_gap"
 FUSION_AB_Q6 = "fusion_ab_q6"
 
+#: serving front-door series stamped by bench.py (ISSUE 12,
+#: docs/plan_cache.md): PLAN_CACHE_PLANS_PER_S is the steady-state rate
+#: of plan-cache-served q6 executions with ROTATING literals (parse +
+#: analyze + rebind + execute per iteration; higher is better) —
+#: the plans/s the serving tier can sustain; WARM_TRAFFIC_Q6_S is the
+#: wall seconds of that warm literal-rotating traffic window (lower is
+#: better, the serving latency analog of warm_restart_s).
+PLAN_CACHE_PLANS_PER_S = "plan_cache_plans_per_s"
+WARM_TRAFFIC_Q6_S = "warm_traffic_q6_s"
+
 #: queries whose direction flips relative to their round's
 #: ``higherIsBetter`` flag (seconds-valued series riding a throughput
 #: round): recorded per entry so old history lines stay judgeable
-INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP})
+INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP,
+                              WARM_TRAFFIC_Q6_S})
 
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)

@@ -426,6 +426,47 @@ def format_violations(violations: List[Violation]) -> str:
     return "\n".join(lines)
 
 
+def validate_cached_binding(root, params, validated_dtypes,
+                            mode: str) -> Tuple[bool, List[Violation]]:
+    """Cache-hit validation policy for the parameterized-plan cache
+    (plan/plan_cache.py): the validated-plan status RIDES the cache
+    entry, so a hit skips the full :func:`validate_plan` walk — as long
+    as every runtime parameter still binds the dtype the entry was
+    validated with. A parameter substitution that drifts a slot's dtype
+    invalidates that status: the bound references the fused programs
+    were compiled against would read values of another type, so the
+    FULL walk re-runs, prefixed with one violation per drifted slot.
+
+    Returns ``(revalidated, violations)``; raises
+    :class:`PlanContractError` in ``error`` mode when drift is found
+    (same policy as :func:`enforce`)."""
+    mode = (mode or "warn").lower()
+    if mode == "off":
+        return False, []
+    drifted: List[Violation] = []
+    for p, want in zip(params, validated_dtypes):
+        try:
+            have = p.dtype
+        except Exception:
+            have = None
+        if have != want:
+            drifted.append(Violation(
+                type(root).__name__, type(root).__name__,
+                f"parameter :{p.param_name or p.slot} rebound as "
+                f"{have} but the plan was validated with {want}; "
+                "re-running full plan validation"))
+    if not drifted:
+        return False, []                 # the hit skips re-validation
+    violations = drifted + validate_plan(root)
+    diag = format_violations(violations)
+    if mode == "error":
+        raise PlanContractError(diag)
+    logger.warning(
+        "parameter dtype drift on a cached plan re-triggered "
+        "validation:\n%s", diag)
+    return True, violations
+
+
 _warned_once = False
 
 

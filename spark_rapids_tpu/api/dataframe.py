@@ -269,16 +269,22 @@ class DataFrame:
 
     # -- actions -------------------------------------------------------------
     def _execute(self):
+        """Plan (or serve from the parameterized-plan cache) this
+        frame's query. Returns the exec tree, and leaves the serving
+        info — plan-cache hit/miss, result-cache key — on the session
+        (plan/plan_cache.py, docs/plan_cache.md)."""
         import time
         t0 = time.perf_counter()
         plan = self._analyzed()
         from ..exec.spill import BufferCatalog
-        from ..plan.overrides import Overrides
-        ov = Overrides(self.session.conf)
-        exec_plan = ov.apply(plan)
+        from ..plan import plan_cache as pc
+        exec_plan, serving = pc.plan_for(self.session, plan)
         self.session._last_plan_time_s = time.perf_counter() - t0
         self.session._last_exec_plan = exec_plan
-        self.session._last_overrides = ov
+        self.session._last_serving = serving
+        # result-cache key read NOW (snapshot = current table tokens /
+        # file stats) so the collect can short-circuit or store
+        serving["resultKey"] = pc.result_key(self.session, serving, plan)
         # spill counters are process-cumulative; snapshot them so
         # last_query_metrics() can report THIS query's deltas
         cat = BufferCatalog.get()
@@ -319,9 +325,21 @@ class DataFrame:
         return self
 
     def collect_batch(self):
+        exec_plan = self._execute()
+        from ..plan import plan_cache as pc
+        serving = getattr(self.session, "_last_serving", None) or {}
+        hit = pc.serve_result_hit(self.session, serving)
+        if hit is not None:
+            # exact-repeat short circuit: no execution at all — the
+            # stored HOST batch serves (no spans/metrics/listeners
+            # for this collect; EXPLAIN ANALYZE marks the hit)
+            return hit
+        return self._collect_planned(exec_plan, serving)
+
+    def _collect_planned(self, exec_plan, serving):
         import time
         from ..exec.tracing import SpanRecorder, SyncCounter
-        exec_plan = self._execute()
+        from ..plan import plan_cache as pc
         listeners = bool(self.session._query_listeners)
         if listeners:
             # snapshots only when someone is listening: the deltas cost a
@@ -365,6 +383,11 @@ class DataFrame:
                 recompile.delta(rc0), lockdep.stats_delta(lk0),
                 violations=getattr(ov, "last_violations", ()) if ov
                 else ()))
+        rkey = serving.get("resultKey")
+        if rkey is not None:
+            # store AFTER the sync/span windows closed: the caching
+            # fetch must not perturb this query's reported sync counts
+            out = pc.store_result(self.session, rkey, out)
         return out
 
     def collect(self) -> List[tuple]:

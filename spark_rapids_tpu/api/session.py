@@ -63,6 +63,11 @@ class RuntimeConf:
         if ".compile." in key:
             from ..exec import compile_cache
             compile_cache.configure(self._session.conf)
+        # ANY conf change drops the session's serving caches: cached
+        # plans were analyzed/optimized/validated under the old conf, and
+        # a stored result may have been produced by it
+        self._session._plan_cache = None
+        self._session._result_cache = None
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._session.conf.get_key(key, default)
@@ -146,6 +151,12 @@ class TpuSession:
         self._views: Dict[str, lp.LogicalPlan] = {}
         self._last_exec_plan = None
         self._last_overrides = None
+        self._last_serving = None
+        # serving front door (plan/plan_cache.py): lazily built from the
+        # conf; RuntimeConf.set drops them so conf changes replan
+        self._plan_cache = None
+        self._result_cache = None
+        self._serving_stats = None
         self._query_listeners: List = []
         self._bootstrap()
         with TpuSession._lock:
@@ -263,8 +274,36 @@ class TpuSession:
         return DataFrameReader(self)
 
     def sql(self, query: str) -> DataFrame:
+        from ..plan import plan_cache as pc
         from .sql import parse_sql
+        pc.serving_stats(self)["parses"] += 1
         return parse_sql(query, self)
+
+    def prepare(self, query: Union[str, DataFrame]) -> "PreparedStatement":
+        """Prepared-statement API (the serving front door,
+        docs/plan_cache.md): parse ONCE, plan/contract-validate/
+        stage-compile once (through the parameterized-plan cache),
+        execute many. SQL text may carry ``:name`` placeholders bound
+        per execution::
+
+            stmt = session.prepare(
+                "SELECT sum(v) FROM t WHERE d >= :lo AND d < :hi")
+            stmt.execute(lo=date(1994, 1, 1), hi=date(1995, 1, 1))
+            stmt.execute(lo=date(1995, 1, 1), hi=date(1996, 1, 1))
+
+        A DataFrame works too (its literals auto-parameterize, so later
+        frames of the same shape share the plan)."""
+        from .sql import PreparedStatement
+        return PreparedStatement(self, query)
+
+    def serving_stats(self) -> Dict[str, int]:
+        """Counters of the serving front door on THIS session: parses,
+        analyzes, plans built, plan/result cache hits and misses,
+        binding revalidations (tests and dashboards read these; the
+        process-wide analogs are the ``tpu_plan_cache_*`` /
+        ``tpu_result_cache_*`` telemetry series)."""
+        from ..plan import plan_cache as pc
+        return dict(pc.serving_stats(self))
 
     def stop(self) -> None:
         with TpuSession._lock:
@@ -384,6 +423,11 @@ class TpuSession:
             f"hostSyncs={sync.get('hostSyncs', 0)} "
             f"spanWallS={spans.get('wallS', 0.0)} "
             f"concurrency={spans.get('concurrency', 0.0)}")
+        # serving-cache hit/miss per layer (plan/plan_cache.py)
+        from ..plan.plan_cache import serving_line
+        sl = serving_line(getattr(self, "_last_serving", None))
+        if sl:
+            lines.append(sl)
         return "\n".join(lines)
 
     # -- query-execution listeners (ExecutionPlanCaptureCallback analog,

@@ -58,6 +58,7 @@ class SqlParseError(ValueError):
 _TOKEN_RE = re.compile(r"""
     \s+
   | (?P<comment>--[^\n]*)
+  | (?P<param>:[A-Za-z_][A-Za-z_0-9]*)
   | (?P<number>\d+\.\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|\d+([eE][+-]?\d+)?)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
@@ -781,15 +782,20 @@ class _Parser:
             self.expect_op(")")
             lits = []
             for v in vals:
-                if not isinstance(v, ex.Literal):
-                    raise SqlParseError("IN list must be literals")
+                if not isinstance(v, ex.Literal) or \
+                        isinstance(v, ex.Parameter):
+                    raise SqlParseError(
+                        "IN list must be literals (:name placeholders "
+                        "are supported in comparisons, not IN lists)")
                 lits.append(v.value)
             out = _unwrap(Col(e).isin(*lits))
             return pr.Not(out) if neg else out
         if self.take_kw("LIKE"):
             p = self.parse_additive()
-            if not isinstance(p, ex.Literal):
-                raise SqlParseError("LIKE pattern must be a string literal")
+            if not isinstance(p, ex.Literal) or isinstance(p, ex.Parameter):
+                raise SqlParseError(
+                    "LIKE pattern must be a string literal (:name "
+                    "placeholders are not supported there)")
             out = _unwrap(Col(e).like(p.value))
             return pr.Not(out) if neg else out
         if self.take_kw("IS"):
@@ -853,6 +859,11 @@ class _Parser:
 
     def parse_primary(self) -> ex.Expression:
         t = self.peek()
+        if t.kind == "param":
+            # :name placeholder (prepared statements, docs/plan_cache.md):
+            # dtype resolves from the first execute()'s bound value
+            self.next()
+            return ex.Parameter(name=t.text[1:])
         if t.kind == "number":
             self.next()
             if "." in t.text or "e" in t.text or "E" in t.text:
@@ -1022,6 +1033,7 @@ class _Parser:
         if fn is None:
             raise SqlParseError(f"unknown function {name}")
         call_args = [a.value if isinstance(a, ex.Literal)
+                     and not isinstance(a, ex.Parameter)
                      and fname in ("substring", "lpad", "rpad", "round",
                                    "locate", "instr", "regexp_extract",
                                    "regexp_replace", "replace", "lead",
@@ -1109,6 +1121,205 @@ def _extract_having(cond: ex.Expression, select_exprs):
     import copy
     cond = copy.deepcopy(cond)
     return extra, walk(cond)
+
+
+class PreparedStatement:
+    """``session.prepare(sql) -> stmt.execute(**params)``: parse ONCE,
+    plan/contract-validate/stage-compile once (through the
+    parameterized-plan cache), execute many (docs/plan_cache.md).
+
+    SQL text may carry ``:name`` placeholders in WHERE conditions and
+    SELECT expressions; each ``execute()`` binds them (python
+    int/float/bool, ``datetime.date``/``datetime.datetime``, ISO
+    ``yyyy-mm-dd`` strings, plain strings). The first execute resolves
+    placeholder dtypes, analyzes, plans and caches; later executes with
+    the same value dtypes skip parse AND analysis and go straight to the
+    cached entry — rebind, cheap binding validation, run. Changing a
+    value's dtype replans (new fingerprint) and re-validates.
+
+    A DataFrame works in place of SQL: its literals auto-parameterize,
+    so repeated frames of the same shape share one plan."""
+
+    def __init__(self, session, query):
+        from ..plan import plan_cache as pc
+        self.session = session
+        self.sql = query if isinstance(query, str) else None
+        if isinstance(query, str):
+            pc.serving_stats(session)["parses"] += 1
+            self._df = parse_sql(query, session)
+        else:
+            self._df = query
+        self._named = self._collect_named(self._df.logical_plan())
+        # after the first planned execute: (fingerprint, value template,
+        # {name: slot}, placeholder dtype signature)
+        self._fast = None
+
+    @staticmethod
+    def _collect_named(plan):
+        named: dict = {}
+
+        def walk(p):
+            for e in p.expressions():
+                for n in e.collect(lambda x: isinstance(x, ex.Parameter)
+                                   and x.param_name is not None):
+                    named.setdefault(n.param_name, []).append(n)
+            for c in p.children:
+                walk(c)
+        walk(plan)
+        return named
+
+    @property
+    def parameter_names(self):
+        return sorted(self._named)
+
+    @staticmethod
+    def _coerce(name, value):
+        """python value -> (engine value, dtype) for a placeholder."""
+        import calendar
+        import datetime
+        from ..columnar import dtypes as dtm
+        if isinstance(value, bool):
+            return value, dtm.BOOL
+        if isinstance(value, datetime.datetime):
+            micros = calendar.timegm(value.utctimetuple()) * 1_000_000 \
+                + value.microsecond
+            return micros, dtm.TIMESTAMP
+        if isinstance(value, datetime.date):
+            return (value - datetime.date(1970, 1, 1)).days, dtm.DATE
+        if isinstance(value, int):
+            return value, dtm.INT64
+        if isinstance(value, float):
+            return value, dtm.FLOAT64
+        if isinstance(value, str):
+            if re.fullmatch(r"\d{4}-\d{2}-\d{2}", value):
+                d = datetime.date.fromisoformat(value)
+                return (d - datetime.date(1970, 1, 1)).days, dtm.DATE
+            return value, dtm.STRING
+        raise TypeError(
+            f"unsupported parameter type for :{name}: {type(value).__name__}")
+
+    def _bind_named(self, kw) -> None:
+        missing = sorted(set(self._named) - set(kw))
+        extra = sorted(set(kw) - set(self._named))
+        if missing or extra:
+            raise ValueError(
+                f"prepared-statement parameters mismatch: missing="
+                f"{missing} unexpected={extra} (declared: "
+                f"{self.parameter_names})")
+        for name, value in kw.items():
+            if value is None:
+                raise ValueError(
+                    f"parameter :{name} cannot bind NULL (write a "
+                    "literal NULL in the statement instead)")
+            v, t = self._coerce(name, value)
+            for node in self._named[name]:
+                node.bind(v, t, retype=True)
+
+    def _dtype_sig(self) -> tuple:
+        return tuple(sorted(
+            (name, nodes[0].dtype.name)
+            for name, nodes in self._named.items()))
+
+    def execute(self, **params):
+        """Bind + run; returns the collected ColumnarBatch (call
+        ``.rows()`` / ``.to_pandas()`` on it, or use :meth:`collect`)."""
+        self._bind_named(params)
+        out = self._serve_fast()
+        if out is not None:
+            return out
+        batch = self._df.collect_batch()
+        self._capture_fast()
+        return batch
+
+    def collect(self, **params):
+        return self.execute(**params).rows()
+
+    # -- the plan-once / execute-many fast path -----------------------------
+    def _capture_fast(self) -> None:
+        from ..plan import plan_cache as pc
+        serving = getattr(self.session, "_last_serving", None)
+        if not serving or not serving.get("cacheable"):
+            return
+        cache, _rc = pc.session_caches(self.session)
+        entry = cache.peek(serving["fingerprint"])
+        if entry is None:
+            return
+        if any(not p.traceable() for p in entry.params):
+            # a value-baked (string) parameter's value is part of the
+            # plan fingerprint AND of every compiled program in the
+            # entry's frozen exec tree (whole-stage _fns memoize it) —
+            # the fast path's in-place rebind would serve the stale
+            # baked program. The full path gives each distinct value
+            # its own cache entry, which still plan-cache-hits on
+            # repeats of the same value.
+            return
+        named_slots: dict = {}
+        for p in entry.params:
+            if p.param_name is not None:
+                # one :name may occupy several slots (used twice)
+                named_slots.setdefault(p.param_name, []).append(p.slot)
+        self._fast = (serving["fingerprint"], list(serving["values"]),
+                      named_slots, self._dtype_sig())
+
+    def _serve_fast(self):
+        """Skip parse AND analysis: rebind the cached entry and execute
+        it through the normal collect machinery. None -> full path."""
+        if self._fast is None:
+            return None
+        fingerprint, template, named_slots, dsig = self._fast
+        if self._dtype_sig() != dsig:
+            self._fast = None          # dtype change: replan + revalidate
+            return None
+        from ..exec.spill import BufferCatalog
+        from ..plan import plan_cache as pc
+        cache, _rc = pc.session_caches(self.session)
+        entry = cache.get(fingerprint)
+        if entry is None:
+            self._fast = None
+            return None
+        values = list(template)
+        for name, slots in named_slots.items():
+            for slot in slots:
+                values[slot] = self._named[name][0].value
+        try:
+            revalidated, violations = entry.bind(values)
+        except Exception:
+            # tainted entry: drop it so a clean retry replans
+            cache.discard(fingerprint)
+            self._fast = None
+            raise
+        if revalidated and violations:
+            cache.discard(fingerprint)
+            self._fast = None
+            return None
+        entry.reset_metrics()
+        sess = self.session
+        st = pc.serving_stats(sess)
+        st["planHits"] += 1
+        pc._inc("tpu_plan_cache_hits_total",
+                "parameterized-plan cache hits (analyze/optimize/"
+                "validate/stage-compile skipped)")
+        serving = {
+            "planCache": "hit", "resultCache": "off",
+            "params": len(values), "fingerprint": fingerprint,
+            "values": tuple(values), "snapshot": None,
+            "cacheable": True, "revalidated": revalidated,
+            "prepared": True,
+        }
+        sess._last_plan_time_s = 0.0
+        sess._last_exec_plan = entry.exec_plan
+        sess._last_overrides = pc._CachedOverrides(entry.overrides,
+                                                   violations)
+        sess._last_serving = serving
+        cat = BufferCatalog.get()
+        sess._mem_baseline = (cat.spilled_device_bytes,
+                              cat.spilled_host_bytes)
+        serving["resultKey"] = pc.result_key(sess, serving,
+                                             entry.logical_plan)
+        hit = pc.serve_result_hit(sess, serving)
+        if hit is not None:
+            return hit
+        return self._df._collect_planned(entry.exec_plan, serving)
 
 
 def parse_sql(query: str, session):

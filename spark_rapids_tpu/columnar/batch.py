@@ -27,7 +27,13 @@ class ColumnarBatch:
     # re-read them (set by BufferCatalog.acquire_batch) — such a batch
     # must NEVER have its buffers donated to a fused program
     # (exec/compile_cache donation gate; docs/compile.md)
-    __slots__ = ("schema", "columns", "_num_rows", "origin", "shared")
+    # ``params``: traced query-parameter scalars riding INSIDE a fused
+    # program only (plan cache parameterization, docs/plan_cache.md):
+    # ``from_flat_arrays`` attaches any arguments beyond the schema's
+    # arity here, and ``ops.expressions.Parameter`` reads them by its
+    # stamped trace position. Host-side batches always carry ().
+    __slots__ = ("schema", "columns", "_num_rows", "origin", "shared",
+                 "params")
 
     def __init__(self, schema: dt.Schema, columns: List[Column], num_rows: int):
         assert len(schema) == len(columns), "schema/column arity mismatch"
@@ -37,6 +43,7 @@ class ColumnarBatch:
         self.columns = columns
         self.origin = None
         self.shared = False
+        self.params = ()
         if isinstance(num_rows, (int, np.integer)):
             self._num_rows = int(num_rows)
         else:
@@ -245,7 +252,12 @@ class ColumnarBatch:
         for f in schema:
             c, i = build_column(f.dtype, arrays, i)
             cols.append(c)
-        return ColumnarBatch(schema, cols, num_rows)
+        out = ColumnarBatch(schema, cols, num_rows)
+        if i < len(arrays):
+            # arguments beyond the schema's arity are appended query
+            # parameters (traced 0-d scalars inside a fused program)
+            out.params = tuple(arrays[i:])
+        return out
 
     # -- host extraction -----------------------------------------------------
     def fetch_to_host(self) -> "ColumnarBatch":

@@ -521,6 +521,48 @@ COMPILE_DONATE = _conf("spark.rapids.tpu.sql.compile.donate").doc(
     "batches are never donated — their arrays are re-read through the "
     "catalog (docs/compile.md)").boolean_conf.create_with_default(True)
 
+PLAN_CACHE_ENABLED = _conf("spark.rapids.tpu.sql.planCache.enabled").doc(
+    "Parameterized-plan cache (the serving front door, "
+    "docs/plan_cache.md): eligible literals in WHERE/SELECT expressions "
+    "extract into runtime parameters, and plans of the same normalized "
+    "fingerprint reuse one analyzed/optimized/contract-validated/"
+    "stage-compiled exec tree — and the SAME compiled program "
+    "signatures — across executions with different literal values. "
+    "``session.prepare(sql)`` plans once / executes many; plain "
+    "``session.sql()`` hits the cache transparently. Plans carrying "
+    "writes, nondeterministic expressions or unkeyable attributes are "
+    "served the classic way").boolean_conf.create_with_default(True)
+
+PLAN_CACHE_MAX_ENTRIES = _conf(
+    "spark.rapids.tpu.sql.planCache.maxEntries").doc(
+    "LRU bound on cached parameterized plans per session (each entry "
+    "pins its exec tree and the fused stage programs it references; the "
+    "JIT map-pressure relief valve drops all plan caches under mapping "
+    "pressure)").integer_conf.check(
+        lambda v: int(v) >= 1).create_with_default(64)
+
+RESULT_CACHE_ENABLED = _conf("spark.rapids.tpu.sql.resultCache.enabled").doc(
+    "Result cache for exact-repeat queries (docs/plan_cache.md): "
+    "executions keyed by (plan fingerprint, parameter values, input "
+    "snapshot) short-circuit BEFORE the planner and serve the stored "
+    "host-resident result. Snapshots ride the scan data's ownership "
+    "tokens (entries invalidate when the base table dies or a file's "
+    "mtime/size changes). Off by default: a served result skips "
+    "execution, so per-query spans/metrics reflect the original run"
+).boolean_conf.create_with_default(False)
+
+RESULT_CACHE_MAX_BYTES = _conf(
+    "spark.rapids.tpu.sql.resultCache.maxBytes").doc(
+    "Host-memory bound on the per-session result cache (LRU evicts "
+    "past it)").bytes_conf.create_with_default(256 * 1024 * 1024)
+
+RESULT_CACHE_MAX_ENTRY_BYTES = _conf(
+    "spark.rapids.tpu.sql.resultCache.maxEntryBytes").doc(
+    "Largest single result the cache will store; bigger results are "
+    "served normally and never cached (serving-shaped results are "
+    "small — a huge analytical result would evict everything else)"
+).bytes_conf.create_with_default(32 * 1024 * 1024)
+
 ANALYSIS_LOCKDEP = _conf("spark.rapids.tpu.sql.analysis.lockdep").doc(
     "Runtime lock-order tracking over the engine's named locks "
     "(analysis/lockdep.py): off, record (build the lock-order graph, log "

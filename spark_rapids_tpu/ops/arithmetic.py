@@ -19,7 +19,7 @@ from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column, Scalar
 from .expressions import (Expression, combine_validity, data_validity,
-                          result_column)
+                          is_traced, result_column)
 
 
 def _ns(*vals):
@@ -78,6 +78,20 @@ class BinaryArithmetic(Expression):
         # expression costs zero device round trips per batch
         if lv.is_null or rv.is_null:
             return Scalar(None, self.dtype)
+        if is_traced(lv.value) or is_traced(rv.value):
+            # a rebindable Parameter under an active fused trace (e.g.
+            # ``:d - 0.01`` around a placeholder): the fold must stay
+            # in-graph. Null-producing ops (div by zero) can't — their
+            # nullness depends on the traced VALUE, which a Scalar can't
+            # carry — so they raise here and the consumer falls back to
+            # the (correct) eager path for this stage.
+            lt = jnp.asarray(lv.value, self.dtype.numpy_dtype)
+            rt = jnp.asarray(rv.value, self.dtype.numpy_dtype)
+            if self._extra_validity(lt, rt) is not None:
+                raise TypeError(
+                    f"scalar {self.symbol} over a traced parameter has "
+                    "value-dependent nullability; host fold required")
+            return Scalar(self._compute_safe(lt, rt), self.dtype)
         l = np.asarray(lv.value, self.dtype.numpy_dtype)   # lint: host-sync-ok numpy view of a python literal, no device value
         r = np.asarray(rv.value, self.dtype.numpy_dtype)   # lint: host-sync-ok numpy view of a python literal, no device value
         extra = self._extra_validity(l, r)

@@ -21,7 +21,7 @@ import numpy as np
 from ..columnar import dtypes as dt
 from ..columnar.batch import ColumnarBatch
 from ..columnar.column import Column, Scalar
-from .expressions import Expression, result_column
+from .expressions import Expression, is_traced, result_column
 
 _INT_RANGE = {
     dt.INT8: (-(1 << 7), (1 << 7) - 1),
@@ -122,6 +122,16 @@ def _cast_scalar(v: Scalar, src: dt.DType, dst: dt.DType) -> Scalar:
         return Scalar(None, dst)
     if src == dst:
         return v
+    if is_traced(v.value):
+        # a rebindable Parameter under an active fused trace (the analyzer
+        # coerces placeholder dtypes with Casts, e.g. :q LONG -> DOUBLE):
+        # the cast must compile INTO the program — the numpy fold below
+        # would concretize the tracer and abort the whole stage to eager
+        if not _is_device_castable(src, dst):
+            raise TypeError(
+                f"cast {src}->{dst} of a traced parameter is host-only")
+        return Scalar(device_cast(jnp.asarray(v.value, src.numpy_dtype),
+                                  src, dst, xp=jnp), dst)
     if dst == dt.STRING:
         return Scalar(_format_value(v.value, src), dst)
     if src == dt.STRING:

@@ -24,6 +24,16 @@ from ..columnar.column import Column, Scalar
 ColumnOrScalar = Union[Column, Scalar]
 
 
+def is_traced(value: Any) -> bool:
+    """True when ``value`` is a jax tracer — a rebindable :class:`Parameter`
+    riding an active fused trace. Scalar folds must keep such values
+    in-graph (jnp): any numpy/python conversion would concretize the tracer
+    and abort the whole fused program back to eager."""
+    import jax
+
+    return isinstance(value, jax.core.Tracer)
+
+
 class Expression:
     """Base expression. Subclasses set ``children`` and implement ``dtype``/``eval``."""
 
@@ -138,6 +148,126 @@ class Literal(Expression):
 
     def __repr__(self):
         return f"Literal({self.value!r})"
+
+
+class Parameter(Literal):
+    """A runtime query parameter: a :class:`Literal` whose VALUE is a
+    rebindable scalar argument instead of a plan constant (the serving
+    front door, docs/plan_cache.md).
+
+    The plan cache's parameterization pass replaces eligible constant
+    subtrees with Parameters so q6 with a different date range produces
+    the SAME plan fingerprint and the same compiled ``_fused_fn``
+    signatures — the structural cache key is ``("param", slot, dtype)``,
+    never the value. Fused programs receive the current values as extra
+    traced jit arguments appended after the batch's flat arrays
+    (``ColumnarBatch.params``); eager/CPU paths read ``self.value`` like
+    any literal (Parameter IS-A Literal, so every isinstance fast path
+    keeps working).
+
+    ``slot``: plan-wide parameter index (deterministic traversal order —
+    structural, so two plans of the same shape number identically).
+    ``trace_pos``: position of this parameter inside its consuming fused
+    program's appended argument tuple (stamped by the consumer before its
+    first trace; baked into the compiled program).
+    ``name``: optional prepared-statement placeholder name (``:name``).
+    """
+
+    def __init__(self, value: Any = None, dtype: Optional[dt.DType] = None,
+                 slot: int = -1, name: Optional[str] = None):
+        if dtype is None and value is None:
+            # a named placeholder before its first bind: dtype resolves
+            # from the first execute()'s value
+            Expression.__init__(self)
+            self._dtype = None
+            self.value = None
+        else:
+            super().__init__(value, dtype)
+        self.slot = slot
+        self.param_name = name
+        self.trace_pos: Optional[int] = None
+
+    @property
+    def dtype(self) -> dt.DType:
+        if self._dtype is None:
+            # pre-bind: parse builds throwaway analyzed copies (schema
+            # probes like df.columns) that must not crash on a
+            # placeholder nobody has bound yet — it types as NULLTYPE
+            # there. Execution re-analyzes AFTER binding, and eval()
+            # still refuses to run unbound.
+            return dt.NULLTYPE
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return False          # parameters never bind NULL (bind() rejects)
+
+    def bind(self, value: Any, dtype: Optional[dt.DType] = None,
+             retype: bool = False) -> None:
+        """Rebind the runtime value. The dtype is FIXED once set — the
+        compiled programs were traced for it; only a prepared
+        statement's PARSE-TREE placeholders may ``retype`` (a dtype
+        change there produces a different fingerprint and a fresh
+        plan, never a stale program)."""
+        if value is None:
+            raise ValueError(
+                f"parameter :{self.param_name or self.slot} cannot bind "
+                "NULL (plan a literal NULL instead)")
+        if self._dtype is None or retype:
+            self._dtype = dtype if dtype is not None else \
+                Literal(value).dtype
+        self.value = value
+
+    def traceable(self) -> bool:
+        """Whether this parameter's value can ride as a traced 0-d jit
+        argument (fixed-width scalar dtypes). Non-traceable parameters
+        (strings) stay baked: their VALUE joins the structural cache key
+        so a rebind can never reuse a stale program."""
+        return (self._dtype is not None and
+                self._dtype.numpy_dtype is not None and
+                not self._dtype.var_width)
+
+    def eval(self, batch: ColumnarBatch) -> Scalar:
+        if self._dtype is None or self.value is None:
+            raise RuntimeError(
+                f"unbound parameter :{self.param_name or self.slot} — "
+                "prepared statements must bind every placeholder before "
+                "execution")
+        pv = getattr(batch, "params", ()) if batch is not None else ()
+        if pv and self.trace_pos is not None and self.trace_pos < len(pv):
+            # inside a fused trace: the value is a traced 0-d argument
+            return Scalar(pv[self.trace_pos], self.dtype)
+        return Scalar(self.value, self.dtype)
+
+    def __repr__(self):
+        tag = self.param_name or f"p{self.slot}"
+        return f"Param(:{tag}={self.value!r})"
+
+
+def ordered_params(exprs: Sequence[Expression]) -> List["Parameter"]:
+    """Unique TRACEABLE Parameters across ``exprs`` in slot order, each
+    stamped with its ``trace_pos`` — the canonical appended-argument
+    ordering a fused program and its call sites must agree on.
+    Non-traceable parameters (strings) stay baked; their values ride the
+    structural cache key instead."""
+    by_slot: dict = {}
+    for e in exprs:
+        for p in e.collect(lambda x: isinstance(x, Parameter)):
+            if p.traceable():
+                by_slot.setdefault(p.slot, p)
+    out = [by_slot[s] for s in sorted(by_slot)]
+    for i, p in enumerate(out):
+        p.trace_pos = i
+    return out
+
+
+def param_arg_values(params: Sequence["Parameter"]) -> tuple:
+    """The current binding of each parameter as a dtype-stable numpy
+    scalar — the extra jit arguments appended after a batch's flat
+    arrays. Host-side value boxing, no device sync."""
+    return tuple(
+        np.asarray(p.value, dtype=p.dtype.numpy_dtype)  # lint: host-sync-ok boxes a python scalar host-side; no device value involved
+        for p in params)
 
 
 class ColumnRef(Expression):

@@ -51,6 +51,17 @@ _CALLS = {
     "max": lambda args: co.Greatest(*args),
 }
 
+# Python 3.10 emits one opcode per binary operator; 3.11+ collapsed them
+# into BINARY_OP with the symbol in argrepr. Both map onto _BINOPS.
+_BINOP_310 = {
+    "BINARY_ADD": "+", "BINARY_SUBTRACT": "-", "BINARY_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "BINARY_MODULO": "%", "BINARY_POWER": "**",
+    "BINARY_FLOOR_DIVIDE": "//",
+    "INPLACE_ADD": "+", "INPLACE_SUBTRACT": "-", "INPLACE_MULTIPLY": "*",
+    "INPLACE_TRUE_DIVIDE": "/", "INPLACE_MODULO": "%",
+    "INPLACE_POWER": "**", "INPLACE_FLOOR_DIVIDE": "//",
+}
+
 _MAX_PATHS = 64          # branch-path explosion guard
 
 
@@ -171,8 +182,8 @@ class _Translator:
                 else:
                     raise UdfTranslationError(
                         f"unsupported global {name}")
-            elif op == "BINARY_OP":
-                sym = ins.argrepr.rstrip("=")
+            elif op == "BINARY_OP" or op in _BINOP_310:
+                sym = _BINOP_310.get(op) or ins.argrepr.rstrip("=")
                 if sym not in _BINOPS:
                     raise UdfTranslationError(
                         f"binary op {ins.argrepr}")
@@ -190,7 +201,8 @@ class _Translator:
                 stack.append(ar.UnaryMinus(_as_expr(stack.pop())))
             elif op == "UNARY_NOT":
                 stack.append(pr.Not(_as_expr(stack.pop())))
-            elif op == "CALL":
+            elif op in ("CALL", "CALL_FUNCTION"):
+                # CALL (3.11+) / CALL_FUNCTION (3.10): callable below args
                 argc = ins.arg
                 args = [_as_expr(stack.pop())
                         for _ in range(argc)][::-1]

@@ -628,6 +628,23 @@ def _expr_cache_key(e: ex.Expression):
     are not faithful — e.g. Like's pattern is not in its repr). Returns None
     when an attribute is opaque (unkeyable): the stage then jits per-exec
     instead of sharing the global cache."""
+    if isinstance(e, ex.Parameter):
+        # a traceable parameter's VALUE is a runtime argument, never part
+        # of the compiled program: two plans differing only in bound
+        # values share one fused signature (the zero-recompile serving
+        # property, docs/plan_cache.md). Non-traceable (string) values
+        # stay baked, so the value must ride the key.
+        # slot stringified: it is an IDENTITY, not a shape — the
+        # size-class audit flags raw non-pow2 ints >= 8 in keys as
+        # bucket-discipline leaks (a 9th parameter is not a dimension)
+        if e.slot < 0:
+            # UNSLOTTED (never passed through plan_cache.parameterize):
+            # two such params would collide on one key and share a stale
+            # program — unkeyable forces per-exec compilation instead
+            return None
+        if e.traceable():
+            return ("param", f"s{e.slot}", e.dtype.name)
+        return ("param", f"s{e.slot}", e.dtype.name, repr(e.value))
     parts: list = [type(e).__name__]
     for k, v in sorted(vars(e).items()):
         if k == "children":
@@ -674,6 +691,10 @@ class FusedStage:
         # cache-served ones, so each stage holds up to two variants
         self._fns: Dict[bool, Any] = {}
         self._ekeys = None
+        # query parameters inside the expressions (plan-cache
+        # parameterization): their CURRENT values append to every program
+        # call as extra traced scalars, in stamped trace_pos order
+        self._params = ex.ordered_params(exprs)
 
     @staticmethod
     def maybe(node, exprs, in_schema, out_schema, stateful,
@@ -748,7 +769,8 @@ class FusedStage:
                 _recompile.note_call(self._kernel)
             with trace_span(f"fused_{self.mode}"):
                 outs = fn(_dev_count(batch),
-                          *batch.flat_arrays())
+                          *batch.flat_arrays(),
+                          *ex.param_arg_values(self._params))
         except _ScalarPredicate:
             self.broken = True
             return None
@@ -1513,6 +1535,15 @@ class TpuHashAggregateExec(TpuExec):
             return batch
         return self.pre_stage.eval_eager(batch)
 
+    def _stage_param_args(self) -> tuple:
+        """Current values of the folded chain's query parameters — the
+        extra traced scalars every UPDATE-phase fused program takes after
+        the batch's flat arrays (merge/final programs never evaluate the
+        chain, so they take none)."""
+        if self.pre_stage is None or not self.pre_stage.params:
+            return ()
+        return ex.param_arg_values(self.pre_stage.params)
+
     def _traced_pre_stage(self, b: ColumnarBatch):
         """Folded-chain evaluation inside a fused trace: returns
         (post-chain batch, live-row mask or None). The mask replaces
@@ -1628,6 +1659,10 @@ class TpuHashAggregateExec(TpuExec):
             sig = sig + ("pre_stage", skey)
         build_eval = self._build_eval_fn(phase)
         pschema = self._partial_schema()
+        # folded-chain query parameters ride ONLY the update-phase
+        # programs (the chain evaluates there); current values append
+        # after the flat arrays, positions baked by StageChain stamping
+        pargs = self._stage_param_args() if phase == "update" else ()
 
         try:
             if not self.grouping:
@@ -1647,7 +1682,8 @@ class TpuHashAggregateExec(TpuExec):
                                       ("donate", bool(donate))),
                                build_reduce)
                 with _trace_exec(self):
-                    outs = fn(_dev_count(batch), *batch.flat_arrays())
+                    outs = fn(_dev_count(batch), *batch.flat_arrays(),
+                              *pargs)
                 return ("done", ColumnarBatch.from_flat_arrays(
                     pschema, list(outs), 1))
 
@@ -1657,7 +1693,7 @@ class TpuHashAggregateExec(TpuExec):
                 # with no probe and no host readback (scatter serialization
                 # only bites at scan-batch capacities)
                 return self._dispatch_plain_sort(batch, sig, in_schema, cap,
-                                                 build_eval)
+                                                 build_eval, pargs)
 
             spec_sig = self._spec_signature(phase)
             key_dtype = (self.grouping[0].dtype
@@ -1686,7 +1722,7 @@ class TpuHashAggregateExec(TpuExec):
                 probe = _fused_fn(sig + ("probe", cap), build_probe)
                 with _trace_exec(self):
                     rmin, dec = probe(_dev_count(batch),
-                                      *batch.flat_arrays())
+                                      *batch.flat_arrays(), *pargs)
                 return ("dense", batch, phase, sig, in_schema, cap,
                         rmin, dec)
 
@@ -1709,10 +1745,11 @@ class TpuHashAggregateExec(TpuExec):
         import jax
         import jax.numpy as jnp
         build_eval = self._build_eval_fn(phase)
+        pargs = self._stage_param_args() if phase == "update" else ()
 
         if not _matmul_agg_enabled():
             return self._dispatch_plain_sort(batch, sig, in_schema, cap,
-                                             build_eval)
+                                             build_eval, pargs)
 
         # staged sort path: probe (sort + segments + group-count stats) ->
         # MXU matmul segment kernel with a static group bucket. TPU scatters
@@ -1745,12 +1782,12 @@ class TpuHashAggregateExec(TpuExec):
         probe = _fused_fn(sig + ("sort-probe", cap), build_sort_probe)
         with _trace_exec(self):
             order, starts, n_eff_dev, dec = probe(
-                _dev_count(batch), *batch.flat_arrays())
+                _dev_count(batch), *batch.flat_arrays(), *pargs)
         return ("sortmm", batch, phase, sig, in_schema, cap,
                 order, starts, n_eff_dev, dec)
 
     def _dispatch_plain_sort(self, batch: ColumnarBatch, sig, in_schema, cap,
-                             build_eval):
+                             build_eval, pargs: tuple = ()):
         """Whole sort+scatter group-by in ONE dispatch, count left
         device-resident (no probe, no readback)."""
         import jax
@@ -1770,7 +1807,7 @@ class TpuHashAggregateExec(TpuExec):
         fn = _fused_fn(sig + ("sort", cap, ("donate", bool(donate))),
                        build_sort)
         with _trace_exec(self):
-            outs = fn(_dev_count(batch), *batch.flat_arrays())
+            outs = fn(_dev_count(batch), *batch.flat_arrays(), *pargs)
         pb = ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                             outs[-1])
         return ("done", pb)
@@ -1844,8 +1881,10 @@ class TpuHashAggregateExec(TpuExec):
             return jax.jit(fn, donate_argnums=donate)
         fn = _fused_fn(sig + ("dense", cap, Kb, ("donate", bool(donate))),
                        build_dense)
+        pargs = self._stage_param_args() if phase == "update" else ()
         with _trace_exec(self):
-            outs = fn(_dev_count(batch), rmin, *batch.flat_arrays())
+            outs = fn(_dev_count(batch), rmin, *batch.flat_arrays(),
+                      *pargs)
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                               outs[-1])
 
@@ -1907,9 +1946,10 @@ class TpuHashAggregateExec(TpuExec):
         fn = _fused_fn(sig + ("sort-mm", cap, Kb, use_mm,
                               ("donate", bool(donate))),
                        build_sort_kernel)
+        pargs = self._stage_param_args() if phase == "update" else ()
         with _trace_exec(self):
             outs = fn(_dev_count(batch), order, starts,
-                      n_eff_dev, *batch.flat_arrays())
+                      n_eff_dev, *batch.flat_arrays(), *pargs)
         # group count came back with the probe stats — no second readback
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                               n_groups)

@@ -71,6 +71,10 @@ class StageChain:
         self.steps = list(steps)
         self.in_schema = in_schema
         self.out_schema = out_schema
+        # query parameters inside the chain (plan-cache parameterization):
+        # slot-ordered, each stamped with its trace position — the fused
+        # program's appended-argument contract (docs/plan_cache.md)
+        self.params = ex.ordered_params(self.exprs())
 
     # -- static properties ---------------------------------------------------
     def exprs(self) -> List[ex.Expression]:
@@ -130,7 +134,9 @@ class StageChain:
             else:
                 _tag, exprs, out_schema = step
                 cols = [ex.materialize(e.eval(b), b) for e in exprs]
-                b = ColumnarBatch(out_schema, cols, b.num_rows_raw)
+                nb = ColumnarBatch(out_schema, cols, b.num_rows_raw)
+                nb.params = b.params   # later steps' Parameters still read
+                b = nb
         if mask is not None:
             mask = mask & b.row_mask_raw()
         return b, mask
@@ -229,7 +235,8 @@ class TpuWholeStageExec(TpuExec):
                 # later batches bypass the cache consult (FusedStage note)
                 _recompile.note_call(self._kernel)
             with trace_span("fused_stage"):
-                outs = fn(_dev_count(batch), *batch.flat_arrays())
+                outs = fn(_dev_count(batch), *batch.flat_arrays(),
+                          *ex.param_arg_values(self.chain.params))
         except _ScalarPredicate:
             self.broken = True
             return None

@@ -108,6 +108,13 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_device_count",
     "tpu_backend_info",                 # label platform=..., value 1
     "tpu_flight_events_total",
+    # serving front door (plan/plan_cache.py, docs/plan_cache.md)
+    "tpu_plan_cache_hits_total",
+    "tpu_plan_cache_misses_total",
+    "tpu_plan_cache_entries",
+    "tpu_result_cache_hits_total",
+    "tpu_result_cache_misses_total",
+    "tpu_result_cache_bytes",
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
