@@ -68,11 +68,20 @@ FUSION_AB_Q6 = "fusion_ab_q6"
 PLAN_CACHE_PLANS_PER_S = "plan_cache_plans_per_s"
 WARM_TRAFFIC_Q6_S = "warm_traffic_q6_s"
 
+#: chaos-mode series stamped by bench.py (ISSUE 13, docs/resilience.md):
+#: wall seconds of a q6-shaped shuffled run completing UNDER injected
+#: faults (a failed fetch + a poisoned map batch absorbed by stage
+#: retry) with results identical to the fault-free run — lower is
+#: better, so a recovery-time regression fails the gate like any perf
+#: regression. Stamped only when the chaos honesty checks pass
+#: (identical rows, >=1 stage retry, every armed fault fired).
+CHAOS_Q6_RECOVERY_S = "chaos_q6_recovery_s"
+
 #: queries whose direction flips relative to their round's
 #: ``higherIsBetter`` flag (seconds-valued series riding a throughput
 #: round): recorded per entry so old history lines stay judgeable
 INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP,
-                              WARM_TRAFFIC_Q6_S})
+                              WARM_TRAFFIC_Q6_S, CHAOS_Q6_RECOVERY_S})
 
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)

@@ -1,0 +1,255 @@
+"""Deterministic, conf-gated fault injection (the chaos harness).
+
+The reference validates failure semantics against Spark's scheduler with
+mocked transports (SURVEY.md §4 ring-1); standalone, recovery paths are
+unreachable from tests unless the engine can *inject* the failures it
+recovers from. This module is that harness: a handful of named injection
+points wired into the shuffle/transport/task hot paths, armed by conf
+``spark.rapids.tpu.sql.faults.spec`` (or :func:`install` directly), each
+firing a bounded, deterministic number of times — counts, never
+probabilities, so a chaos test is exactly reproducible.
+
+Spec grammar (see docs/resilience.md)::
+
+    spec     := clause (';' clause)*
+    clause   := point [':' count] ['@' selector]
+    point    := fetch.fail | conn.kill | task.poison | worker.die
+              | mesh.drop
+    count    := positive int, default 1 — firings before the clause
+                disarms
+    selector := 'p<pid>' ['b<batch>'] | 'b<batch>'   (task.poison)
+              | '<n>'                                 (conn.kill: kill
+                after n chunks of a send window, default 1)
+
+Points and where they fire:
+
+* ``fetch.fail`` — a shuffle fetch attempt raises an injected
+  ConnectionError before touching the wire (transport client) or an
+  injected ShuffleFetchError before the local pull (exchange reduce
+  read) — the "fail a fetch on first attempt" probe.
+* ``conn.kill`` — the transfer server tears the connection mid send
+  window after ``n`` chunks (torn stream on the fetching client).
+* ``task.poison`` — a partition task body raises
+  :class:`~spark_rapids_tpu.exec.recovery.InjectedTaskFault`; with a
+  ``b<batch>`` selector the exchange map loop poisons exactly batch N.
+* ``worker.die`` — the shuffle server drops the next incoming
+  connection unserved; registered :func:`on_fire` callbacks let a test
+  or bench stop (and later restart) the server at that exact protocol
+  point — a deterministic worker death.
+* ``mesh.drop`` — the next exchange plane resolution sees the ICI mesh
+  as having lost a participant (``exec/recovery.note_mesh_lost``) and
+  declines gracefully to DCN.
+
+Every firing lands in the flight recorder (kind ``fault``) and bumps
+``tpu_faults_injected_total``, so a recovery post-mortem shows the
+injected cause right next to the recovery it triggered.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+from .lockdep import named_lock
+
+POINTS = ("fetch.fail", "conn.kill", "task.poison", "worker.die",
+          "mesh.drop")
+
+_CLAUSE_RE = re.compile(
+    r"^(?P<point>[a-z.]+)(?::(?P<count>\d+))?(?:@(?P<sel>[a-z0-9]+))?$")
+_TASK_SEL_RE = re.compile(r"^(?:p(?P<pid>\d+))?(?:b(?P<batch>\d+))?$")
+
+
+class FaultSpecError(ValueError):
+    """The faults.spec string does not parse — raised loudly at install
+    (a chaos run with a typo'd spec must not silently run fault-free)."""
+
+
+class _Fault:
+    """One armed clause: remaining firings + optional selector."""
+
+    def __init__(self, point: str, count: int,
+                 pid: Optional[int] = None, batch: Optional[int] = None,
+                 after: Optional[int] = None):
+        self.point = point
+        self.remaining = count
+        self.pid = pid
+        self.batch = batch
+        self.after = after          # conn.kill: chunks before the kill
+
+    def matches(self, pid=None, batch=None, chunk=None) -> bool:
+        if self.pid is not None and pid != self.pid:
+            return False
+        if self.batch is not None and batch != self.batch:
+            return False
+        if self.point == "conn.kill":
+            want = self.after if self.after is not None else 1
+            if chunk is None or chunk < want:
+                return False
+        return True
+
+
+def parse_spec(spec: str) -> List[_Fault]:
+    """Parse the spec grammar into armed clauses; bad specs raise
+    :class:`FaultSpecError` naming the offending clause."""
+    out: List[_Fault] = []
+    for raw in (spec or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _CLAUSE_RE.match(raw)
+        if not m:
+            raise FaultSpecError(f"unparseable faults clause {raw!r} "
+                                 "(grammar: point[:count][@selector])")
+        point = m.group("point")
+        if point not in POINTS:
+            raise FaultSpecError(
+                f"unknown fault point {point!r} (known: {POINTS})")
+        count = int(m.group("count") or 1)
+        if count < 1:
+            raise FaultSpecError(f"fault count must be >= 1 in {raw!r}")
+        sel = m.group("sel")
+        pid = batch = after = None
+        if sel is not None:
+            if point == "task.poison":
+                sm = _TASK_SEL_RE.match(sel)
+                if not sm or (sm.group("pid") is None and
+                              sm.group("batch") is None):
+                    raise FaultSpecError(
+                        f"bad task.poison selector {sel!r} "
+                        "(expect p<pid>, b<batch> or p<pid>b<batch>)")
+                pid = int(sm.group("pid")) if sm.group("pid") else None
+                batch = int(sm.group("batch")) if sm.group("batch") \
+                    else None
+            elif point == "conn.kill":
+                if not sel.isdigit():
+                    raise FaultSpecError(
+                        f"bad conn.kill selector {sel!r} (expect the "
+                        "chunk count to survive before the kill)")
+                after = int(sel)
+            else:
+                raise FaultSpecError(
+                    f"fault point {point} takes no selector ({raw!r})")
+        out.append(_Fault(point, count, pid=pid, batch=batch, after=after))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Process-global armed plan
+# ---------------------------------------------------------------------------
+
+_mu = named_lock("analysis.faults._mu")
+_plan: List[_Fault] = []
+_callbacks: Dict[str, List[Callable]] = {}
+_fired_total = 0
+#: lock-free fast-path flag read on hot paths (map-task batch loops):
+#: True only while at least one clause is armed. Written under ``_mu``
+#: only; a stale read costs one extra locked check, never a missed fire.
+ARMED = False
+
+
+def install(spec: str) -> int:
+    """Arm the harness from a spec string (replacing any prior plan and
+    zeroing :func:`fired_total` — counts are per armed plan, so a chaos
+    test asserts exact firing counts); returns the number of armed
+    clauses. ``install("")`` disarms."""
+    global _plan, ARMED, _fired_total
+    clauses = parse_spec(spec)
+    with _mu:
+        _plan = clauses
+        _fired_total = 0
+        ARMED = bool(clauses)
+    return len(clauses)
+
+
+#: the mesh-loss reason an injected mesh.drop records — reset() only
+#: clears THIS loss (a real topology loss must survive a harness reset)
+INJECTED_MESH_DROP_REASON = "injected mesh drop (faults.spec)"
+
+
+def reset() -> None:
+    """Disarm every clause, drop registered callbacks, and undo the one
+    fault effect that outlives its firing: an injected mesh drop
+    (tests / bench teardown — chaos must never leak downstream)."""
+    global _plan, ARMED, _fired_total
+    with _mu:
+        _plan = []
+        _callbacks.clear()
+        _fired_total = 0
+        ARMED = False
+    from ..exec import recovery
+    if recovery.mesh_lost() == INJECTED_MESH_DROP_REASON:
+        recovery.clear_mesh_lost()
+
+
+def refresh(conf=None) -> None:
+    """Prime the harness from a session conf (session bootstrap calls
+    this eagerly, the telemetry/lockdep pattern)."""
+    from .. import config as cfg
+    conf = conf or cfg.TpuConf()
+    install(str(conf.get(cfg.FAULTS_SPEC)))
+
+
+def armed() -> bool:
+    return ARMED
+
+
+def on_fire(point: str, callback: Callable[[], None]) -> None:
+    """Register a callback run (outside the plan lock) when ``point``
+    fires — the hook a chaos test uses to stop a server at the exact
+    injected protocol point. Callback errors are swallowed: a broken
+    chaos hook must not change the failure being injected."""
+    if point not in POINTS:
+        raise FaultSpecError(f"unknown fault point {point!r}")
+    with _mu:
+        _callbacks.setdefault(point, []).append(callback)
+
+
+def fired_total() -> int:
+    with _mu:
+        return _fired_total
+
+
+def fire(point: str, pid=None, batch=None, chunk=None) -> bool:
+    """True exactly when an armed clause for ``point`` matches the call
+    context: decrements the clause, flight-records the firing, bumps
+    ``tpu_faults_injected_total`` and runs registered callbacks. The
+    injection site raises its fault when this returns True."""
+    global _fired_total, ARMED
+    if not ARMED:
+        return False
+    with _mu:
+        hit = None
+        for f in _plan:
+            if f.point == point and f.remaining > 0 and \
+                    f.matches(pid=pid, batch=batch, chunk=chunk):
+                hit = f
+                break
+        if hit is None:
+            return False
+        hit.remaining -= 1
+        _fired_total += 1
+        ARMED = any(f.remaining > 0 for f in _plan)
+        cbs = list(_callbacks.get(point, ()))
+    # side effects OUTSIDE the plan lock: the flight recorder and the
+    # metrics registry take their own (leaf) locks, and callbacks may
+    # stop servers / join threads
+    data = {k: v for k, v in
+            (("pid", pid), ("batch", batch), ("chunk", chunk))
+            if v is not None}
+    from ..service.telemetry import MetricsRegistry, flight_record
+    flight_record("fault", point, data or None)
+    try:
+        MetricsRegistry.get().counter(
+            "tpu_faults_injected_total",
+            "deterministic chaos-harness firings").inc()
+    except Exception:
+        pass                      # telemetry must never change the fault
+    for cb in cbs:
+        try:
+            cb()
+        except Exception:
+            import logging
+            logging.getLogger("spark_rapids_tpu.faults").exception(
+                "faults.on_fire callback for %s failed", point)
+    return True

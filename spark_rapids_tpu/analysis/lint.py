@@ -45,6 +45,14 @@ the first argument of a ``<registry>.counter("...")`` /
 ``service/telemetry.py``'s ``TELEMETRY_KEYS`` tuple (the metric-key
 rule's analog for the process-lifetime scrape surface).
 
+``bare-recover``: an ``except`` clause naming a recoverable-taxonomy
+type (ShuffleFetchError and subclasses, BufferLostError,
+InjectedTaskFault — the exec/recovery.py domain) outside
+``exec/recovery.py`` carries a ``# lint: recover-ok <reason>`` pragma.
+Retry/recovery decisions belong to the ONE stage-retry driver; a bare
+catch elsewhere is how retry logic quietly forks into second
+implementations (docs/resilience.md).
+
 The linter is pure AST + text: no engine import, no jax import.
 """
 
@@ -86,6 +94,19 @@ BASE_METRIC_KEYS = {"numOutputRows", "numOutputBatches", "opTime",
 
 PRAGMA_RE = re.compile(r"#\s*lint:\s*host-sync-ok(.*)$")
 NAKED_JIT_PRAGMA_RE = re.compile(r"#\s*lint:\s*naked-jit-ok(.*)$")
+RECOVER_PRAGMA_RE = re.compile(r"#\s*lint:\s*recover-ok(.*)$")
+
+# mirror of exec/recovery's taxonomy (the linter is pure AST and cannot
+# import the engine): exception names whose `except` clauses are
+# recovery decisions — catching one outside the stage-retry driver
+# needs a reasoned pragma (bare-recover rule)
+RECOVER_TAXONOMY_NAMES = {
+    "ShuffleFetchError", "ShuffleWorkerLostError", "ShuffleDesyncError",
+    "ShuffleProtocolError", "BufferLostError", "InjectedTaskFault",
+    "recoverable_types",          # except recovery.recoverable_types():
+}
+#: the one module allowed to catch taxonomy types bare
+RECOVER_MODULE = "exec/recovery.py"
 
 
 @dataclass
@@ -218,6 +239,10 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # persistent compile cache watch — or carry a reasoned pragma
     out.extend(_check_naked_jit(tree, source, path))
 
+    # bare-recover (whole package): taxonomy catches outside the
+    # stage-retry driver carry a reasoned pragma
+    out.extend(_check_bare_recover(tree, source, rel, path))
+
     if rel in EXEC_MODULES:
         for node in ast.walk(tree):
             if isinstance(node, ast.ClassDef) and \
@@ -241,6 +266,74 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # concurrency.py imports LintViolation from here
     from . import concurrency
     out.extend(concurrency.lint_source(source, rel, path=path))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bare-recover: taxonomy catches outside exec/recovery.py need a pragma
+# ---------------------------------------------------------------------------
+
+def _handler_exception_names(handler: ast.ExceptHandler) -> List[str]:
+    """The taxonomy-relevant names an except clause catches: bare names,
+    dotted tails (``transport.ShuffleFetchError``), tuple members, and
+    the ``recovery.recoverable_types()`` call form — the whole taxonomy
+    at once, which needs the pragma most of all."""
+    t = handler.type
+    if t is None:
+        return []
+    nodes = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+    names: List[str] = []
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.append(n.attr)
+        elif isinstance(n, ast.Call):
+            f = n.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname == "recoverable_types":
+                names.append("recoverable_types")
+    return names
+
+
+def _check_bare_recover(tree: ast.AST, source: str, rel: str, path: str
+                        ) -> List[LintViolation]:
+    """``bare-recover``: an except clause naming a recoverable-taxonomy
+    type outside exec/recovery.py without a reasoned recover-ok pragma —
+    a recovery decision made outside the one stage-retry driver
+    (docs/resilience.md)."""
+    out: List[LintViolation] = []
+    pragmas: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = RECOVER_PRAGMA_RE.search(line)
+        if m:
+            reason = m.group(1).strip()
+            if not reason:
+                out.append(LintViolation(
+                    path, i, "pragma-reason",
+                    "recover-ok pragma missing its justification "
+                    "(format: `# lint: recover-ok <reason>`)"))
+            pragmas[i] = reason
+    if rel == RECOVER_MODULE:
+        return out                         # the driver's own domain
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = [n for n in _handler_exception_names(node)
+                  if n in RECOVER_TAXONOMY_NAMES]
+        if not caught:
+            continue
+        if any(l in pragmas and pragmas[l]
+               for l in (node.lineno, node.lineno - 1)):
+            continue
+        out.append(LintViolation(
+            path, node.lineno, "bare-recover",
+            f"except of recoverable-taxonomy type(s) {sorted(caught)} "
+            "outside exec/recovery.py — route the decision through the "
+            "stage-retry driver (exec/recovery.retry_stage / "
+            "StageRetryState) or pragma with "
+            "`# lint: recover-ok <reason>`"))
     return out
 
 

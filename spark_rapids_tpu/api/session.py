@@ -63,6 +63,16 @@ class RuntimeConf:
         if ".compile." in key:
             from ..exec import compile_cache
             compile_cache.configure(self._session.conf)
+        # recovery budget / durable tier / fetch-retry knobs / chaos
+        # plan re-prime on their keys (they cache per process like the
+        # audits)
+        if ".recovery." in key or ".shuffle.durable" in key or \
+                ".shuffle.fetch." in key:
+            from ..exec import recovery
+            recovery.refresh(self._session.conf)
+        if ".faults." in key:
+            from ..analysis import faults
+            faults.refresh(self._session.conf)
         # ANY conf change drops the session's serving caches: cached
         # plans were analyzed/optimized/validated under the old conf, and
         # a stored result may have been produced by it
@@ -195,6 +205,13 @@ class TpuSession:
         # loads the fused-program signature index; degrades gracefully
         from ..exec import compile_cache
         compile_cache.configure(self.conf)
+        # recovery knobs + fault-injection plan prime EAGERLY (the
+        # lockdep pattern: a lazy conf read inside a failing partition
+        # drain could recurse into the conf-registry lock)
+        from ..analysis import faults
+        from ..exec import recovery
+        recovery.refresh(self.conf)
+        faults.refresh(self.conf)
 
     @classmethod
     def active(cls) -> "TpuSession":

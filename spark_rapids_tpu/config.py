@@ -272,6 +272,56 @@ SHUFFLE_PIPELINE_DEPTH = _conf("spark.rapids.tpu.sql.shuffle.pipelineDepth").doc
     "grows by one sorted batch per slot"
 ).integer_conf.check(lambda v: int(v) >= 1).create_with_default(8)
 
+SHUFFLE_DURABLE = _conf("spark.rapids.tpu.sql.shuffle.durable").doc(
+    "Durable shuffle outputs (docs/resilience.md): map outputs stay "
+    "registered (re-fetchable) until the exchange releases them and are "
+    "pinned through the spill store's host/disk tiers — a consumer-side "
+    "stage retry re-fetches instead of re-running the map stage, and a "
+    "multi-process worker that dies and rejoins re-serves its outputs "
+    "from the durable .npz tier (the reference's checkpoint/resume "
+    "trade, SURVEY §5). Off keeps the memory-only fast path"
+).boolean_conf.create_with_default(False)
+
+SHUFFLE_FETCH_MAX_RETRIES = _conf(
+    "spark.rapids.tpu.sql.shuffle.fetch.maxRetries").doc(
+    "Transport-level retries per shuffle fetch before the failure "
+    "escalates to the stage-retry taxonomy (exec/recovery.py): each "
+    "retry uses a fresh connection; CRC mismatches and connection "
+    "failures retry, desyncs never do (ShuffleClient; attempts are "
+    "metered into tpu_shuffle_retries_total)"
+).integer_conf.check(lambda v: int(v) >= 0).create_with_default(3)
+
+SHUFFLE_FETCH_RETRY_BACKOFF = _conf(
+    "spark.rapids.tpu.sql.shuffle.fetch.retryBackoff").doc(
+    "Linear backoff (seconds x attempt) between transport-level fetch "
+    "retries").double_conf.check(
+        lambda v: float(v) >= 0).create_with_default(0.05)
+
+RECOVERY_MAX_STAGE_RETRIES = _conf(
+    "spark.rapids.tpu.sql.recovery.maxStageRetries").doc(
+    "Stage re-executions a recoverable failure (lost shuffle buffer, "
+    "fetch give-up, dead worker, injected task fault) may consume "
+    "before the query fails — the standalone analog of Spark's "
+    "spark.stage.maxConsecutiveAttempts driving FetchFailed map-stage "
+    "retries (docs/resilience.md). 0 propagates every failure"
+).integer_conf.check(lambda v: int(v) >= 0).create_with_default(2)
+
+RECOVERY_RETRY_BACKOFF = _conf(
+    "spark.rapids.tpu.sql.recovery.retryBackoff").doc(
+    "Linear backoff (seconds x attempt) between stage retries "
+    "(dead-worker liveness probes pace on their own exponential "
+    "window, one fetch timeout per budget attempt)"
+).double_conf.check(lambda v: float(v) >= 0).create_with_default(0.1)
+
+FAULTS_SPEC = _conf("spark.rapids.tpu.sql.faults.spec").doc(
+    "Deterministic fault-injection spec for the chaos harness "
+    "(analysis/faults.py, docs/resilience.md): semicolon-separated "
+    "point[:count][@selector] clauses over fetch.fail, conn.kill, "
+    "task.poison, worker.die, mesh.drop — each fires a bounded number "
+    "of times, flight-recorded and counted in "
+    "tpu_faults_injected_total. Empty disables injection"
+).string_conf.create_with_default("")
+
 SHUFFLE_COMPRESSION_CODEC = _conf("spark.rapids.tpu.shuffle.compression.codec").doc(
     "Codec for shuffle transfer payloads: none, zlib (ref: spark.rapids."
     "shuffle.compression.codec / NvcompLZ4CompressionCodec, "

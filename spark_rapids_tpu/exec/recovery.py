@@ -1,0 +1,368 @@
+"""Recoverable-error taxonomy + stage-retry driver.
+
+The reference inherits failure semantics from Spark: a fetch failure
+surfaces as FetchFailed and the scheduler re-executes the producing map
+stage; executor loss triggers lineage recompute; OOM falls back to spill
+(SURVEY.md §3.5, §5 "Failure detection / elastic recovery"). Standalone,
+this module is the scheduler's stand-in: the ONE place that decides what
+a failure means and drives bounded re-execution.
+
+Taxonomy -> action (:func:`classify`):
+
+=========================  =============  ====================================
+error                      action         rationale
+=========================  =============  ====================================
+ShuffleDesyncError         FAIL_QUERY     lockstep streams diverged; retrying
+                                          would pair wrong data
+ShuffleProtocolError       FAIL_QUERY     peer alive but confused (version
+                                          skew / unknown buffer); a retry
+                                          re-asks the same confused peer
+ShuffleWorkerLostError     RETRY_STAGE    the consuming stage re-fetches from
+                                          durable outputs once the worker
+                                          rejoins (the lost worker is
+                                          excluded until a probe readmits it)
+ShuffleFetchError (base)   RETRY_STAGE    transport gave up after its own
+                                          retries; re-execute the producing
+                                          stage from durable inputs
+BufferLostError            RETRY_STAGE    a spill-store buffer vanished; the
+                                          map refill recomputes it (Spark
+                                          FetchFailed -> map-stage retry)
+InjectedTaskFault          RETRY_STAGE    chaos-harness poison: recoverable
+                                          by construction
+ConnectionError/OSError    RETRY_FETCH    transient transport error: the
+                                          ShuffleClient retry loop's domain,
+                                          below stage granularity
+anything else              FAIL_QUERY     unknown failures propagate unmasked
+=========================  =============  ====================================
+
+Retry budget and backoff come from ``spark.rapids.tpu.sql.recovery.*``
+(primed eagerly at session bootstrap like lockdep/telemetry — a lazy
+conf read inside a failing drain could recurse into the conf-registry
+lock). Every recovery event bumps the ``tpu_stage_retries_total`` /
+``tpu_worker_lost_total`` counters, observes ``tpu_recovery_seconds``
+on success, and lands in the flight recorder (kind ``recovery``) so a
+post-mortem shows the decision trail.
+
+This module is the only place allowed to catch taxonomy types bare:
+everywhere else, lint rule ``bare-recover`` requires a
+``# lint: recover-ok <reason>`` pragma so retry logic cannot quietly
+fork into second implementations (docs/resilience.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from enum import Enum
+from typing import Callable, Optional, Tuple
+
+from ..analysis.lockdep import named_lock
+
+log = logging.getLogger("spark_rapids_tpu.recovery")
+
+
+class RecoveryAction(Enum):
+    RETRY_FETCH = "retry-fetch"    # below stage granularity (transport)
+    RETRY_STAGE = "retry-stage"    # re-execute the producing stage
+    FAIL_QUERY = "fail-query"      # propagate unmasked
+
+
+class InjectedTaskFault(RuntimeError):
+    """A chaos-harness task poison (analysis/faults.py ``task.poison``):
+    recoverable by construction — the stage retry must absorb it."""
+
+
+def recoverable_types() -> Tuple[type, ...]:
+    """The exception types a stage-retry loop may legally absorb."""
+    from ..shuffle.transport import ShuffleFetchError
+    from .spill import BufferLostError
+    return (ShuffleFetchError, BufferLostError, InjectedTaskFault)
+
+
+def classify(exc: BaseException) -> RecoveryAction:
+    """Map one failure to its recovery action (the table above)."""
+    from ..shuffle.transport import (ShuffleDesyncError, ShuffleFetchError,
+                                     ShuffleProtocolError,
+                                     ShuffleWorkerLostError)
+    from .spill import BufferLostError
+    if isinstance(exc, ShuffleDesyncError):
+        return RecoveryAction.FAIL_QUERY
+    if isinstance(exc, ShuffleProtocolError):
+        return RecoveryAction.FAIL_QUERY
+    if isinstance(exc, (ShuffleWorkerLostError, ShuffleFetchError,
+                        BufferLostError, InjectedTaskFault)):
+        return RecoveryAction.RETRY_STAGE
+    if isinstance(exc, (ConnectionError, OSError)):
+        return RecoveryAction.RETRY_FETCH
+    return RecoveryAction.FAIL_QUERY
+
+
+# ---------------------------------------------------------------------------
+# Conf-primed knobs (session bootstrap calls refresh, lockdep pattern)
+# ---------------------------------------------------------------------------
+
+_mu = named_lock("exec.recovery._mu")
+_max_stage_retries: Optional[int] = None
+_backoff_s: Optional[float] = None
+_shuffle_durable: Optional[bool] = None
+_fetch_max_retries: Optional[int] = None
+_fetch_backoff_s: Optional[float] = None
+_spill_dir: Optional[str] = None
+_mesh_lost_reason: Optional[str] = None
+
+
+def refresh(conf=None) -> None:
+    """Prime retry budget / backoff / durability / transport fetch-retry
+    knobs from a session conf (ShuffleClient reads the fetch knobs from
+    here: client construction happens below the session layer, so the
+    primed state is how the active session's conf reaches it)."""
+    global _max_stage_retries, _backoff_s, _shuffle_durable
+    global _fetch_max_retries, _fetch_backoff_s, _spill_dir
+    from .. import config as cfg
+    conf = conf or cfg.TpuConf()
+    with _mu:
+        _max_stage_retries = int(conf.get(cfg.RECOVERY_MAX_STAGE_RETRIES))
+        _backoff_s = float(conf.get(cfg.RECOVERY_RETRY_BACKOFF))
+        _shuffle_durable = bool(conf.get(cfg.SHUFFLE_DURABLE))
+        _fetch_max_retries = int(conf.get(cfg.SHUFFLE_FETCH_MAX_RETRIES))
+        _fetch_backoff_s = float(
+            conf.get(cfg.SHUFFLE_FETCH_RETRY_BACKOFF))
+        _spill_dir = str(conf.spill_dir)
+
+
+def reset_cache() -> None:
+    """Drop the primed knobs (tests / conf mutation re-prime lazily)."""
+    global _max_stage_retries, _backoff_s, _shuffle_durable
+    global _fetch_max_retries, _fetch_backoff_s, _spill_dir
+    with _mu:
+        _max_stage_retries = None
+        _backoff_s = None
+        _shuffle_durable = None
+        _fetch_max_retries = None
+        _fetch_backoff_s = None
+        _spill_dir = None
+
+
+def _primed() -> Tuple:
+    with _mu:
+        knobs = (_max_stage_retries, _backoff_s, _shuffle_durable,
+                 _fetch_max_retries, _fetch_backoff_s, _spill_dir)
+    if knobs[0] is None:
+        refresh(None)
+        with _mu:
+            knobs = (_max_stage_retries, _backoff_s, _shuffle_durable,
+                     _fetch_max_retries, _fetch_backoff_s, _spill_dir)
+    return knobs
+
+
+def max_stage_retries() -> int:
+    return _primed()[0]
+
+
+def retry_backoff_s() -> float:
+    return _primed()[1]
+
+
+def shuffle_durable() -> bool:
+    return _primed()[2]
+
+
+def fetch_max_retries() -> int:
+    return _primed()[3]
+
+
+def fetch_retry_backoff_s() -> float:
+    return _primed()[4]
+
+
+def spill_dir() -> str:
+    """The session-primed spill directory (the durable shuffle root
+    lives under it; WorkerContext sits below the session layer, so the
+    primed state is how the active session's conf reaches it)."""
+    return _primed()[5]
+
+
+# ---------------------------------------------------------------------------
+# Mesh-participant loss (graceful ICI -> DCN decline)
+# ---------------------------------------------------------------------------
+
+def note_mesh_lost(reason: str) -> None:
+    """Record that the ICI mesh plane lost a participant: subsequent
+    ``auto`` exchanges decline to DCN instead of dispatching a
+    collective that would hang on the missing chip."""
+    global _mesh_lost_reason
+    with _mu:
+        already = _mesh_lost_reason is not None
+        _mesh_lost_reason = reason
+    if not already:
+        log.warning("ICI mesh marked lost (%s): exchanges decline to DCN",
+                    reason)
+        from ..service.telemetry import flight_record
+        flight_record("recovery", "mesh-lost", {"reason": reason})
+
+
+def mesh_lost() -> Optional[str]:
+    """The loss reason while the mesh is marked lost, else None."""
+    with _mu:
+        return _mesh_lost_reason
+
+
+def clear_mesh_lost() -> None:
+    """Re-admit the mesh plane (tests / a topology re-probe)."""
+    global _mesh_lost_reason
+    with _mu:
+        _mesh_lost_reason = None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry funnels (push-style, recovery is a cold path)
+# ---------------------------------------------------------------------------
+
+def note_stage_retry(stage: str, exc: BaseException, attempt: int) -> None:
+    """One stage re-execution decision: counter + flight record + log."""
+    from ..service.telemetry import MetricsRegistry, flight_record
+    log.warning("stage %s failed (%s: %s); retry %d/%d",
+                stage, type(exc).__name__, exc, attempt,
+                max_stage_retries())
+    flight_record("recovery", f"stage-retry-{stage}",
+                  {"error": f"{type(exc).__name__}: {exc}"[:300],
+                   "attempt": attempt})
+    try:
+        MetricsRegistry.get().counter(
+            "tpu_stage_retries_total",
+            "stage re-executions absorbed by recovery").inc()
+    except Exception:
+        pass
+
+
+def note_worker_lost(worker_id: int, exc: Optional[BaseException] = None
+                     ) -> None:
+    from ..service.telemetry import MetricsRegistry, flight_record
+    log.warning("shuffle worker %d marked lost%s", worker_id,
+                f" ({exc})" if exc else "")
+    flight_record("recovery", f"worker-lost-{worker_id}",
+                  {"error": str(exc)[:300]} if exc else None)
+    try:
+        MetricsRegistry.get().counter(
+            "tpu_worker_lost_total",
+            "peer workers observed dead (failed-send detection)").inc()
+    except Exception:
+        pass
+
+
+def note_worker_rejoin(worker_id: int) -> None:
+    from ..service.telemetry import MetricsRegistry, flight_record
+    log.warning("shuffle worker %d rejoined", worker_id)
+    flight_record("recovery", f"worker-rejoin-{worker_id}")
+    try:
+        MetricsRegistry.get().counter(
+            "tpu_worker_rejoin_total",
+            "peer workers re-admitted after loss").inc()
+    except Exception:
+        pass
+
+
+def observe_recovery_seconds(seconds: float) -> None:
+    from ..service.telemetry import MetricsRegistry
+    try:
+        MetricsRegistry.get().histogram(
+            "tpu_recovery_seconds",
+            "wall seconds from first recoverable failure to recovered "
+            "success").observe(seconds)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The stage-retry driver
+# ---------------------------------------------------------------------------
+
+class StageRetryState:
+    """Bookkeeping for one stage's bounded retry loop.
+
+    Usage::
+
+        rs = StageRetryState("shuffle-map")
+        while True:
+            try:
+                out = attempt()
+                rs.succeeded()
+                break
+            except recovery.recoverable_types() as e:  # in recovery's
+                rs.failed(e)        # re-raises when not retryable    # domain
+
+    ``failed`` classifies the error, counts the attempt against the
+    ``recovery.maxStageRetries`` budget, sleeps the linear backoff and
+    returns — or re-raises when the action is FAIL_QUERY, the budget is
+    exhausted, or the caller's ``retryable`` gate says no. ``succeeded``
+    observes ``tpu_recovery_seconds`` when any retry happened."""
+
+    def __init__(self, stage: str,
+                 retryable: Optional[Callable[[BaseException], bool]] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
+        self.stage = stage
+        self.attempts = 0
+        self._retryable = retryable
+        self._max = max_stage_retries() if max_retries is None \
+            else int(max_retries)
+        self._backoff = retry_backoff_s() if backoff_s is None \
+            else float(backoff_s)
+        self._first_failure_t: Optional[float] = None
+
+    def failed(self, exc: BaseException, sleep: bool = True) -> None:
+        """Account one failure; returns to retry, raises to give up.
+        ``sleep=False`` defers the backoff to :meth:`sleep_backoff` so
+        the caller can discard partial state (a half-written shuffle's
+        pinned buffers) BEFORE the dwell instead of holding it through."""
+        action = classify(exc)
+        if action is RecoveryAction.FAIL_QUERY:
+            raise exc
+        if self._retryable is not None and not self._retryable(exc):
+            raise exc
+        if self._first_failure_t is None:
+            self._first_failure_t = time.monotonic()
+        self.attempts += 1
+        if self.attempts > self._max:
+            log.error("stage %s: recovery budget exhausted after %d "
+                      "retries", self.stage, self._max)
+            raise exc
+        note_stage_retry(self.stage, exc, self.attempts)
+        if sleep:
+            self.sleep_backoff()
+
+    def sleep_backoff(self) -> None:
+        if self._backoff > 0:
+            time.sleep(self._backoff * self.attempts)
+
+    def succeeded(self) -> None:
+        if self.attempts and self._first_failure_t is not None:
+            seconds = time.monotonic() - self._first_failure_t
+            observe_recovery_seconds(seconds)
+            from ..service.telemetry import flight_record
+            flight_record("recovery", f"recovered-{self.stage}",
+                          {"retries": self.attempts,
+                           "seconds": round(seconds, 4)})
+
+
+def retry_stage(stage: str, attempt: Callable[[], object],
+                on_retry: Optional[Callable[[BaseException, int], None]]
+                = None, **kw):
+    """Run ``attempt()`` under a :class:`StageRetryState` loop.
+    ``on_retry(exc, attempt_no)`` runs before each re-execution so the
+    caller can discard partial state (a half-written shuffle)."""
+    rs = StageRetryState(stage, **kw)
+    while True:
+        try:
+            out = attempt()
+        except recoverable_types() as e:
+            # discard partial state BEFORE the backoff dwell: a
+            # half-written shuffle's buffers must not stay pinned
+            # through the sleep
+            rs.failed(e, sleep=False)  # re-raises when not retryable
+            if on_retry is not None:
+                on_retry(e, rs.attempts)
+            rs.sleep_backoff()
+            continue
+        rs.succeeded()
+        return out

@@ -128,6 +128,12 @@ class SpillableBuffer:
         # python-object payloads that never touch the device; they ride the
         # buffer untiered (already host-resident, nothing to spill)
         self._obj_cols = obj_cols or {}
+        # durable-shuffle pin (BufferCatalog.pin_to_disk): a pinned
+        # buffer's npz payload is RETAINED across promotion (immutable,
+        # write-once) so the post-read re-pin is a tier flip, not a
+        # fresh D2H + savez round trip per read
+        self.disk_pinned = False
+        self._pinned_path: Optional[str] = None
         # every buffer lock shares ONE lockdep name (a lock CLASS, kernel-
         # lockdep style): order edges are per class of lock, not per buffer
         self._lock = named_rlock("exec.spill.SpillableBuffer._lock")
@@ -169,6 +175,14 @@ class SpillableBuffer:
         return self.size_bytes
 
     def spill_to_disk(self, spill_dir: str) -> int:
+        # zero-IO path for disk-pinned buffers already staged on host:
+        # the retained npz IS the payload (immutable), so the pressure
+        # cascade's host->disk move restores it instead of paying a
+        # fresh savez rewrite at the worst possible time. HOST-only:
+        # callers' accounting assumes the bytes came off the host tier
+        if self.demote_to_pinned_disk(
+                only_from=StorageTier.HOST) is not None:
+            return self.size_bytes
         self.spill_to_host()           # no-op unless device-resident
         with self._lock:
             if self.tier != StorageTier.HOST or self._host_arrays is None:
@@ -246,14 +260,52 @@ class SpillableBuffer:
 
     def promote_to_device(self, arrays: List[Any]) -> None:
         """Move the buffer back to the device tier (re-promotion on acquire,
-        RapidsBufferStore.scala:275-301); caller accounts the bytes."""
+        RapidsBufferStore.scala:275-301); caller accounts the bytes. A
+        disk-pinned buffer's npz is stashed, not unlinked — the durable
+        re-pin restores it without rewriting (buffers are immutable)."""
         with self._lock:
             self._device_arrays = arrays
             self._host_arrays = None
-            if self._disk_path and os.path.exists(self._disk_path):
-                os.unlink(self._disk_path)
+            if self._disk_path:
+                if self.disk_pinned:
+                    if self._pinned_path and \
+                            self._pinned_path != self._disk_path and \
+                            os.path.exists(self._pinned_path):
+                        os.unlink(self._pinned_path)  # superseded stash
+                    self._pinned_path = self._disk_path
+                elif os.path.exists(self._disk_path):
+                    os.unlink(self._disk_path)
             self._disk_path = None
             self.tier = StorageTier.DEVICE
+
+    def demote_to_pinned_disk(self, only_from: Optional["StorageTier"]
+                              = None) -> Optional["StorageTier"]:
+        """Zero-IO demotion for disk-pinned buffers: the retained npz
+        payload becomes the buffer again. Returns the tier demoted FROM
+        (caller accounts the bytes), or None when there is no retained
+        payload / the buffer is already on disk / ``only_from`` names a
+        different tier (callers whose accounting assumes a specific
+        source tier pass it so a racing move can't skew the books)."""
+        with self._lock:
+            if self._pinned_path is None or \
+                    self.tier == StorageTier.DISK:
+                return None
+            if only_from is not None and self.tier != only_from:
+                return None
+            if not os.path.exists(self._pinned_path):
+                self._pinned_path = None   # payload vanished; full spill
+                return None
+            prev = self.tier
+            self._device_arrays = None
+            self._host_arrays = None
+            self._disk_path = self._pinned_path
+            self._pinned_path = None
+            self.tier = StorageTier.DISK
+        from ..service.telemetry import flight_record
+        flight_record("spill", f"buffer-{self.id}",
+                      {"bytes": self.size_bytes, "to": "disk",
+                       "pinned": True})
+        return prev
 
     def free(self) -> None:
         with self._lock:
@@ -262,6 +314,9 @@ class SpillableBuffer:
             if self._disk_path and os.path.exists(self._disk_path):
                 os.unlink(self._disk_path)
             self._disk_path = None
+            if self._pinned_path and os.path.exists(self._pinned_path):
+                os.unlink(self._pinned_path)
+            self._pinned_path = None
 
 
 class BufferCatalog:
@@ -394,6 +449,54 @@ class BufferCatalog:
         batch.shared = True
         return batch
 
+    def pin_to_disk(self, buffer_id: int) -> int:
+        """Push one registered buffer through to the DISK tier now (the
+        durable-shuffle checkpoint write, docs/resilience.md) — unlike
+        the pressure-driven cascade this is caller-initiated, so durable
+        map outputs stop holding device/host memory the moment the map
+        phase ends. Returns the buffer's size when it reached disk. The
+        buffer stays registered and re-promotes on its next read.
+
+        The npz IO runs OUTSIDE the admission lock (the ShuffleStore
+        write-through rule: checkpoint writes must not stall every
+        concurrent allocation/spill): the buffer's own lock serializes
+        its tier moves, and each move's accounting commits immediately
+        after the move lands — a disk write failing halfway must not
+        tear the device/host byte counts (the host move already
+        happened and stays accounted)."""
+        with self._mu:
+            buf = self.buffers.get(buffer_id)
+        if buf is None:
+            return 0
+        buf.disk_pinned = True
+        # re-pin fast path: a read promoted this pinned buffer and its
+        # npz payload was retained — demotion is a tier flip, no IO
+        prev = buf.demote_to_pinned_disk()
+        if prev is not None:
+            with self._mu:
+                if prev == StorageTier.DEVICE:
+                    self.device_bytes -= buf.size_bytes
+                    self.spilled_device_bytes += buf.size_bytes
+                elif prev == StorageTier.HOST:
+                    self.host_bytes -= buf.size_bytes
+                    self.spilled_host_bytes += buf.size_bytes
+                self._note_residency()
+            return buf.size_bytes
+        moved = buf.spill_to_host()
+        if moved:
+            with self._mu:
+                self.device_bytes -= moved
+                self.host_bytes += moved
+                self.spilled_device_bytes += moved
+                self._note_residency()
+        moved_d = buf.spill_to_disk(self.spill_dir)
+        if moved_d:
+            with self._mu:
+                self.host_bytes -= moved_d
+                self.spilled_host_bytes += moved_d
+                self._note_residency()
+        return buf.size_bytes if buf.tier == StorageTier.DISK else 0
+
     def remove(self, buffer_id: int) -> None:
         with self._mu:
             buf = self.buffers.pop(buffer_id, None)
@@ -488,6 +591,13 @@ class SpillableColumnarBatch:
         except KeyError:
             raise BufferLostError(f"buffer {self._id} missing from the "
                                   "catalog") from None
+
+    def pin_to_disk(self) -> int:
+        """Durable pin: push this handle's buffer to the disk tier now
+        (see :meth:`BufferCatalog.pin_to_disk`); 0 when already closed."""
+        if self._closed:
+            return 0
+        return self.catalog.pin_to_disk(self._id)
 
     def close(self) -> None:
         if not self._closed:

@@ -28,6 +28,43 @@ def _release_semaphore() -> None:
     TpuSemaphore.get().release_if_necessary()
 
 
+def _record_swallowed(name: str, exc: BaseException) -> None:
+    """A worker exception that will never re-raise on the consumer side
+    (early generator close, bounded-join teardown) is LOGGED and
+    flight-recorded instead of silently discarded — the teardown
+    discipline of docs/resilience.md. Never raises: teardown reporting
+    must not replace the (absent) original failure with its own."""
+    try:
+        import logging
+        logging.getLogger("spark_rapids_tpu.tasks").warning(
+            "%s teardown swallowed a worker exception: %s: %s",
+            name, type(exc).__name__, exc)
+        from ..service.telemetry import flight_record
+        flight_record("teardown", f"{name}-swallowed",
+                      {"error": f"{type(exc).__name__}: {exc}"[:300]})
+    except Exception:
+        pass
+
+
+def record_join_timeout(name: str, threads: List[str],
+                        logger: str = "spark_rapids_tpu.tasks") -> None:
+    """Bounded-join teardown: threads that outlived their join window
+    are LOGGED and flight-recorded, not silently abandoned — the wedge
+    stays visible in post-mortems (docs/resilience.md). Never raises:
+    this runs in finally/teardown paths where a reporting failure must
+    not replace the (absent) original error."""
+    try:
+        import logging
+        logging.getLogger(logger).warning(
+            "%s: %d thread(s) still alive after bounded join: %s",
+            name, len(threads), threads)
+        from ..service.telemetry import flight_record
+        flight_record("teardown", f"{name}-join-timeout",
+                      {"threads": threads})
+    except Exception:
+        pass
+
+
 def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
                  depth: int = 2,
                  name: str = "spark-rapids-tpu-prefetch") -> Iterable[T]:
@@ -67,16 +104,23 @@ def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
 
     t = threading.Thread(target=worker, daemon=True, name=name)
     t.start()
+    delivered = False
     try:
         while True:
             v = q.get()
             if v is sentinel:
                 if err:
+                    delivered = True
                     raise err[0]
                 return
             yield v
     finally:
         stop.set()                          # unblock the worker on early exit
+        if err and not delivered:
+            # the consumer closed early: the worker's exception would be
+            # silently discarded — flight-record it so teardown never
+            # swallows a real failure (docs/resilience.md)
+            _record_swallowed(name, err[0])
 
 
 def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
@@ -145,12 +189,14 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
                for i in range(threads)]
     for t in workers:
         t.start()
+    delivered = False
     try:
         for i in range(len(items)):
             with cond:
                 while i not in results and not errs:
                     cond.wait(0.2)
                 if errs:
+                    delivered = True     # re-raised, not swallowed
                     raise errs[0]
                 res = results.pop(i)
                 state["next"] = i + 1
@@ -162,6 +208,17 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
             cond.notify_all()
         for t in workers:                # bounded join on shutdown
             t.join(timeout=5.0)
+        # bounded-join teardown discipline: a worker that outlived its
+        # join window, or an exception captured but never re-raised
+        # (consumer closed early), is LOGGED instead of discarded
+        alive = [t.name for t in workers if t.is_alive()]
+        if alive:
+            record_join_timeout(name, alive)
+        if not delivered:
+            with cond:
+                pending_errs = list(errs)
+            for e in pending_errs:
+                _record_swallowed(name, e)
 
 
 def run_partition_tasks(parts: Sequence[Any],

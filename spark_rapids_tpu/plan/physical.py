@@ -950,7 +950,7 @@ class TpuLocalScanExec(TpuExec):
                         batch = payload.get_batch()
                         batch.origin = payload
                         self.metrics.inc("cacheHitBatches")
-                    except BufferLostError:
+                    except BufferLostError:  # lint: recover-ok scan-cache miss repair: rebuilds the evicted device cache entry in place, no stage re-execution involved
                         # catalog was reset under us (tests do): rebuild
                         with TpuLocalScanExec._device_cache_lock:
                             if cache.get(key) is payload:

@@ -115,6 +115,13 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_result_cache_hits_total",
     "tpu_result_cache_misses_total",
     "tpu_result_cache_bytes",
+    # fault tolerance (exec/recovery.py, analysis/faults.py,
+    # docs/resilience.md)
+    "tpu_stage_retries_total",
+    "tpu_worker_lost_total",
+    "tpu_worker_rejoin_total",
+    "tpu_recovery_seconds",             # histogram, failure -> recovered
+    "tpu_faults_injected_total",        # deterministic chaos firings
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,

@@ -25,6 +25,7 @@ The exchange is TWO-PLANE (docs/shuffle.md, conf
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -49,6 +50,8 @@ from .partitioning import (HashPartitioner, RoundRobinPartitioner,
 # artifacts' shuffle report. Bumped once per exchange at completion
 # boundaries, never per batch.
 # ---------------------------------------------------------------------------
+
+log = logging.getLogger("spark_rapids_tpu.shuffle")
 
 _PLANE_TOTALS: Dict[str, float] = {
     "ici_exchanges": 0, "dcn_exchanges": 0,
@@ -111,11 +114,21 @@ def shuffle_report(root) -> List[Dict[str, Any]]:
 
 class LocalShuffle:
     """In-process shuffle state: (reduce partition) -> list of spillable
-    slices (ShuffleBufferCatalog analog, scoped to one exchange)."""
+    slices (ShuffleBufferCatalog analog, scoped to one exchange).
 
-    def __init__(self, num_partitions: int, catalog: Optional[BufferCatalog] = None):
+    ``durable`` (conf ``spark.rapids.tpu.sql.shuffle.durable``) keeps
+    slices REGISTERED after a read instead of closing them, and pins the
+    map outputs through the spill store's disk tier at map-phase end —
+    so a reduce-side stage retry re-reads the durable outputs instead of
+    re-running the map stage (docs/resilience.md). Slices free at
+    ``close_pending`` (exchange cleanup) as before."""
+
+    def __init__(self, num_partitions: int,
+                 catalog: Optional[BufferCatalog] = None,
+                 durable: bool = False):
         self.num_partitions = num_partitions
         self.catalog = catalog or BufferCatalog.get()
+        self.durable = durable
         self.slices: Dict[int, List[SpillableColumnarBatch]] = {
             p: [] for p in range(num_partitions)}
 
@@ -151,9 +164,36 @@ class LocalShuffle:
         batches = []
         for s in pending:
             batches.append(s.get_batch())
-            s.close()
+            if not self.durable:
+                s.close()          # durable outputs stay re-fetchable
         if batches:
-            yield concat_batches(schema, batches)
+            out = concat_batches(schema, batches)
+            if self.durable:
+                # get_batch re-promoted the pinned slices DISK->DEVICE;
+                # re-pin them NOW (before yielding — an abandoned
+                # consumer must not strand them device-resident) so only
+                # the in-flight partition holds HBM, keeping
+                # pin_outputs_to_disk's discipline across reads. Safe
+                # even when ``out`` aliases a demoted buffer's arrays
+                # (single-slice concat short-circuit): jax arrays are
+                # immutable and acquire_batch marked the batch shared,
+                # so no downstream program can donate them.
+                del batches
+                for s in pending:
+                    s.pin_to_disk()
+            yield out
+
+    def pin_outputs_to_disk(self) -> int:
+        """Durable tier: push every registered slice through to the disk
+        tier of the spill store (the checkpoint write of SURVEY §5
+        "Checkpoint / resume" — paid once at map-phase end, bounding the
+        memory the retained outputs hold). Returns bytes pinned."""
+        pinned = 0
+        for pending in self.slices.values():
+            for s in pending:
+                if not s._closed:
+                    pinned += s.pin_to_disk()
+        return pinned
 
     def read_slices(self, p: int, lo: int, hi: int,
                     schema: dt.Schema) -> Partition:
@@ -216,7 +256,8 @@ class TpuShuffleExchangeExec(TpuExec):
                            "shuffleBytesWritten", "shuffleBytesRead",
                            "iciExchanges", "dcnExchanges",
                            "skewSplitPartitions", "skewSplitTasks",
-                           "coalescedPartitions", "fetchFailedRetries")
+                           "coalescedPartitions", "fetchFailedRetries",
+                           "stageRetries")
 
     def __init__(self, child: TpuExec, num_partitions: int,
                  by: Optional[List[ex.Expression]] = None,
@@ -271,6 +312,8 @@ class TpuShuffleExchangeExec(TpuExec):
         counts in a PipelineWindow, so the sizing readbacks land in O(1)
         batched resolves per task instead of one blocking readback per
         batch (the host-plane half of the device-resident shuffle)."""
+        from ..analysis import faults
+        from ..exec import recovery
         from ..exec.pipeline import PipelineWindow
         from ..exec.tasks import run_partition_tasks
         partitioner = self._make_partitioner()
@@ -281,19 +324,29 @@ class TpuShuffleExchangeExec(TpuExec):
         def map_task(pid, part):
             win = PipelineWindow(depth, metrics=self.metrics)
             local_bytes = 0
-            for batch in part:
+            for bi, batch in enumerate(part):
+                if faults.armed() and faults.fire("task.poison",
+                                                  pid=pid, batch=bi):
+                    raise recovery.InjectedTaskFault(
+                        f"injected task poison (partition {pid}, "
+                        f"batch {bi})")
                 shuffle.write_deferred(win, partitioner, batch)
-                b = batch.device_size_bytes()
-                local_bytes += b
-                self.metrics.inc("dataSize", b)
+                local_bytes += batch.device_size_bytes()
             win.flush()
-            self.metrics.inc("shuffleBytesWritten", local_bytes)
             written.append(local_bytes)    # GIL-atomic append
 
         with trace_span("shuffle_write", self.metrics, "shuffleWriteTime"):
             run_partition_tasks(self.children[0].execute(), map_task)
+        if getattr(shuffle, "durable", False):
+            shuffle.pin_outputs_to_disk()
+        # metrics commit only on map-phase SUCCESS: a failed attempt's
+        # partial bytes must not pollute dataSize (the AQE broadcast
+        # switch reads it) or the shuffle write totals on a recovered run
+        total = sum(written)
+        self.metrics.inc("dataSize", total)
+        self.metrics.inc("shuffleBytesWritten", total)
         self.metrics.inc("dcnExchanges")
-        note_plane("dcn", sum(written), time.perf_counter() - t0)
+        note_plane("dcn", total, time.perf_counter() - t0)
 
     def execute(self) -> List[Partition]:
         from .manager import WorkerContext
@@ -304,10 +357,42 @@ class TpuShuffleExchangeExec(TpuExec):
             return self._execute_distributed(ctx)
         if plane == "ici":
             return self._execute_ici()
-        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
-        self._run_map_phase(shuffle)
+        shuffle = self._local_map_with_retry()
         groups = self._reduce_groups(shuffle)
         return [self._read_group(shuffle, g) for g in groups]
+
+    def _local_map_with_retry(self) -> LocalShuffle:
+        """Local map phase under the stage-retry discipline
+        (exec/recovery.py): an injected task fault or a recoverable
+        upstream failure discards the half-written shuffle and
+        re-executes the map from its (deterministic or not — nothing
+        was consumed yet) inputs. Shared by :meth:`execute` and the
+        skew-split path."""
+        from ..exec import recovery
+
+        def attempt():
+            # an OUTER exchange's stage retry re-executes this whole
+            # subtree: a stale _shuffle from the prior execution would be
+            # orphaned by the reassignment below with its slices still
+            # registered in the catalog — release it first (idempotent;
+            # the normal path nulls _shuffle at query cleanup)
+            stale = getattr(self, "_shuffle", None)
+            if stale is not None:
+                stale.close_pending()
+            shuffle = LocalShuffle(self.num_partitions,
+                                   durable=recovery.shuffle_durable())
+            self._shuffle = shuffle
+            self._run_map_phase(shuffle)
+            return shuffle
+
+        def discard(exc, attempt_no):
+            self.metrics.inc("stageRetries")
+            sh = getattr(self, "_shuffle", None)
+            if sh is not None:
+                sh.close_pending()     # release the partial map outputs
+
+        return recovery.retry_stage("shuffle-map", attempt,
+                                    on_retry=discard)
 
     # -- plane routing -------------------------------------------------------
 
@@ -346,6 +431,22 @@ class TpuShuffleExchangeExec(TpuExec):
                 raise RuntimeError(
                     "spark.rapids.tpu.sql.shuffle.plane=ici but no device "
                     "mesh is active (spark.rapids.tpu.sql.mesh.enabled)")
+            return "dcn"
+        # mesh-participant loss (real or chaos-injected): the ICI plane
+        # declines GRACEFULLY to DCN under auto — dispatching a
+        # collective onto a mesh missing a participant would hang, and
+        # the host plane carries the exchange correctly, just slower.
+        # Forced ici stays a loud error (the mesh.enabled=true contract)
+        from ..analysis import faults
+        from ..exec import recovery
+        if faults.armed() and faults.fire("mesh.drop"):
+            recovery.note_mesh_lost(faults.INJECTED_MESH_DROP_REASON)
+        lost = recovery.mesh_lost()
+        if lost is not None:
+            if forced:
+                raise RuntimeError(
+                    "spark.rapids.tpu.sql.shuffle.plane=ici but the ICI "
+                    f"mesh lost a participant ({lost})")
             return "dcn"
         if self.num_partitions == 1:
             return "dcn"          # single sink: nothing to exchange
@@ -424,8 +525,7 @@ class TpuShuffleExchangeExec(TpuExec):
         assert WorkerContext.current is None, \
             "skew split is a local-mode path"
         self.plane_used = "dcn"       # skew split is a host-plane feature
-        shuffle = self._shuffle = LocalShuffle(self.num_partitions)
-        self._run_map_phase(shuffle)
+        shuffle = self._local_map_with_retry()
         out: List[List[Partition]] = []
         for p in range(self.num_partitions):
             sizes = [s.size_bytes for s in shuffle.slices[p]]
@@ -466,7 +566,7 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..exec.spill import BufferLostError
         try:
             yield from chunk
-        except BufferLostError as e:
+        except BufferLostError as e:  # lint: recover-ok deliberate FAIL_QUERY: consumed sibling chunks pin the old slice boundaries, re-execution is unsafe here
             raise RuntimeError(
                 f"skew-split chunk of shuffle partition {p} lost a "
                 f"buffer; map-stage retry is unsafe for split chunks "
@@ -495,6 +595,16 @@ class TpuShuffleExchangeExec(TpuExec):
         s = f"{desc(self)}|n={self.num_partitions}|by={by}"
         return hashlib.sha1(s.encode()).hexdigest()[:16]
 
+    @staticmethod
+    def _subtree_allocates_shuffle_ids(node) -> bool:
+        """True when ``node``'s subtree holds an exchange that would
+        allocate a lockstep shuffle id if re-executed (distributed
+        mode's :class:`DistributedShuffle` constructor)."""
+        if isinstance(node, TpuShuffleExchangeExec):
+            return True
+        return any(TpuShuffleExchangeExec._subtree_allocates_shuffle_ids(c)
+                   for c in node.children)
+
     def _execute_distributed(self, ctx) -> List[Partition]:
         """Multi-process mode: map slices register in the worker's
         ShuffleStore (RapidsCachingWriter), reduce partitions this worker
@@ -502,10 +612,44 @@ class TpuShuffleExchangeExec(TpuExec):
         other partitions are empty here — their owners produce them.
         Adaptive coalescing stays off: partition->worker ownership must be
         identical on every worker."""
+        from ..exec import recovery
         from .manager import DistributedShuffle
+        # the shuffle is created ONCE (its id comes from the lockstep
+        # counter — a retry must not consume another id); only the map
+        # run retries, resetting this worker's partial outputs first.
+        # Safe because peers cannot have fetched yet: completion is only
+        # marked after the retry loop succeeds
         shuffle = self._shuffle = DistributedShuffle(
             self.num_partitions, ctx, fingerprint=self.plan_fingerprint())
-        self._run_map_phase(shuffle)
+
+        def attempt():
+            self._run_map_phase(shuffle)
+
+        def discard(exc, attempt_no):
+            self.metrics.inc("stageRetries")
+            shuffle.reset_outputs()
+
+        # a retry re-executes the whole child subtree; if that subtree
+        # holds ANOTHER exchange, re-running it would consume a fresh
+        # lockstep shuffle id on THIS worker only, desyncing the id /
+        # fingerprint streams from peers (each budget attempt would then
+        # burn a full fetch timeout against a shuffle no peer completes).
+        # Recovery declines — the fault propagates unmasked instead of
+        # wedging (docs/resilience.md "nested-exchange maps")
+        nested = self._subtree_allocates_shuffle_ids(self.children[0])
+
+        def gate(exc):
+            if nested:
+                log.warning(
+                    "shuffle-map retry declined: child subtree holds "
+                    "another exchange (lockstep id streams cannot "
+                    "re-execute on one worker); propagating %s",
+                    type(exc).__name__)
+                return False
+            return True
+
+        recovery.retry_stage("shuffle-map", attempt, on_retry=discard,
+                             retryable=gate)
         shuffle.finish_writes()
 
         def owned(p):
@@ -553,30 +697,46 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _read_group(self, shuffle: LocalShuffle, group: List[int]) -> Partition:
         """Reduce-side read with ELASTIC RECOVERY: a failed fetch (lost /
-        released buffers, transport give-up) triggers one re-execution of
-        the upstream map phase for the lost partitions — the analog of
+        released buffers, transport give-up) re-executes up to
+        ``recovery.maxStageRetries`` times with backoff — the analog of
         RapidsShuffleFetchFailedException -> Spark FetchFailed -> map-stage
-        retry (RapidsShuffleIterator.scala:28,49)."""
+        retry (RapidsShuffleIterator.scala:28,49). With DURABLE outputs
+        the retry re-reads the retained slices; only a genuinely lost
+        buffer re-runs the upstream map for the lost partitions."""
+        from ..exec import recovery
         from ..exec.spill import BufferLostError
         from .transport import ShuffleFetchError
-        try:
-            with trace_span("shuffle_fetch", self.metrics, "fetchWaitTime"):
-                batches = self._count_read(
-                    self._pull_group(shuffle, group))
-        except (ShuffleFetchError, BufferLostError) as e:
-            if not self.children[0].subtree_deterministic():
-                # re-executing an indeterminate map stage re-partitions
-                # rows differently; partitions already consumed from the
-                # first run would silently duplicate/drop rows (Spark
-                # aborts the stage for the same reason)
-                raise
-            import logging
-            logging.getLogger("spark_rapids_tpu.shuffle").warning(
-                "shuffle fetch for partitions %s failed (%s); re-running "
-                "the map stage for them", group, e)
-            self.metrics.inc("fetchFailedRetries")
-            self._refill(shuffle, group)
-            batches = self._count_read(self._pull_group(shuffle, group))
+
+        def retryable(exc):
+            if self.children[0].subtree_deterministic():
+                return True
+            # a consumed-elsewhere indeterminate map stage would
+            # re-partition rows differently on refill; the durable
+            # re-read path is still safe (same slices, no re-execution)
+            return shuffle.durable and not isinstance(exc, BufferLostError)
+
+        rs = recovery.StageRetryState(f"shuffle-reduce-p{group}",
+                                      retryable=retryable)
+        while True:
+            try:
+                with trace_span("shuffle_fetch", self.metrics,
+                                "fetchWaitTime"):
+                    batches = self._count_read(
+                        self._pull_group(shuffle, group))
+                rs.succeeded()
+                break
+            except (ShuffleFetchError, BufferLostError) as e:  # lint: recover-ok the FetchFailed -> map-stage-retry boundary, driven by exec/recovery's budget
+                # discard partial state BEFORE the backoff dwell: the
+                # failed attempt's half-read slices must not stay pinned
+                # through the sleep (the retry_stage discipline)
+                rs.failed(e, sleep=False)  # re-raises when not retryable
+                self.metrics.inc("fetchFailedRetries")
+                self.metrics.inc("stageRetries")
+                if not shuffle.durable or isinstance(e, BufferLostError):
+                    # no durable tier to re-read (or it lost a buffer):
+                    # re-run the upstream map for the lost partitions
+                    self._refill(shuffle, group)
+                rs.sleep_backoff()
         if batches:
             yield concat_batches(self.schema, batches)
 
@@ -591,6 +751,11 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _pull_group(self, shuffle: LocalShuffle,
                     group: List[int]) -> List[ColumnarBatch]:
+        from ..analysis import faults
+        from .transport import ShuffleFetchError
+        if faults.armed() and faults.fire("fetch.fail"):
+            raise ShuffleFetchError(
+                f"injected fetch fault (partitions {group})")
         batches = []
         for p in group:
             for b in shuffle.read(p, self.schema):
@@ -715,6 +880,9 @@ class TpuRangeExchangeExec(TpuExec):
                 samples.append(self._sample(s.get_batch(), per_batch))
         partitioner = RangePartitioner(self.num_partitions, self.orders,
                                        samples)
+        stale = getattr(self, "_shuffle", None)
+        if stale is not None:       # re-execution under an outer stage
+            stale.close_pending()   # retry: release the orphaned slices
         shuffle = self._shuffle = LocalShuffle(self.num_partitions)
         from .. import config as cfg
         from ..exec.pipeline import PipelineWindow

@@ -59,18 +59,44 @@ class WorkerContext:
 
     def __init__(self, worker_id: int, n_workers: int,
                  port: int = 0, codec: str = "none",
-                 fetch_timeout_s: float = 60.0):
+                 fetch_timeout_s: float = 60.0,
+                 durable_dir: Optional[str] = None):
         self.worker_id = worker_id
         self.n_workers = n_workers
-        self.store = ShuffleStore()
+        # durable shuffle tier (docs/resilience.md): explicit dir wins;
+        # otherwise conf shuffle.durable pins map outputs under the
+        # spill dir so a dead worker's rejoin re-serves them. The knobs
+        # come from the recovery-primed state (session bootstrap primes
+        # it) — a fresh TpuConf() here would only see env/defaults and
+        # silently ignore the session's conf
+        if durable_dir is None:
+            from ..exec import recovery
+            if recovery.shuffle_durable():
+                import os
+                durable_dir = os.path.join(
+                    recovery.spill_dir(),
+                    f"shuffle-durable-w{worker_id}")
+        self.durable_dir = durable_dir
+        self.store = ShuffleStore(durable_dir=durable_dir)
         self.store.release_quorum = n_workers
+        if durable_dir:
+            # a rejoining worker (fresh process, same durable dir)
+            # re-serves the outputs its previous incarnation pinned
+            self.store.reload_durable()
         self.server = ShuffleServer(self.store, port=port,
                                     codec=codec).start()
         self.port = self.server.port
+        self.codec = codec
         self.peers: Dict[int, Tuple[str, int]] = {}
         self.fetch_timeout_s = fetch_timeout_s
-        self._next_shuffle = 1
+        # the lockstep counter resumes PAST any durable-reloaded ids:
+        # reusing a previous incarnation's shuffle id would merge its
+        # rows into a new query and answer peers' completion polls from
+        # the stale mark (an id colliding with a peer's LATER exchange
+        # fails the fingerprint handshake loudly instead)
+        self._next_shuffle = self.store.durable_max_shuffle_id() + 1
         self._peer_complete: set = set()    # (worker_id, shuffle_id)
+        self._lost: set = set()             # failed-send-detected peers
         self._mu = named_lock("shuffle.manager.WorkerContext._mu")
 
     def set_peers(self, peers: Dict[int, Tuple[str, int]]) -> None:
@@ -93,20 +119,141 @@ class WorkerContext:
         host, port = self.peers[worker_id]
         return ShuffleClient.for_address(host, port)
 
+    # -- liveness / death / rejoin ------------------------------------------
+    def mark_worker_lost(self, worker_id: int,
+                         exc: Optional[BaseException] = None) -> None:
+        """Failed-send detection: record the peer as dead (telemetry
+        counter + flight record; idempotent per loss episode)."""
+        with self._mu:
+            fresh = worker_id not in self._lost
+            self._lost.add(worker_id)
+        if fresh:
+            from ..exec import recovery
+            recovery.note_worker_lost(worker_id, exc)
+
+    def is_worker_lost(self, worker_id: int) -> bool:
+        with self._mu:
+            return worker_id in self._lost
+
+    def lost_workers(self) -> List[int]:
+        with self._mu:
+            return sorted(self._lost)
+
+    def admit_worker(self, worker_id: int,
+                     address: Optional[Tuple[str, int]] = None) -> None:
+        """(Re-)admit a peer: update its address when given and clear
+        the lost mark — the rejoin half of death/rejoin. A worker that
+        restarted with a durable store re-serves its old outputs, so
+        in-flight stage retries recover without re-running map stages."""
+        with self._mu:
+            was_lost = worker_id in self._lost
+            self._lost.discard(worker_id)
+            if address is not None:
+                self.peers[worker_id] = (address[0], int(address[1]))
+        if was_lost:
+            from ..exec import recovery
+            recovery.note_worker_rejoin(worker_id)
+
+    def probe_peer(self, worker_id: int, timeout_s: float = 1.0) -> bool:
+        """Cheap liveness heartbeat: one metadata round trip against the
+        peer's transfer server (shuffle 0 is never registered, so the
+        reply content is irrelevant — answering at all means alive)."""
+        from .wire import META_REQ, FrameReader, encode_frame
+        import socket as _socket
+        host, port = self.peers[worker_id]
+        conn = None
+        try:
+            sock = _socket.create_connection((host, port),
+                                             timeout=timeout_s)
+            from .transport import SocketConnection
+            conn = SocketConnection(sock)
+            conn.send(encode_frame(META_REQ, {"shuffle_id": 0,
+                                              "reduce_ids": []}))
+            FrameReader(conn.read_exact).next_frame()
+            return True
+        except (ConnectionError, OSError):
+            return False
+        finally:
+            if conn is not None:
+                conn.close()
+
+    def restart_server(self) -> int:
+        """Restart this worker's transfer server on its ORIGINAL port
+        (peers keep their address book) — the in-process rejoin after an
+        injected or real server death. Returns the bound port."""
+        old = self.server
+        try:
+            old.stop()
+        except Exception:
+            pass
+        server = ShuffleServer(self.store, port=self.port,
+                               codec=self.codec).start()
+        with self._mu:
+            self.server = server
+            self.port = server.port
+        return server.port
+
     def fetch_from_peer(self, worker_id: int, shuffle_id: int,
                         reduce_ids: List[int],
                         fingerprint: Optional[str] = None):
-        """Fetch with per-(peer, shuffle) completion caching: map
-        completion is monotonic, so only the FIRST fetch per peer+shuffle
-        pays the completion-poll round trips. Failures surface LOUDLY and
-        with the right label: a desync keeps its type (wrong-pairing
-        detection); connection-rooted failures become
-        :class:`ShuffleWorkerLostError` naming the peer (a dead worker's
-        shard is unrecoverable from other lineage, so the query aborts
-        instead of returning partial rows); protocol/straggler failures
-        (released outputs, live-but-slow map phase) keep their
-        ShuffleFetchError identity with the peer id prepended — a slow
-        worker is not a dead worker."""
+        """One peer fetch under the stage-retry discipline
+        (exec/recovery.py): a desync aborts immediately; a dead worker
+        is marked lost and probed on its OWN wall-clock window (one
+        fetch timeout per budget attempt — liveness probes are not
+        stage retries, so they neither consume the budget nor count in
+        ``tpu_stage_retries_total``); a rejoined server (durable
+        outputs re-served) is re-admitted and the fetch re-executes
+        from those durable inputs; stragglers/released outputs retry on
+        the same budget. The budget exhausted, the original loud error
+        propagates (partial rows are never returned)."""
+        import time as _time
+        from ..exec import recovery
+        rs = recovery.StageRetryState(f"fetch-peer{worker_id}")
+        while True:
+            try:
+                out = self._fetch_attempt(worker_id, shuffle_id,
+                                          reduce_ids, fingerprint)
+                rs.succeeded()
+                if rs.attempts:
+                    # the peer answered after a loss episode: re-admit
+                    self.admit_worker(worker_id)
+                return out
+            except ShuffleWorkerLostError as e:  # lint: recover-ok failed-send detection: marks the peer lost, then routes into the recovery retry loop
+                self.mark_worker_lost(worker_id, e)
+                # sleep=False: the probe loop below paces itself from
+                # 50ms — prepending the stage-retry backoff would only
+                # delay the millisecond-scale dead-peer probe this
+                # method exists to provide
+                rs.failed(e, sleep=False)  # re-raises when budget exhausted
+                # probe window: a dead peer fails each probe in
+                # milliseconds instead of burning a full fetch timeout;
+                # the window expiring just returns to the fetch attempt,
+                # which re-fails and consumes the NEXT budget unit
+                deadline = _time.monotonic() + max(self.fetch_timeout_s,
+                                                   0.5)
+                wait = 0.05
+                while not self.probe_peer(worker_id):
+                    if _time.monotonic() > deadline:
+                        break
+                    _time.sleep(wait)
+                    wait = min(wait * 2, 1.0)
+                else:
+                    self.admit_worker(worker_id)
+            except ShuffleFetchError as e:  # lint: recover-ok straggler/released-output failures route into the recovery retry loop (desync FAIL_QUERYs inside)
+                rs.failed(e)           # desync/protocol re-raise inside
+
+    def _fetch_attempt(self, worker_id: int, shuffle_id: int,
+                       reduce_ids: List[int],
+                       fingerprint: Optional[str] = None):
+        """One fetch attempt with per-(peer, shuffle) completion caching:
+        map completion is monotonic, so only the FIRST fetch per
+        peer+shuffle pays the completion-poll round trips. Failures
+        surface LOUDLY and with the right label: a desync keeps its type
+        (wrong-pairing detection); connection-rooted failures become
+        :class:`ShuffleWorkerLostError` naming the peer; protocol/
+        straggler failures (released outputs, live-but-slow map phase)
+        keep their ShuffleFetchError identity with the peer id prepended
+        — a slow worker is not a dead worker."""
         client = self.client_for(worker_id)
         key = (worker_id, shuffle_id)
         with self._mu:
@@ -118,10 +265,10 @@ class WorkerContext:
             out = client.fetch_when_complete(
                 shuffle_id, reduce_ids, timeout_s=self.fetch_timeout_s,
                 fingerprint=fingerprint)
-        except ShuffleDesyncError as e:
+        except ShuffleDesyncError as e:  # lint: recover-ok relabeling boundary: prepends the peer id, keeps the type, never retries
             raise ShuffleDesyncError(
                 f"worker {worker_id}: {e}") from e
-        except ShuffleFetchError as e:
+        except ShuffleFetchError as e:  # lint: recover-ok relabeling boundary: maps connection-rooted failures to worker-lost for the recovery loop above
             if isinstance(e.__cause__, (ConnectionError, OSError)):
                 raise ShuffleWorkerLostError(
                     worker_id,
@@ -167,11 +314,14 @@ class WorkerContext:
 
 
 def init_worker(worker_id: int, n_workers: int, port: int = 0,
-                codec: str = "none") -> WorkerContext:
+                codec: str = "none", fetch_timeout_s: float = 60.0,
+                durable_dir: Optional[str] = None) -> WorkerContext:
     """Bootstrap this process as shuffle worker ``worker_id`` (the
     RapidsExecutorPlugin.init analog). Returns the context; call
     ``set_peers`` once every worker's port is known."""
-    ctx = WorkerContext(worker_id, n_workers, port, codec)
+    ctx = WorkerContext(worker_id, n_workers, port, codec,
+                        fetch_timeout_s=fetch_timeout_s,
+                        durable_dir=durable_dir)
     with WorkerContext._current_mu:
         WorkerContext.current = ctx
     return ctx
@@ -234,6 +384,27 @@ class DistributedShuffle:
 
     def finish_writes(self) -> None:
         self.ctx.store.mark_complete(self.shuffle_id)
+
+    @property
+    def durable(self) -> bool:
+        """True when the worker's store write-throughs to the durable
+        .npz tier (outputs survive a worker death for rejoin re-serve)."""
+        return bool(self.ctx.store.durable_dir)
+
+    def pin_outputs_to_disk(self) -> int:
+        """No-op: the durable ShuffleStore persists each slice at
+        registration (write-through), unlike the local spill-store pin."""
+        return 0
+
+    def reset_outputs(self) -> None:
+        """Discard this worker's (partial) map outputs for a stage
+        retry. Only legal BEFORE ``finish_writes``: peers poll the
+        completion mark before fetching, so nothing was observed yet."""
+        self.ctx.store.remove_shuffle(self.shuffle_id)
+        if self.fingerprint:
+            self.ctx.store.set_fingerprint(self.shuffle_id,
+                                           self.fingerprint)
+        self._wrote = False  # lint: unguarded-ok single-writer flag: reset runs on the one thread driving this exchange's map retry
 
     # -- reduce side ---------------------------------------------------------
     def read(self, p: int, schema: dt.Schema):

@@ -20,6 +20,7 @@ elastic retry, exactly the split SURVEY.md §5 calls for.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -102,9 +103,16 @@ class ShuffleWorkerLostError(ShuffleFetchError):
 class ShuffleStore:
     """(shuffle_id, reduce_id) -> registered host buffers with metadata
     (ShuffleBufferCatalog analog, host-tier: the transfer server serves
-    bytes from host staging, never touching the device)."""
+    bytes from host staging, never touching the device).
 
-    def __init__(self):
+    ``durable_dir`` (conf ``spark.rapids.tpu.sql.shuffle.durable``, wired
+    by WorkerContext) opts map outputs into a write-through .npz disk
+    tier: every registered slice and completion mark also lands on disk,
+    and :meth:`reload_durable` re-serves them after a worker death —
+    the rejoining worker's peers re-fetch instead of aborting (the
+    checkpoint/resume trade of SURVEY §5, docs/resilience.md)."""
+
+    def __init__(self, durable_dir: Optional[str] = None):
         self._mu = named_lock("shuffle.transport.ShuffleStore._mu")
         self._next_id = 1
         self._buffers: Dict[int, Tuple[BufferDesc, List[np.ndarray]]] = {}
@@ -116,6 +124,9 @@ class ShuffleStore:
         # how many distinct worker release-acks free a shuffle's outputs
         # (set by WorkerContext to n_workers; 0 disables the protocol)
         self.release_quorum = 0
+        self.durable_dir = durable_dir
+        self._durable_files: Dict[int, Tuple[str, str]] = {}
+        self._durable_max_sid = 0
 
     def register_batch(self, shuffle_id: int, reduce_id: int,
                        batch: ColumnarBatch) -> int:
@@ -133,7 +144,116 @@ class ShuffleStore:
             self._buffers[bid] = (desc, arrays)
             self._by_partition.setdefault((shuffle_id, reduce_id),
                                           []).append(bid)
+        # durable write-through runs OUTSIDE the store lock (npz IO must
+        # not serialize the transfer server); control-plane shuffles
+        # (negative ids) are ephemeral and never persisted
+        if self.durable_dir and shuffle_id >= 0:
+            self._persist(bid, desc, arrays)
         return bid
+
+    # -- durable tier --------------------------------------------------------
+    def _persist(self, bid: int, desc: BufferDesc,
+                 arrays: List[np.ndarray]) -> None:
+        import json as _json
+        os.makedirs(self.durable_dir, exist_ok=True)
+        stem = os.path.join(self.durable_dir,
+                            f"buf-{desc.shuffle_id}-{desc.reduce_id}-{bid}")
+        np.savez(stem + ".npz", *arrays)
+        with open(stem + ".json", "w") as f:
+            _json.dump(desc.to_json(), f)
+        with self._mu:
+            self._durable_files[bid] = (stem + ".npz", stem + ".json")
+        from ..service.telemetry import flight_record
+        flight_record("spill", f"shuffle-durable-{bid}",
+                      {"shuffle": desc.shuffle_id,
+                       "reduce": desc.reduce_id})
+
+    def reload_durable(self) -> int:
+        """Rebuild the store from a durable directory (a rejoining
+        worker re-serving the outputs its previous incarnation pinned);
+        returns the number of buffers re-registered. Completion marks
+        AND fingerprints reload too, so peers' completion polls resume
+        immediately and the desync handshake still validates the old
+        outputs. The highest reloaded shuffle id is tracked
+        (:meth:`durable_max_shuffle_id`) so the rejoining worker's
+        lockstep counter can advance PAST the previous incarnation's
+        ids — reusing one would merge a dead run's rows into a new
+        query (and its stale completion mark would answer peers'
+        polls before the new map phase even ran)."""
+        import glob
+        import json as _json
+        if not self.durable_dir or not os.path.isdir(self.durable_dir):
+            return 0
+        n = 0
+        for meta_path in sorted(glob.glob(
+                os.path.join(self.durable_dir, "buf-*.json"))):
+            npz_path = meta_path[:-len(".json")] + ".npz"
+            try:
+                with open(meta_path) as f:
+                    desc = BufferDesc.from_json(_json.load(f))
+                with np.load(npz_path) as z:
+                    arrays = [z[k] for k in z.files]
+            except Exception:
+                # a torn write from the death: np.load on a truncated
+                # npz raises zipfile.BadZipFile / zlib.error, not just
+                # OSError — ANY unreadable pair is skipped, never fatal
+                continue
+            with self._mu:
+                bid = desc.buffer_id
+                if bid in self._buffers:
+                    continue
+                self._next_id = max(self._next_id, bid + 1)
+                self._buffers[bid] = (desc, arrays)
+                self._by_partition.setdefault(
+                    (desc.shuffle_id, desc.reduce_id), []).append(bid)
+                self._durable_files[bid] = (npz_path, meta_path)
+                self._durable_max_sid = max(self._durable_max_sid,
+                                            desc.shuffle_id)
+            n += 1
+        for marker in glob.glob(
+                os.path.join(self.durable_dir, "complete-*")):
+            try:
+                sid = int(os.path.basename(marker).split("-", 1)[1])
+            except ValueError:
+                continue
+            with self._mu:
+                self._complete.add(sid)
+                self._durable_max_sid = max(self._durable_max_sid, sid)
+        for fp_path in glob.glob(
+                os.path.join(self.durable_dir, "fp-*")):
+            try:
+                sid = int(os.path.basename(fp_path).split("-", 1)[1])
+                with open(fp_path) as f:
+                    fp = f.read().strip()
+            except Exception:
+                continue
+            if fp:
+                with self._mu:
+                    self._fingerprints.setdefault(sid, fp)
+        return n
+
+    def durable_max_shuffle_id(self) -> int:
+        """Highest shuffle id the durable reload saw (0 when none)."""
+        with self._mu:
+            return self._durable_max_sid
+
+    def _unlink_durable(self, bids: List[int],
+                        shuffle_id: Optional[int] = None) -> None:
+        with self._mu:
+            paths = [self._durable_files.pop(b) for b in bids
+                     if b in self._durable_files]
+        for npz_path, meta_path in paths:
+            for p in (npz_path, meta_path):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        if shuffle_id is not None and self.durable_dir:
+            for name in (f"complete-{shuffle_id}", f"fp-{shuffle_id}"):
+                try:
+                    os.unlink(os.path.join(self.durable_dir, name))
+                except OSError:
+                    pass
 
     def metas(self, shuffle_id: int, reduce_ids: List[int]
               ) -> List[BufferDesc]:
@@ -155,6 +275,13 @@ class ShuffleStore:
         ordering Spark provides; a flag replaces it standalone)."""
         with self._mu:
             self._complete.add(shuffle_id)
+        if self.durable_dir and shuffle_id >= 0:
+            # completion survives a worker death with the slices: the
+            # rejoined server answers completion polls immediately
+            os.makedirs(self.durable_dir, exist_ok=True)
+            with open(os.path.join(self.durable_dir,
+                                   f"complete-{shuffle_id}"), "w"):
+                pass
 
     def is_complete(self, shuffle_id: int) -> bool:
         with self._mu:
@@ -163,9 +290,16 @@ class ShuffleStore:
     def set_fingerprint(self, shuffle_id: int, fingerprint: str) -> None:
         """Bind the structural plan fingerprint of the exchange that owns
         ``shuffle_id``; metadata requests carrying a different fingerprint
-        for the same id are rejected (lockstep-desync detection)."""
+        for the same id are rejected (lockstep-desync detection). Durable
+        stores persist it so a rejoined worker's re-served outputs still
+        validate the handshake."""
         with self._mu:
             self._fingerprints[shuffle_id] = fingerprint
+        if self.durable_dir and shuffle_id >= 0 and fingerprint:
+            os.makedirs(self.durable_dir, exist_ok=True)
+            with open(os.path.join(self.durable_dir,
+                                   f"fp-{shuffle_id}"), "w") as f:
+                f.write(fingerprint)
 
     def check_fingerprint(self, shuffle_id: int,
                           fingerprint: Optional[str]) -> Optional[str]:
@@ -217,13 +351,17 @@ class ShuffleStore:
         return out
 
     def remove_shuffle(self, shuffle_id: int) -> None:
+        removed: List[int] = []
         with self._mu:
             gone = [k for k in self._by_partition if k[0] == shuffle_id]
             for k in gone:
                 for bid in self._by_partition.pop(k):
                     self._buffers.pop(bid, None)
+                    removed.append(bid)
             self._complete.discard(shuffle_id)
             self._fingerprints.pop(shuffle_id, None)
+        if self.durable_dir:
+            self._unlink_durable(removed, shuffle_id=shuffle_id)
 
     def buffer_count(self) -> int:
         with self._mu:
@@ -315,7 +453,14 @@ class ShuffleServer:
                 sock, _addr = self._sock.accept()
             except socket.timeout:
                 continue
-            except OSError:
+            except OSError as e:
+                if not self._stop.is_set():
+                    # an accept loop dying OUTSIDE orderly shutdown is a
+                    # server death, not noise: record it instead of
+                    # silently stranding every future fetch
+                    from ..service.telemetry import flight_record
+                    flight_record("teardown", "shuffle-accept-died",
+                                  {"error": f"{type(e).__name__}: {e}"})
                 return
             with self._threads_mu:
                 self._conn_seq += 1
@@ -335,6 +480,15 @@ class ShuffleServer:
         """One request/response session (the server handler loop,
         RapidsShuffleServer.scala:97-167). Public so the mock rig can drive
         it directly over an in-process connection."""
+        from ..analysis import faults
+        if faults.armed() and faults.fire("worker.die"):
+            # deterministic worker death: drop this connection unserved;
+            # on_fire callbacks (tests/bench) stop the server here, so
+            # the fetching peer observes connect-refused next — exactly
+            # the failed-send signature WorkerContext maps to
+            # worker-lost (docs/resilience.md)
+            conn.close()
+            return
         reader = FrameReader(conn.read_exact)
         try:
             while True:
@@ -389,6 +543,7 @@ class ShuffleServer:
                 conn.send(encode_frame(ERROR,
                                        {"message": f"unknown buffer {bid}"}))
                 return
+            from ..analysis import faults
             ranges = wire.chunk_ranges(len(payload), self.chunk_bytes)
             for seq, (off, ln) in enumerate(ranges):
                 raw = payload[off:off + ln]
@@ -398,6 +553,13 @@ class ShuffleServer:
                     "offset": off, "raw_len": ln,
                     "codec": self.codec.name,
                     "crc32": wire.chunk_crc(body)}, body))
+                if faults.armed() and faults.fire("conn.kill",
+                                                  chunk=seq + 1):
+                    # torn send window: the client's reassembly sees the
+                    # peer close mid-buffer and retries the fetch on a
+                    # fresh connection (the mid-window transport kill)
+                    raise ConnectionError(
+                        "injected connection kill mid send window")
             # this buffer's send window completed
             _note_total("bytes_sent", len(payload))
             _note_total("chunks_sent", len(ranges))
@@ -418,16 +580,24 @@ class ShuffleServer:
             self._sock.close()
         except OSError:
             pass
+        me = threading.current_thread()
         acc = self._accept_thread
-        if acc is not None and acc.is_alive():
+        if acc is not None and acc is not me and acc.is_alive():
             acc.join(timeout=join_timeout_s)
         with self._threads_mu:
             handlers = list(self._threads)
         for t in handlers:
-            if t.is_alive():
+            # a handler may call stop() itself (the worker.die chaos
+            # hook fires inside handle_connection): never self-join
+            if t is not me and t.is_alive():
                 t.join(timeout=join_timeout_s)
         with self._threads_mu:
             self._threads = [t for t in self._threads if t.is_alive()]
+            leftovers = [t.name for t in self._threads if t is not me]
+        if leftovers:
+            from ..exec.tasks import record_join_timeout
+            record_join_timeout("shuffle-server", leftovers,
+                                logger="spark_rapids_tpu.shuffle")
 
     def alive_threads(self) -> List[str]:
         """Names of transport threads still running (teardown reports)."""
@@ -456,11 +626,22 @@ class ShuffleClient:
 
     def __init__(self, connect: Callable[[], Connection],
                  max_inflight_bytes: int = 8 << 20,
-                 max_retries: int = 3, retry_backoff_s: float = 0.05,
+                 max_retries: Optional[int] = None,
+                 retry_backoff_s: Optional[float] = None,
                  bounce: Optional["BounceBufferManager"] = None):
         from ..exec.native_alloc import BounceBufferManager
         self._connect = connect
         self.max_inflight_bytes = max_inflight_bytes
+        # retry knobs are conf-driven (shuffle.fetch.maxRetries /
+        # .retryBackoff) unless the caller pins them; the recovery
+        # module primes them from the active session's conf at
+        # bootstrap (client construction sits below the session layer)
+        if max_retries is None or retry_backoff_s is None:
+            from ..exec import recovery
+            if max_retries is None:
+                max_retries = recovery.fetch_max_retries()
+            if retry_backoff_s is None:
+                retry_backoff_s = recovery.fetch_retry_backoff_s()
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
         # receive staging: chunk reassembly sub-allocates windows out of one
@@ -543,7 +724,7 @@ class ShuffleClient:
                 time.sleep(self.retry_backoff_s * attempt)
             try:
                 return self._fetch_once(shuffle_id, reduce_ids, fingerprint)
-            except ShuffleDesyncError:
+            except ShuffleDesyncError:  # lint: recover-ok transport retry loop: a desync must escape its own retries — re-fetching diverged streams pairs wrong data
                 raise                    # retrying cannot un-diverge streams
             except (ConnectionError, OSError, ValueError) as e:
                 last_err = e
@@ -581,6 +762,10 @@ class ShuffleClient:
     def _fetch_once(self, shuffle_id: int, reduce_ids: List[int],
                     fingerprint: Optional[str] = None
                     ) -> List[ColumnarBatch]:
+        from ..analysis import faults
+        if faults.armed() and faults.fire("fetch.fail"):
+            raise ConnectionError(
+                f"injected fetch fault (shuffle {shuffle_id})")
         conn = self._connect()
         try:
             conn.send(encode_frame(META_REQ, {

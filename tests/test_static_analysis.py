@@ -471,3 +471,60 @@ def test_rule_naked_jit_pragma_requires_reason():
             "    return jax.jit(lambda y: y)(x)  # lint: naked-jit-ok\n")
     v = lint.lint_source(bare, "ops/fixture.py")
     assert _rules(v) == {"naked-jit", "pragma-reason"}
+
+
+def test_rule_bare_recover_flags_taxonomy_catch():
+    src = ("def f():\n"
+           "    try:\n"
+           "        pass\n"
+           "    except ShuffleFetchError:\n"
+           "        pass\n")
+    v = lint.lint_source(src, "shuffle/fixture.py")
+    assert "bare-recover" in _rules(v)
+    assert any("stage-retry driver" in x.message for x in v)
+    # tuple and dotted forms are caught too
+    tup = ("def f():\n"
+           "    try:\n"
+           "        pass\n"
+           "    except (transport.ShuffleWorkerLostError, ValueError):\n"
+           "        pass\n")
+    assert "bare-recover" in _rules(lint.lint_source(tup, "exec/fix.py"))
+    # the recovery.recoverable_types() call form — the WHOLE taxonomy at
+    # once — cannot bypass the rule either
+    call = ("def f():\n"
+            "    try:\n"
+            "        pass\n"
+            "    except recovery.recoverable_types():\n"
+            "        pass\n")
+    assert "bare-recover" in _rules(lint.lint_source(call, "plan/fix.py"))
+
+
+def test_rule_bare_recover_pragma_and_recovery_module_exempt():
+    pragma = ("def f():\n"
+              "    try:\n"
+              "        pass\n"
+              "    except BufferLostError:  "
+              "# lint: recover-ok relabeling boundary, never retries\n"
+              "        pass\n")
+    assert lint.lint_source(pragma, "shuffle/fixture.py") == []
+    bare_pragma = ("def f():\n"
+                   "    try:\n"
+                   "        pass\n"
+                   "    except BufferLostError:  # lint: recover-ok\n"
+                   "        pass\n")
+    v = lint.lint_source(bare_pragma, "shuffle/fixture.py")
+    assert _rules(v) == {"bare-recover", "pragma-reason"}
+    # exec/recovery.py is the driver's own domain: bare catches legal
+    driver = ("def retry():\n"
+              "    try:\n"
+              "        pass\n"
+              "    except (ShuffleFetchError, InjectedTaskFault):\n"
+              "        pass\n")
+    assert lint.lint_source(driver, "exec/recovery.py") == []
+    # non-taxonomy exceptions never trip the rule
+    other = ("def f():\n"
+             "    try:\n"
+             "        pass\n"
+             "    except ValueError:\n"
+             "        pass\n")
+    assert lint.lint_source(other, "shuffle/fixture.py") == []
