@@ -166,6 +166,25 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             except Exception:
                 pass
             try:
+                # stage-boundary exchange statistics + drift summary
+                # (docs/observability.md §8) next to the metricsTree:
+                # what each exchange actually produced (partition shape,
+                # skew) and where the planner's row estimates missed —
+                # the SAME artifact shapes the structured query log
+                # writes, from the shared helpers
+                from spark_rapids_tpu.service.query_log import (
+                    drift_summary, stage_summaries)
+                entry["queryId"] = session.last_query_id()
+                stats = stage_summaries(session.last_plan())
+                if stats:
+                    entry["stageStats"] = stats
+                drift = drift_summary(session.last_plan(),
+                                      conf=session.conf)
+                if drift["nodes"]:
+                    entry["drift"] = drift
+            except Exception:
+                pass
+            try:
                 m = session.last_query_metrics()
                 entry["planTimeS"] = m.get("planTimeS")
                 entry["executeTimeS"] = m.get("executeTimeS")
@@ -186,13 +205,17 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             except Exception:
                 pass
             if trace_dir:
-                # Chrome-trace timeline of the last iteration (open in
-                # chrome://tracing / ui.perfetto.dev)
+                # Chrome-trace timeline of the last iteration in the
+                # MERGED form (query-id-stamped spans, per-worker process
+                # groups — open in chrome://tracing / ui.perfetto.dev):
+                # a distributed run appends the remote workers' trace
+                # dumps via session.merged_timeline(extra=...) and the
+                # spans join under the shared query id. No recorder
+                # (timeline off / short-circuited query) or a failed
+                # write just skips the artifact.
                 try:
-                    rec = getattr(session, "_last_span_recorder", None)
-                    if rec is not None:
-                        path = os.path.join(trace_dir, f"{name}.trace.json")
-                        entry["traceFile"] = rec.dump_chrome_trace(path)
+                    path = os.path.join(trace_dir, f"{name}.trace.json")
+                    entry["traceFile"] = session.merged_timeline(path=path)
                 except Exception:
                     pass
             captures.clear()

@@ -45,6 +45,11 @@ the first argument of a ``<registry>.counter("...")`` /
 ``service/telemetry.py``'s ``TELEMETRY_KEYS`` tuple (the metric-key
 rule's analog for the process-lifetime scrape surface).
 
+``querylog-key``: every top-level record field the structured query
+log's ``build_record`` emits (``service/query_log.py``) is declared in
+its ``QUERY_LOG_FIELDS`` tuple — the metric-key discipline applied to
+the artifact surface ``tools/query_report`` reads.
+
 ``bare-recover``: an ``except`` clause naming a recoverable-taxonomy
 type (ShuffleFetchError and subclasses, BufferLostError,
 InjectedTaskFault — the exec/recovery.py domain) outside
@@ -242,6 +247,11 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # bare-recover (whole package): taxonomy catches outside the
     # stage-retry driver carry a reasoned pragma
     out.extend(_check_bare_recover(tree, source, rel, path))
+
+    # querylog-key: the structured query log's record fields are a
+    # declared surface, like METRICS and TELEMETRY_KEYS
+    if rel == QUERY_LOG_MODULE:
+        out.extend(check_querylog_keys(source, path))
 
     if rel in EXEC_MODULES:
         for node in ast.walk(tree):
@@ -568,6 +578,95 @@ def check_telemetry_keys(sources: Dict[str, Tuple[str, str]]
                     f"registry metric name {name!r} is not declared in "
                     "service/telemetry.TELEMETRY_KEYS — declare it so "
                     "the scrape surface stays greppable"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# query-log record fields (querylog-key rule)
+# ---------------------------------------------------------------------------
+
+#: module declaring the structured query-log field surface
+QUERY_LOG_MODULE = "service/query_log.py"
+
+
+def querylog_declared_keys(source: str):
+    """The string names in ``QUERY_LOG_FIELDS = (...)``, or None when the
+    module declares no such tuple."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if node.value is not None and any(
+                isinstance(t, ast.Name) and t.id == "QUERY_LOG_FIELDS"
+                for t in targets):
+            return {n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant) and
+                    isinstance(n.value, str)}
+    return None
+
+
+def querylog_usages(source: str):
+    """(line, key) for every top-level record field ``build_record``
+    emits: the string keys of the dict literal assigned to ``rec`` and
+    ``rec["..."] = ...`` subscript assignments."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out = []
+    for fn in ast.walk(tree):
+        if not (isinstance(fn, ast.FunctionDef) and
+                fn.name == "build_record"):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "rec" and \
+                        isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            out.append((k.lineno, k.value))
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "rec" and \
+                        isinstance(t.slice, ast.Constant) and \
+                        isinstance(t.slice.value, str):
+                    out.append((t.lineno, t.slice.value))
+    return out
+
+
+def check_querylog_keys(source: str, path: str) -> List[LintViolation]:
+    """``querylog-key``: every top-level record field the query-log
+    writer emits is declared in ``QUERY_LOG_FIELDS`` — the metric-key /
+    telemetry-key discipline applied to the artifact surface consumers
+    (tools/query_report) read."""
+    declared = querylog_declared_keys(source)
+    if declared is None:
+        return [LintViolation(
+            path, 0, "querylog-key",
+            "service/query_log.py declares no QUERY_LOG_FIELDS tuple — "
+            "the query-log record surface must be declared")]
+    out: List[LintViolation] = []
+    for line, key in querylog_usages(source):
+        if key not in declared:
+            out.append(LintViolation(
+                path, line, "querylog-key",
+                f"query-log record field {key!r} is not declared in "
+                "service/query_log.QUERY_LOG_FIELDS — declare it so the "
+                "artifact surface stays greppable"))
     return out
 
 

@@ -338,6 +338,7 @@ class DataFrame:
 
     def _collect_planned(self, exec_plan, serving):
         import time
+        from ..exec import query_context as qc
         from ..exec.tracing import SpanRecorder, SyncCounter
         from ..plan import plan_cache as pc
         listeners = bool(self.session._query_listeners)
@@ -347,18 +348,31 @@ class DataFrame:
             from ..analysis import lockdep, recompile
             rc0 = recompile.snapshot()
             lk0 = lockdep.stats()
+        # the query-lifecycle identity (docs/observability.md §8): ONE
+        # query id minted at collect time, ambient for the execution so
+        # spans, flight events, shuffle protocol traffic and exchange
+        # stage ids all attribute to this query — lockstep-deterministic,
+        # so distributed workers running the same query mint the same id
+        qid = qc.mint_query_id(exec_plan)
+        self.session._last_query_id = qid
+        from ..analysis import faults as _faults
+        faults0 = _faults.fired_total()
         t0 = time.perf_counter()
-        try:
-            with SyncCounter() as sc, SpanRecorder() as spans:
-                out = exec_plan.execute_collect()
-        except BaseException as e:
-            # post-mortem for failures OUTSIDE task bodies (planner-side
-            # execute, concat, exchange setup): dump the flight ring.
-            # dump_on_error never raises and dedups against the task-level
-            # hook, so the original exception propagates unmasked.
-            from ..service.telemetry import dump_on_error
-            dump_on_error(e)
-            raise
+        with qc.query_scope(qc.QueryContext(qid)):
+            try:
+                with SyncCounter() as sc, SpanRecorder() as spans:
+                    spans.query_id = qid
+                    out = exec_plan.execute_collect()
+            except BaseException as e:
+                # post-mortem for failures OUTSIDE task bodies
+                # (planner-side execute, concat, exchange setup): dump
+                # the flight ring INSIDE the query scope so the artifact
+                # is scoped+named to the failing query. dump_on_error
+                # never raises and dedups against the task-level hook,
+                # so the original exception propagates unmasked.
+                from ..service.telemetry import dump_on_error
+                dump_on_error(e)
+                raise
         self.session._last_execute_time_s = time.perf_counter() - t0
         try:
             from ..service.telemetry import MetricsRegistry
@@ -388,6 +402,15 @@ class DataFrame:
             # store AFTER the sync/span windows closed: the caching
             # fetch must not perturb this query's reported sync counts
             out = pc.store_result(self.session, rkey, out)
+        try:
+            # opt-in structured query log (service/query_log.py, conf
+            # telemetry.queryLog.dir): one JSONL record per execution.
+            # Best-effort — the log must never fail the query.
+            from ..service import query_log
+            query_log.maybe_log(self.session, exec_plan, serving, qid,
+                                faults_before=faults0)
+        except Exception:
+            pass
         return out
 
     def collect(self) -> List[tuple]:

@@ -91,17 +91,26 @@ class _BuilderAccessor:
         return TpuSessionBuilder()
 
 
-def _annotated_plan_lines(plan, violations) -> List[str]:
+def _annotated_plan_lines(plan, violations, conf=None) -> List[str]:
     """Executed-plan tree with runtime metrics plus the per-node
     annotations EXPLAIN ANALYZE renders — contract diagnostics keyed by
-    validator path, and fused-stage membership / decline reasons
-    (plan/stage_compiler.fusion_annotations). One implementation for
-    both the session-level and captured-QueryExecution renderings."""
+    validator path, fused-stage membership / decline reasons
+    (plan/stage_compiler.fusion_annotations), per-exchange stage-boundary
+    statistics (shuffle/exchange.stage_stats_annotations), and the
+    estimate-vs-actual row drift per node (plan/estimates). One
+    implementation for both the session-level and captured-
+    QueryExecution renderings."""
     by_path: Dict[str, List[str]] = {}
     for v in violations:
         by_path.setdefault(v.path, []).append(f"! contract: {v.message}")
     from ..plan.stage_compiler import fusion_annotations
     for path, notes in fusion_annotations(plan).items():
+        by_path.setdefault(path, []).extend(notes)
+    from ..shuffle.exchange import stage_stats_annotations
+    for path, notes in stage_stats_annotations(plan).items():
+        by_path.setdefault(path, []).extend(notes)
+    from ..plan.estimates import drift_annotations
+    for path, notes in drift_annotations(plan, conf=conf).items():
         by_path.setdefault(path, []).extend(notes)
     return plan.metrics_lines(
         annotate=lambda path: list(by_path.get(path, ())))
@@ -142,7 +151,8 @@ class QueryExecution:
         its captured contract diagnostics, and fused-stage membership
         (rendered on demand)."""
         lines = ["== Executed Plan (analyzed) =="]
-        lines += _annotated_plan_lines(self.plan, self.violations)
+        lines += _annotated_plan_lines(self.plan, self.violations,
+                                       conf=self.session.conf)
         lines.append(
             f"query: hostSyncs={self.sync.get('hostSyncs', 0)} "
             f"spanWallS={self.spans.get('wallS', 0.0)} "
@@ -348,12 +358,74 @@ class TpuSession:
         from ..service.telemetry import MetricsRegistry
         return MetricsRegistry.get().prometheus_text()
 
-    def dump_flight_record(self, path: Optional[str] = None) -> str:
+    def dump_flight_record(self, path: Optional[str] = None,
+                           query_id: Optional[str] = None) -> str:
         """Write the always-on flight ring to a JSON artifact on demand
         (the automatic dump fires when a task body or collect raises);
-        returns the artifact path."""
+        returns the artifact path. ``query_id`` scopes the artifact to
+        one query: the filename carries the id and another query's
+        attributed events are filtered out."""
         from ..service.telemetry import FlightRecorder
-        return FlightRecorder.get().dump(path, reason="on-demand")
+        return FlightRecorder.get().dump(path, reason="on-demand",
+                                         query_id=query_id)
+
+    # -- query-lifecycle observability (docs/observability.md §8) -----------
+    def last_query_id(self) -> Optional[str]:
+        """The query id minted for the last executed collect (None before
+        the first execution; shared by every worker of a lockstep
+        distributed run)."""
+        return getattr(self, "_last_query_id", None)
+
+    def last_stage_stats(self) -> List[dict]:
+        """Stage-boundary exchange statistics of the last executed query:
+        one entry per exchange node in tree order — stage id, data plane,
+        per-partition rows/bytes, p50/max partition bytes and the skew
+        factor observed at materialization. This is the AQE feed
+        (ROADMAP item 2): coalesce/skew re-planning reads exactly this
+        shape."""
+        if self._last_exec_plan is None:
+            raise RuntimeError("no plan executed yet")
+        from ..shuffle.exchange import collect_stage_stats
+        return collect_stage_stats(self._last_exec_plan)
+
+    def last_drift_report(self) -> List[dict]:
+        """Estimate-vs-actual row drift of the last executed query, worst
+        first: per plan node the planner's estimate, the executed actual,
+        the drift ratio, and whether it crossed
+        ``observability.driftThreshold`` (the cardinality-feedback
+        groundwork, plan/estimates.py)."""
+        if self._last_exec_plan is None:
+            raise RuntimeError("no plan executed yet")
+        from ..plan.estimates import drift_report
+        return drift_report(self._last_exec_plan, conf=self.conf)
+
+    def merged_timeline(self, extra=(), query_id: Optional[str] = None,
+                        path: Optional[str] = None):
+        """ONE Chrome-trace timeline for the last executed query across
+        every worker that ran it: this session's recorded spans merged
+        with ``extra`` trace documents (dicts or trace.json paths —
+        typically the REMOTE workers' dumps), filtered to the shared
+        query id, each source under its own process group. Requires the
+        timeline conf (``tracing.timeline``) or a trace-recording run.
+        Returns the merged trace dict; with ``path``, also writes it
+        there and returns the path."""
+        rec = getattr(self, "_last_span_recorder", None)
+        if rec is None:
+            raise RuntimeError("no recorded query timeline (enable "
+                               "spark.rapids.tpu.sql.tracing.timeline)")
+        from ..exec.tracing import merge_chrome_traces
+        qid = query_id or getattr(self, "_last_query_id", None)
+        merged = merge_chrome_traces(
+            [rec.chrome_trace()] + list(extra), query_id=qid)
+        if path:
+            import json
+            import os
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(merged, f)
+            return path
+        return merged
 
     # -- testing hooks (ExecutionPlanCaptureCallback analog) ----------------
     def last_plan(self):
@@ -430,12 +502,15 @@ class TpuSession:
         lines: List[str] = ["== Executed Plan (analyzed) =="]
         lines += _annotated_plan_lines(
             self._last_exec_plan,
-            getattr(ov, "last_violations", []) if ov else [])
+            getattr(ov, "last_violations", []) if ov else [],
+            conf=self.conf)
         rep = self.last_query_metrics()
         sync = rep.get("sync", {})
         spans = rep.get("spans", {})
+        qid = getattr(self, "_last_query_id", None)
         lines.append(
-            f"query: planTimeS={rep.get('planTimeS')} "
+            f"query: {'queryId=' + qid + ' ' if qid else ''}"
+            f"planTimeS={rep.get('planTimeS')} "
             f"executeTimeS={rep.get('executeTimeS')} "
             f"hostSyncs={sync.get('hostSyncs', 0)} "
             f"spanWallS={spans.get('wallS', 0.0)} "

@@ -282,6 +282,18 @@ SHUFFLE_DURABLE = _conf("spark.rapids.tpu.sql.shuffle.durable").doc(
     "trade, SURVEY §5). Off keeps the memory-only fast path"
 ).boolean_conf.create_with_default(False)
 
+SHUFFLE_DURABLE_MAX_BYTES = _conf(
+    "spark.rapids.tpu.sql.shuffle.durable.maxBytes").doc(
+    "Disk budget for the durable shuffle tier's .npz write-through "
+    "(docs/shuffle.md): once the durable files exceed this many bytes, "
+    "the OLDEST COMPLETED shuffle's durable files are evicted (the "
+    "in-memory outputs keep serving this process; only the dead-worker "
+    "rejoin re-serve for that old shuffle is given up), metered into "
+    "tpu_durable_evicted_bytes_total — a long-lived session with "
+    "shuffle.durable on cannot fill the disk. The newest completed "
+    "shuffle is never evicted. 0 disables the budget"
+).bytes_conf.create_with_default(2 * 1024 * 1024 * 1024)
+
 SHUFFLE_FETCH_MAX_RETRIES = _conf(
     "spark.rapids.tpu.sql.shuffle.fetch.maxRetries").doc(
     "Transport-level retries per shuffle fetch before the failure "
@@ -649,6 +661,27 @@ TELEMETRY_FLIGHT_EVENTS = _conf(
     "spark.rapids.tpu.sql.telemetry.flightRecorderEvents").doc(
     "Capacity of the flight-recorder ring; the newest events win"
 ).integer_conf.check(lambda v: int(v) >= 16).create_with_default(4096)
+
+TELEMETRY_QUERY_LOG_DIR = _conf(
+    "spark.rapids.tpu.sql.telemetry.queryLog.dir").doc(
+    "Opt-in structured query log (service/query_log.py, "
+    "docs/observability.md §8): one JSONL record per executed query — "
+    "query id, plan fingerprint, cache verdicts, per-stage exchange "
+    "statistics and wall, stage retries, faults fired, shuffle plane "
+    "bytes, HBM peak operator, drift flags, top operators — appended to "
+    "<dir>/query_log-<pid>.jsonl (render with python -m "
+    "tools.query_report). Empty disables the log"
+).string_conf.create_with_default("")
+
+OBSERVABILITY_DRIFT_THRESHOLD = _conf(
+    "spark.rapids.tpu.sql.observability.driftThreshold").doc(
+    "Estimate-vs-actual row drift ratio at which a plan node is flagged "
+    "as a misestimate (plan/estimates.py; the cardinality-feedback "
+    "groundwork): a node whose actual/estimated output rows ratio is "
+    ">= this factor (or <= its inverse) lands in the per-query drift "
+    "report (session.last_drift_report) and is marked '! drift' in "
+    "EXPLAIN ANALYZE").double_conf.check(
+        lambda v: float(v) > 1.0).create_with_default(4.0)
 
 
 class TpuConf:

@@ -1,0 +1,166 @@
+"""Query-lifecycle context: the per-execution query id and its stage ids.
+
+Spans, flight-recorder events, telemetry counters and shuffle traffic were
+process-global with no query identity: a two-worker distributed query
+emitted two uncorrelated trace files, and ``dump_on_error`` of one session
+interleaved another query's events. This module mints ONE ``query_id``
+per collect and makes it ambient for the duration of the execution, so
+every cross-cutting instrument (``exec/tracing``, ``service/telemetry``,
+the shuffle transport, the mesh exchange) can attribute its events to the
+query that paid for them — the substrate the merged multi-worker timeline
+and the structured query log stand on (docs/observability.md §8).
+
+Query ids are LOCKSTEP-DETERMINISTIC: a process-global execution counter
+plus a structural hash of the executed plan. Multi-process workers run
+the same query sequence (the shuffle-id contract, shuffle/manager.py), so
+both workers mint the SAME id for the same query — which is exactly what
+lets one merged timeline join their spans. Two different concurrent
+queries in one process draw different counter values, so their events
+never alias.
+
+Stage ids number the exchange boundaries within one query (the
+query-stage granularity AQE re-plans at): each shuffle/range exchange
+draws ``next_stage_id()`` at execute time, deterministic because exchange
+``execute()`` calls run on the single driving thread during plan
+construction.
+
+The ambient context uses the SyncCounter pattern (exec/tracing.py): the
+entering thread's context is also the process default, so task-pool
+worker threads — which do the actual partition drains — inherit it; a
+thread entering its own scope overrides the default for itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+from typing import List, Optional
+
+from ..analysis.lockdep import named_lock
+
+#: process-global execution counter (itertools.count.__next__ is
+#: GIL-atomic; workers running the same query sequence draw the same
+#: values — the lockstep contract shuffle ids already rely on)
+_QUERY_SEQ = itertools.count(1)
+
+
+def _plan_digest(plan) -> str:
+    """Short structural hash of an executed plan tree (exec class names +
+    child shape, no data): workers running the same logical query compute
+    the same digest, structurally different queries at the same counter
+    value do not collide."""
+
+    def desc(node) -> str:
+        kids = ";".join(desc(c) for c in getattr(node, "children", ()))
+        return f"{type(node).__name__}({kids})"
+
+    return hashlib.sha1(desc(plan).encode()).hexdigest()[:8]
+
+
+def mint_query_id(plan=None) -> str:
+    """A fresh query id: ``q<seq>-<plan digest>`` (digest omitted when no
+    plan is given). Minted once per collect, at collect time."""
+    seq = next(_QUERY_SEQ)
+    if plan is None:
+        return f"q{seq:06d}"
+    try:
+        return f"q{seq:06d}-{_plan_digest(plan)}"
+    except Exception:
+        return f"q{seq:06d}"
+
+
+class QueryContext:
+    """One query execution's identity: the query id plus the stage-id
+    counter exchanges draw from at their boundaries."""
+
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self._stage_seq = itertools.count(1)
+
+    def next_stage_id(self) -> int:
+        """The next exchange-boundary stage id within this query
+        (deterministic: exchanges execute on the driving thread)."""
+        return next(self._stage_seq)
+
+
+_tls = threading.local()
+_default_stack: List[QueryContext] = []
+# guards _default_stack (the SyncCounter._default_stack discipline):
+# scopes enter on the driving thread but exits can interleave across
+# threads in tests, and bare list mutation racing on the shared stack
+# could resurrect a finished context as the lingering default
+_stack_mu = named_lock("exec.query_context._stack_mu")
+
+
+def current() -> Optional[QueryContext]:
+    """The innermost active query context on THIS thread, falling back to
+    the process default (the driving thread's context, visible to pool
+    worker threads). Lock-free read — this runs per flight-recorder event
+    on hot paths; the check-then-index window is handled by catching (the
+    SyncCounter._get_active rationale)."""
+    local = getattr(_tls, "active", None)
+    if local is not None:
+        return local
+    try:
+        return _default_stack[-1]
+    except IndexError:
+        return None
+
+
+def current_query_id() -> Optional[str]:
+    ctx = current()
+    return ctx.query_id if ctx is not None else None
+
+
+class thread_scope:
+    """TLS-only activation of ``ctx`` on THIS thread (no default-stack
+    push): the task-pool funnel (``exec/tasks.run_partition_tasks``)
+    captures the submitting thread's context and installs it on each
+    worker thread through this, so two CONCURRENT queries' pool events
+    attribute to their own query instead of whichever entered the
+    process default last. ``None`` is a no-op (no ambient query)."""
+
+    def __init__(self, ctx: Optional[QueryContext]):
+        self.ctx = ctx
+
+    def __enter__(self) -> Optional[QueryContext]:
+        if self.ctx is not None:
+            self._prev = getattr(_tls, "active", None)  # lint: unguarded-ok worker thread's own TLS field
+            _tls.active = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc) -> bool:
+        if self.ctx is not None:
+            _tls.active = self._prev
+        return False
+
+
+class query_scope:
+    """Context manager marking ``ctx`` as the active query on this thread
+    AND the process default for the duration. The default is the
+    fallback for auxiliary threads (transport handlers, prefetch pools)
+    that were not routed explicitly; the partition task pool routes
+    explicitly via :class:`thread_scope`, so concurrent queries'
+    dominant event traffic never cross-attributes."""
+
+    def __init__(self, ctx: QueryContext):
+        self.ctx = ctx
+
+    def __enter__(self) -> QueryContext:
+        self._prev = getattr(_tls, "active", None)  # lint: unguarded-ok entering thread's own TLS field, set before the context is shared
+        _tls.active = self.ctx
+        with _stack_mu:
+            _default_stack.append(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc) -> bool:
+        _tls.active = self._prev
+        with _stack_mu:
+            # remove by identity, not LIFO: interleaved exits across
+            # threads must not resurrect a finished context
+            for i in range(len(_default_stack) - 1, -1, -1):
+                if _default_stack[i] is self.ctx:
+                    del _default_stack[i]
+                    break
+        return False

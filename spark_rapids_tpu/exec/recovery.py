@@ -108,6 +108,7 @@ _shuffle_durable: Optional[bool] = None
 _fetch_max_retries: Optional[int] = None
 _fetch_backoff_s: Optional[float] = None
 _spill_dir: Optional[str] = None
+_durable_max_bytes: Optional[int] = None
 _mesh_lost_reason: Optional[str] = None
 
 
@@ -118,6 +119,7 @@ def refresh(conf=None) -> None:
     primed state is how the active session's conf reaches it)."""
     global _max_stage_retries, _backoff_s, _shuffle_durable
     global _fetch_max_retries, _fetch_backoff_s, _spill_dir
+    global _durable_max_bytes
     from .. import config as cfg
     conf = conf or cfg.TpuConf()
     with _mu:
@@ -128,12 +130,14 @@ def refresh(conf=None) -> None:
         _fetch_backoff_s = float(
             conf.get(cfg.SHUFFLE_FETCH_RETRY_BACKOFF))
         _spill_dir = str(conf.spill_dir)
+        _durable_max_bytes = int(conf.get(cfg.SHUFFLE_DURABLE_MAX_BYTES))
 
 
 def reset_cache() -> None:
     """Drop the primed knobs (tests / conf mutation re-prime lazily)."""
     global _max_stage_retries, _backoff_s, _shuffle_durable
     global _fetch_max_retries, _fetch_backoff_s, _spill_dir
+    global _durable_max_bytes
     with _mu:
         _max_stage_retries = None
         _backoff_s = None
@@ -141,6 +145,7 @@ def reset_cache() -> None:
         _fetch_max_retries = None
         _fetch_backoff_s = None
         _spill_dir = None
+        _durable_max_bytes = None
 
 
 def _primed() -> Tuple:
@@ -180,6 +185,16 @@ def spill_dir() -> str:
     lives under it; WorkerContext sits below the session layer, so the
     primed state is how the active session's conf reaches it)."""
     return _primed()[5]
+
+
+def durable_max_bytes() -> int:
+    """The durable shuffle tier's disk budget
+    (``shuffle.durable.maxBytes``; 0 = unbounded). WorkerContext hands
+    it to its ShuffleStore at construction — the store sits below the
+    session layer, so the primed state is how the conf reaches it."""
+    _primed()
+    with _mu:
+        return _durable_max_bytes or 0
 
 
 # ---------------------------------------------------------------------------

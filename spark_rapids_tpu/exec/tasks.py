@@ -236,6 +236,12 @@ def run_partition_tasks(parts: Sequence[Any],
     # no engine locks are held at task launch
     from .spill import drain_deferred_finalizers
     drain_deferred_finalizers()
+    # capture the SUBMITTING thread's query context and install it on
+    # each worker thread (TLS-only): with two concurrent queries in one
+    # process, pool events must attribute to their own query, not to
+    # whichever query entered the process default last
+    from . import query_context as _qc
+    _query_ctx = _qc.current()
 
     def task(pid_part):
         pid, part = pid_part
@@ -246,7 +252,7 @@ def run_partition_tasks(parts: Sequence[Any],
             # jax.transfer_guard_device_to_host(log|disallow); sanctioned
             # implicit crossings wrap themselves in allowed_host_transfer
             from ..analysis.sync_audit import audited_region
-            with audited_region():
+            with _qc.thread_scope(_query_ctx), audited_region():
                 return fn(pid, part)
         except BaseException as e:
             # post-mortem: dump the always-on flight ring for a dying

@@ -144,6 +144,11 @@ class SpanRecorder:
         self._events: List[tuple] = []     # (name, begin, dur, tid, tname)
         self._t0: Optional[float] = None   # entered wall-clock origin
         self._wall: Optional[float] = None
+        # the query id this recorder's spans belong to (set by the
+        # collect that enters the recorder, exec/query_context.py):
+        # rides every exported Chrome-trace event so merged multi-worker
+        # timelines can join both workers' spans under one query
+        self.query_id: Optional[str] = None
 
     def __enter__(self):
         import time
@@ -271,15 +276,23 @@ class SpanRecorder:
         track_of: Dict[tuple, int] = {}
         for name, begin, dur, tid, tname in events:
             track = track_of.setdefault((tid, tname), len(track_of) + 1)
-            out.append({
+            ev = {
                 "ph": "X", "cat": "span", "name": name, "pid": 0,
                 "tid": track, "ts": round((begin - base) * 1e6, 1),
-                "dur": round(dur * 1e6, 1)})
+                "dur": round(dur * 1e6, 1)}
+            if self.query_id is not None:
+                # per-event query attribution: the merged multi-worker
+                # timeline filters/joins spans on this
+                ev["args"] = {"query": self.query_id}
+            out.append(ev)
         for (_tid, tname), track in sorted(track_of.items(),
                                            key=lambda kv: kv[1]):
             out.append({"ph": "M", "name": "thread_name", "pid": 0,
                         "tid": track, "args": {"name": tname}})
-        return {"traceEvents": out, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": out, "displayTimeUnit": "ms"}
+        if self.query_id is not None:
+            doc["queryId"] = self.query_id
+        return doc
 
     def dump_chrome_trace(self, path: str) -> str:
         """Write :meth:`chrome_trace` to ``path`` (the per-query
@@ -293,6 +306,47 @@ class SpanRecorder:
         with open(path, "w") as f:
             json.dump(self.chrome_trace(), f)
         return path
+
+
+def merge_chrome_traces(traces, query_id: Optional[str] = None) -> dict:
+    """Join several workers' Chrome-trace documents into ONE timeline
+    (docs/observability.md §8): each source becomes a distinct ``pid``
+    (its own process group in chrome://tracing / ui.perfetto.dev), its
+    thread tracks and thread_name metadata ride along unchanged, and —
+    when ``query_id`` is given — span ("X") events are filtered to the
+    ones carrying that query id, so a merged distributed timeline shows
+    exactly one query across every worker that executed it.
+
+    ``traces`` items are Chrome-trace dicts (``SpanRecorder.chrome_trace``
+    output) or paths to dumped trace.json files."""
+    import json
+    traces = list(traces)
+    events: List[dict] = []
+    for w, tr in enumerate(traces):
+        if isinstance(tr, str):
+            with open(tr) as f:
+                tr = json.load(f)
+        label = f"worker {w}"
+        saw_process_meta = False
+        for ev in tr.get("traceEvents", ()):
+            ev = dict(ev)
+            if ev.get("ph") == "X" and query_id is not None and \
+                    (ev.get("args") or {}).get("query") != query_id:
+                continue
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                saw_process_meta = True
+                prev = (ev.get("args") or {}).get("name", "")
+                ev["args"] = {"name": f"{label}: {prev}" if prev else label}
+            ev["pid"] = w
+            events.append(ev)
+        if not saw_process_meta:
+            events.append({"ph": "M", "name": "process_name", "pid": w,
+                           "tid": 0, "args": {"name": label}})
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "mergedSources": len(traces)}
+    if query_id is not None:
+        doc["queryId"] = query_id
+    return doc
 
 
 def record_span(name: str, seconds: float) -> None:

@@ -265,6 +265,15 @@ def run_partition_exchange(mesh: Mesh, batches: List[ColumnarBatch],
     from ..analysis.sync_audit import allowed_host_transfer
     with allowed_host_transfer("ici exchange sizing"):
         pcounts = np.asarray(outs[-1])     # ONE readback per exchange
+    # query-lifecycle breadcrumb: the mesh exchange's metadata (worker
+    # count, partition count, total routed rows) lands in the flight
+    # ring stamped with the ambient query id (exec/query_context via the
+    # flight funnel), so a multichip post-mortem ties every collective
+    # exchange to the query that dispatched it
+    from ..service.telemetry import flight_record
+    flight_record("exchange", "ici-partition-exchange",
+                  {"workers": int(n), "partitions": int(num_partitions),
+                   "rows": int(pcounts.sum())})
     results: List[Tuple[List[Column], np.ndarray]] = []
     for w in range(n):
         arrays = [o[w] for o in outs[:-1]]

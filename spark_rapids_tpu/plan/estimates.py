@@ -1,0 +1,227 @@
+"""Planner row estimates + estimate-vs-actual drift.
+
+The planner already carries a size-only statistics visitor
+(``logical.stats_bytes``, the broadcast decision's input); this module is
+its ROW twin on the EXEC tree, annotated at optimization time and
+compared against executed actuals afterwards — the cardinality-feedback
+groundwork (docs/observability.md §8):
+
+* :func:`annotate_estimates` — called by ``Overrides.apply`` after
+  conversion: walks the converted exec tree bottom-up and stamps
+  ``node.est_rows`` from leaf cardinalities (arrow tables, cached
+  handles, file byte sizes, range bounds) and classic per-operator
+  heuristics (filter selectivity 0.25, inner join = max side, limit =
+  min(n, child), expand = child × projections, ...). Deliberately crude:
+  drift against these estimates is the SIGNAL the report exists to
+  surface, and what a future cardinality-feedback loop corrects.
+* :func:`drift_report` — estimate vs the executed ``numOutputRows``
+  actual per node, with the drift ratio (actual/estimate) and a flag
+  when it crosses ``spark.rapids.tpu.sql.observability.driftThreshold``
+  in either direction.
+* :func:`drift_annotations` — the same data shaped as EXPLAIN ANALYZE
+  per-node annotation lines (the fusion_annotations path convention).
+
+Plan-cache note: estimates ride the cached exec tree (structural, not
+data-dependent beyond scan cardinalities at plan time), so a cache hit
+keeps its original estimates while actuals refresh per execution —
+exactly the comparison a repeated misestimate should keep showing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+#: the classic Selinger-style default selectivity for an un-modeled
+#: predicate — deliberately simple; the drift report measures how wrong
+#: it is per query
+FILTER_SELECTIVITY = 0.25
+#: per-row explode fan-out guess for generators
+GENERATE_FANOUT = 4.0
+
+
+def _leaf_rows(node) -> Optional[float]:
+    """Leaf cardinality where the plan actually knows it."""
+    name = type(node).__name__
+    if name == "TpuLocalScanExec":
+        table = getattr(node, "table", None)
+        if table is not None and hasattr(table, "num_rows"):
+            return float(table.num_rows)
+    if name == "TpuCachedScanExec":
+        handle = getattr(getattr(node, "plan", None), "handle", None)
+        if handle is not None:
+            try:
+                return float(int(handle.num_rows))
+            except Exception:
+                return None
+    if name == "TpuRangeExec":
+        try:
+            step = node.step or 1
+            return float(max(0, -(-(node.end - node.start) // step)))
+        except Exception:
+            return None
+    if name == "TpuFileScanExec":
+        plan = getattr(node, "plan", None)
+        if plan is None:
+            return None
+        try:
+            nbytes = plan.stats_bytes()
+            if nbytes >= (1 << 60):
+                return None            # unknown-size sentinel
+            width = max(8, sum(
+                getattr(f.dtype, "byte_width", 0) or 8
+                for f in node.schema))
+            return float(max(1, nbytes // width))
+        except Exception:
+            return None
+    return None
+
+
+def _estimate(node, child_est: List[Optional[float]]) -> Optional[float]:
+    """One node's output-row estimate from its children's (None =
+    unknown; unknown children poison everything above them — a made-up
+    number would turn the drift report into noise)."""
+    name = type(node).__name__
+    leaf = _leaf_rows(node)
+    if leaf is not None:
+        return leaf
+    c0 = child_est[0] if child_est else None
+
+    if name in ("TpuFilterExec",):
+        return None if c0 is None else max(1.0, c0 * FILTER_SELECTIVITY)
+    if name == "TpuWholeStageExec":
+        # the fused chain collapsed its member filters away: apply the
+        # selectivity once per folded filter step
+        if c0 is None:
+            return None
+        steps = getattr(getattr(node, "chain", None), "steps", ())
+        n_filters = sum(1 for s in steps if s and s[0] == "filter")
+        return max(1.0, c0 * (FILTER_SELECTIVITY ** n_filters))
+    if name in ("TpuSortMergeJoinExec", "TpuShuffledJoinExec",
+                "TpuMeshJoinExec"):
+        left, right = (child_est + [None, None])[:2]
+        if left is None or right is None:
+            return None
+        how = getattr(node, "how", "inner")
+        if how in ("left_semi", "left_anti", "left"):
+            return left
+        if how == "right":
+            return right
+        if how == "full":
+            return left + right
+        return max(left, right)        # inner equi-join: FK-side guess
+    if name == "TpuCrossJoinExec":
+        left, right = (child_est + [None, None])[:2]
+        return None if left is None or right is None else left * right
+    if name == "TpuHashAggregateExec":
+        grouping = getattr(node, "grouping", None)
+        if not grouping:
+            return 1.0                 # ungrouped aggregate: one row
+        return c0                      # grouped: child upper bound
+    if name in ("TpuMeshGroupByExec",):
+        return c0
+    if name == "TpuLimitExec":
+        n = getattr(node, "n", None)
+        if n is None:
+            return c0
+        return float(n) if c0 is None else min(float(n), c0)
+    if name == "TpuUnionExec":
+        if any(e is None for e in child_est):
+            return None
+        return float(sum(child_est))
+    if name == "TpuExpandExec":
+        nproj = len(getattr(node, "projections", ()) or ())
+        return None if c0 is None else c0 * max(1, nproj)
+    if name == "TpuGenerateExec":
+        return None if c0 is None else c0 * GENERATE_FANOUT
+    if name in ("TpuMapInPandasExec", "TpuFlatMapGroupsInPandasExec",
+                "TpuFlatMapCoGroupsInPandasExec",
+                "TpuAggregateInPandasExec", "CpuFallbackExec",
+                "CpuOpBridgeExec", "TpuWriteFileExec"):
+        return None                    # opaque: a UDF can emit anything
+    # passthrough default (project, sort, coalesce, exchanges, window,
+    # broadcast, distinct bridges): the child's estimate
+    return c0
+
+
+def annotate_estimates(root) -> None:
+    """Stamp ``est_rows`` bottom-up on every node the heuristics can
+    price (others carry no attribute and render no drift line). Never
+    raises — planning must not fail on observability."""
+
+    def walk(node) -> Optional[float]:
+        child_est = [walk(c) for c in getattr(node, "children", ())]
+        try:
+            est = _estimate(node, child_est)
+        except Exception:
+            est = None
+        if est is not None:
+            node.est_rows = int(est)
+        return est
+
+    try:
+        walk(root)
+    except Exception:
+        pass
+
+
+def _actual_rows(node) -> Optional[int]:
+    try:
+        v = node.metrics.get("numOutputRows", None)
+        return None if v is None else int(v)
+    except Exception:
+        return None
+
+
+def _drift_threshold(conf=None) -> float:
+    from .. import config as cfg
+    try:
+        conf = conf or cfg.TpuConf()
+        return float(conf.get(cfg.OBSERVABILITY_DRIFT_THRESHOLD))
+    except Exception:
+        return 4.0
+
+
+def drift_report(root, conf=None) -> List[Dict]:
+    """Estimate-vs-actual per executed node: ``[{operator, path,
+    estRows, actualRows, ratio, flagged}]``, worst drift first. Only
+    nodes that both carry an estimate and actually emitted a row count
+    appear — a cached/short-circuited node has nothing to compare."""
+    threshold = _drift_threshold(conf)
+    out: List[Dict] = []
+
+    def walk(node, path: str, idx: Optional[int] = None) -> None:
+        name = type(node).__name__
+        here = f"{path}/{idx}.{name}" if path else name
+        est = getattr(node, "est_rows", None)
+        actual = _actual_rows(node)
+        if est is not None and actual is not None:
+            # both sides floored at 1: a perfectly-estimated EMPTY node
+            # (est=0, actual=0) must read as ratio 1.0, not as the
+            # worst misestimate in the report
+            ratio = round(max(1, actual) / max(1, est), 4)
+            flagged = ratio >= threshold or ratio <= 1.0 / threshold
+            out.append({"operator": name, "path": here,
+                        "estRows": int(est), "actualRows": int(actual),
+                        "ratio": ratio, "flagged": flagged})
+        for i, c in enumerate(getattr(node, "children", ())):
+            walk(c, here, i)
+
+    walk(root, "")
+    out.sort(key=lambda d: -max(d["ratio"], 1.0 / max(d["ratio"], 1e-9)))
+    return out
+
+
+def drift_annotations(root, conf=None) -> Dict[str, List[str]]:
+    """The drift comparison as per-node EXPLAIN ANALYZE annotation lines
+    keyed by the contract-validator path convention; misestimates past
+    the threshold lead with ``! drift`` so they read as diagnostics."""
+    threshold = _drift_threshold(conf)
+    out: Dict[str, List[str]] = {}
+    for d in drift_report(root, conf=conf):
+        line = (f"rows: est={d['estRows']} actual={d['actualRows']} "
+                f"drift={d['ratio']}x")
+        if d["flagged"]:
+            line = (f"! drift: {line} (past threshold {threshold}x — "
+                    "misestimate)")
+        out.setdefault(d["path"], []).append(line)
+    return out

@@ -485,6 +485,12 @@ class Overrides:
                                  if self.last_explain else diag)
             if mode != "NONE":
                 print(diag)
+        # planner row estimates stamped at optimization time
+        # (plan/estimates.py): EXPLAIN ANALYZE compares them against
+        # executed actuals per node — the estimate-vs-actual drift
+        # report, the cardinality-feedback groundwork
+        from .estimates import annotate_estimates
+        annotate_estimates(node)
         return node
 
     def _insert_hash_optimize_sorts(self, node: ph.TpuExec) -> ph.TpuExec:

@@ -1,0 +1,208 @@
+"""Structured query log: one JSONL record per executed query.
+
+Opt-in via conf ``spark.rapids.tpu.sql.telemetry.queryLog.dir``
+(docs/observability.md §8): every collect appends one self-contained
+record — query id, plan fingerprint, serving-cache verdicts, per-stage
+exchange statistics and wall seconds, stage retries, faults fired,
+shuffle plane bytes, the HBM peak operator, drift flags, and the top
+operators by time — to ``<dir>/query_log-<pid>.jsonl``. Distributed
+workers each write their own file; the shared query id joins them
+(``python -m tools.query_report`` renders the digest).
+
+The record's field surface is DECLARED in :data:`QUERY_LOG_FIELDS` and
+lint-enforced (rule ``querylog-key``, analysis/lint.py) exactly like the
+exec METRICS and TELEMETRY_KEYS surfaces, so artifact consumers can grep
+one tuple instead of reverse-engineering the writer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+#: every top-level key a query-log record may carry (lint rule
+#: ``querylog-key`` checks :func:`build_record`'s literals against this)
+QUERY_LOG_FIELDS: Tuple[str, ...] = (
+    "queryId", "tS", "wallS", "planTimeS", "rows",
+    "fingerprint", "planCache", "resultCache", "params",
+    "stageStats", "stageWallS", "stageRetries", "fetchRetries",
+    "faultsFired", "shufflePlanes", "hbmPeakBytes", "hbmPeakOperator",
+    "drift", "operators", "hostSyncs", "recompiles",
+)
+
+
+def stage_summaries(exec_plan) -> list:
+    """Per-exchange stage stats with the per-partition lists dropped —
+    the per-query artifact shape (this log AND the bench runner's
+    ``stageStats`` entry share it; ``session.last_stage_stats()`` keeps
+    the full per-partition vectors)."""
+    from ..shuffle.exchange import collect_stage_stats
+    out = []
+    for st in collect_stage_stats(exec_plan):
+        out.append({k: st[k] for k in
+                    ("operator", "stageId", "plane", "partitions",
+                     "totalRows", "totalBytes", "p50Bytes", "maxBytes",
+                     "skew") if k in st})
+    return out
+
+
+def drift_summary(exec_plan, conf=None) -> Dict[str, Any]:
+    """The drift report reduced to its artifact shape: node/flag counts
+    plus the worst flagged misestimate (shared by this log and the
+    bench runner's ``drift`` entry)."""
+    from ..plan.estimates import drift_report
+    drift = drift_report(exec_plan, conf=conf)
+    flagged = [d for d in drift if d["flagged"]]
+    out: Dict[str, Any] = {"nodes": len(drift), "flagged": len(flagged)}
+    if flagged:
+        worst = flagged[0]
+        out["worst"] = {k: worst[k] for k in
+                        ("operator", "estRows", "actualRows", "ratio")}
+    return out
+
+
+def _stage_walls(exec_plan) -> Dict[str, float]:
+    """stage id -> write+fetch wall seconds per exchange node."""
+    out: Dict[str, float] = {}
+
+    def walk(node) -> None:
+        sid = getattr(node, "stage_id", None)
+        if sid is not None and getattr(node, "stage_stats", None):
+            m = node.metrics
+            wall = float(m.get("shuffleWriteTime", 0.0) or 0.0) + \
+                float(m.get("fetchWaitTime", 0.0) or 0.0)
+            out[str(sid)] = round(out.get(str(sid), 0.0) + wall, 4)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(exec_plan)
+    return out
+
+
+def _metric_total(exec_plan, key: str) -> int:
+    total = 0
+
+    def walk(node) -> None:
+        nonlocal total
+        total += int(node.metrics.get(key, 0) or 0)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(exec_plan)
+    return total
+
+
+def _top_operators(exec_plan, top: int = 5) -> list:
+    rows = []
+    for depth, name, m in exec_plan.metrics_tree():
+        t = float(m.get("opTime", 0.0) or 0.0)
+        if t > 0:
+            rows.append({"operator": name.split(" ")[0].split("[")[0],
+                         "opTimeS": round(t, 4),
+                         "rows": int(m.get("numOutputRows", 0) or 0)})
+    rows.sort(key=lambda r: -r["opTimeS"])
+    return rows[:top]
+
+
+def _plane_bytes(exec_plan) -> Dict[str, int]:
+    from ..shuffle.exchange import shuffle_report
+    out: Dict[str, int] = {}
+    for entry in shuffle_report(exec_plan):
+        plane = entry.get("plane")
+        if plane:
+            out[plane] = out.get(plane, 0) + int(entry.get("bytesWritten",
+                                                           0) or 0)
+    return out
+
+
+def build_record(session, exec_plan, serving: Dict[str, Any],
+                 query_id: Optional[str],
+                 faults_before: int = 0) -> Dict[str, Any]:
+    """Assemble one query-log record (every key declared in
+    :data:`QUERY_LOG_FIELDS`). Pure read of post-execution state."""
+    import hashlib
+    import time
+    from ..analysis import faults
+    from .telemetry import watermarks
+    serving = serving or {}
+    fp = serving.get("fingerprint")
+    sync = getattr(session, "_last_sync_report", {}) or {}
+    stage_retries = _metric_total(exec_plan, "stageRetries")
+    fetch_retries = _metric_total(exec_plan, "fetchFailedRetries")
+    drift_entry = drift_summary(exec_plan, conf=session.conf)
+    dev = watermarks().get("device")
+    try:
+        root_rows = int(exec_plan.metrics.get("numOutputRows", 0) or 0)
+    except Exception:
+        root_rows = 0
+    rec: Dict[str, Any] = {
+        "queryId": query_id,
+        "tS": round(time.time(), 3),
+        "wallS": round(getattr(session, "_last_execute_time_s", 0.0), 4),
+        "planTimeS": round(getattr(session, "_last_plan_time_s", 0.0), 4),
+        "rows": root_rows,
+        "fingerprint": (hashlib.sha1(repr(fp).encode()).hexdigest()[:12]
+                        if fp is not None else None),
+        "planCache": serving.get("planCache", "off"),
+        "resultCache": serving.get("resultCache", "off"),
+        "params": serving.get("params", 0),
+        "stageStats": stage_summaries(exec_plan),
+        "stageWallS": _stage_walls(exec_plan),
+        "stageRetries": stage_retries,
+        "fetchRetries": fetch_retries,
+        "faultsFired": max(0, faults.fired_total() - int(faults_before)),
+        "shufflePlanes": _plane_bytes(exec_plan),
+        "hbmPeakBytes": int(dev.peak) if dev is not None else 0,
+        "hbmPeakOperator": dev.peak_operator if dev is not None else None,
+        "drift": drift_entry,
+        "operators": _top_operators(exec_plan),
+        "hostSyncs": int(sync.get("hostSyncs", 0) or 0),
+        "recompiles": _metric_total(exec_plan, "recompiles"),
+    }
+    return rec
+
+
+def log_dir(session) -> Optional[str]:
+    from .. import config as cfg
+    try:
+        d = str(session.conf.get(cfg.TELEMETRY_QUERY_LOG_DIR)).strip()
+        return d or None
+    except Exception:
+        return None
+
+
+def maybe_log(session, exec_plan, serving, query_id,
+              faults_before: int = 0) -> Optional[str]:
+    """Append one record when the query log is enabled; returns the log
+    path. Never raises — a broken log directory must not fail queries
+    (callers also guard, belt and braces)."""
+    d = log_dir(session)
+    if not d:
+        return None
+    try:
+        rec = build_record(session, exec_plan, serving, query_id,
+                           faults_before=faults_before)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"query_log-{os.getpid()}.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+        from .telemetry import MetricsRegistry
+        try:
+            MetricsRegistry.get().counter(
+                "tpu_query_log_records_total",
+                "structured query-log records written").inc()
+            n_flagged = rec["drift"].get("flagged", 0)
+            if n_flagged:
+                MetricsRegistry.get().counter(
+                    "tpu_query_drift_flags_total",
+                    "plan nodes whose estimate-vs-actual drift crossed "
+                    "observability.driftThreshold").inc(n_flagged)
+        except Exception:
+            pass
+        return path
+    except Exception:
+        import logging
+        logging.getLogger("spark_rapids_tpu.query_log").exception(
+            "query-log write failed (query unaffected)")
+        return None

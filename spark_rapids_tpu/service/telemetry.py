@@ -122,6 +122,14 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_worker_rejoin_total",
     "tpu_recovery_seconds",             # histogram, failure -> recovered
     "tpu_faults_injected_total",        # deterministic chaos firings
+    # query-lifecycle observability (docs/observability.md §8)
+    "tpu_exchange_partition_bytes",     # histogram, label plane=ici|dcn
+    "tpu_exchange_skew_factor",         # gauge, last exchange, label plane
+    "tpu_exchange_p50_bytes",           # gauge, last exchange, label plane
+    "tpu_exchange_max_bytes",           # gauge, last exchange, label plane
+    "tpu_durable_evicted_bytes_total",  # durable-tier GC budget evictions
+    "tpu_query_log_records_total",      # structured query-log lines
+    "tpu_query_drift_flags_total",      # plan nodes past driftThreshold
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
@@ -587,20 +595,35 @@ class FlightRecorder:
             return self._n
 
     def dump(self, path: Optional[str] = None,
-             reason: Optional[str] = None) -> str:
+             reason: Optional[str] = None,
+             query_id: Optional[str] = None) -> str:
         """Write the ring to a JSON artifact and return its path. Parent
         directories are created defensively; IO errors raise here — the
         *automatic* dump path (:func:`dump_on_error`) wraps this so a
-        failed telemetry write can never mask a query exception."""
+        failed telemetry write can never mask a query exception.
+
+        With ``query_id`` the artifact is SCOPED to that query: the
+        filename carries the id, and ring entries attributed to a
+        DIFFERENT query are filtered out (a concurrent session's events
+        no longer interleave the post-mortem) — process-level events
+        with no query attribution are kept, they are context."""
         if path is None:
+            qpart = f"-{query_id}" if query_id else ""
             path = os.path.join(
                 _flight_dir,
-                f"flight-{os.getpid()}-{next(_dump_seq)}.json")
+                f"flight-{os.getpid()}{qpart}-{next(_dump_seq)}.json")
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        events = self.events()
+        if query_id is not None:
+            events = [e for e in events
+                      if e.get("data", {}).get("query", query_id)
+                      == query_id]
         doc = {"dumpedAtS": round(time.time(), 3), "pid": os.getpid(),
                "reason": reason, "totalEvents": self.event_count(),
-               "events": self.events()}
+               "events": events}
+        if query_id is not None:
+            doc["queryId"] = query_id
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         try:
@@ -620,11 +643,26 @@ def flight_record(kind: str, name: str, data: Optional[Dict] = None) -> None:
     recorder conf is off). The funnel every instrument calls. Re-entry
     on the same thread is dropped: lockdep's cycle incident can fire
     *inside* the acquisition of this module's own singleton lock, and
-    recursing there would deadlock on the non-reentrant raw lock."""
+    recursing there would deadlock on the non-reentrant raw lock.
+
+    When a query context is active (``exec/query_context``), its query
+    id is stamped into the event's data — so EVERY instrument routing
+    through this funnel (spans, syncs, spills, recompiles, faults,
+    recovery, conf changes) is attributable to the query that paid for
+    it, and ``dump(query_id=...)`` can filter a concurrent session's
+    events out of a post-mortem."""
     if getattr(_flight_tls, "busy", False) or not _flight_on():
         return
     _flight_tls.busy = True
     try:
+        try:
+            from ..exec.query_context import current_query_id
+            qid = current_query_id()
+        except Exception:
+            qid = None
+        if qid is not None:
+            data = dict(data) if data else {}
+            data.setdefault("query", qid)
         FlightRecorder.get().record(kind, name, data)
     finally:
         _flight_tls.busy = False
@@ -640,8 +678,17 @@ def dump_on_error(exc: BaseException) -> Optional[str]:
         existing = getattr(exc, "_tpu_flight_dump", None)
         if existing is not None:
             return existing
+        # scope the artifact to the FAILING query: the dump runs on the
+        # failing task/collect thread, so the ambient query context IS
+        # the query that died — its id lands in the filename and other
+        # concurrent queries' attributed events are filtered out
+        try:
+            from ..exec.query_context import current_query_id
+            qid = current_query_id()
+        except Exception:
+            qid = None
         path = FlightRecorder.get().dump(
-            reason=f"{type(exc).__name__}: {exc}")
+            reason=f"{type(exc).__name__}: {exc}", query_id=qid)
         try:
             exc._tpu_flight_dump = path
         except Exception:

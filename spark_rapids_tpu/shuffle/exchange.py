@@ -75,6 +75,156 @@ def plane_totals() -> Dict[str, float]:
         return dict(_PLANE_TOTALS)
 
 
+# ---------------------------------------------------------------------------
+# Stage-boundary exchange statistics (docs/observability.md §8): what an
+# exchange ACTUALLY produced, per reduce partition — the feed AQE's
+# coalesce/skew decisions read (ROADMAP item 2), recorded at
+# materialization on all three planes (local DCN, distributed, ICI).
+# ---------------------------------------------------------------------------
+
+#: byte-scale buckets for the per-partition size histogram (the default
+#: registry buckets are second-scale)
+_PARTITION_BYTE_BUCKETS = (1 << 10, 1 << 14, 1 << 17, 1 << 20, 1 << 23,
+                           1 << 26, 1 << 30, float("inf"))
+
+
+def compute_stage_stats(stage_id: Optional[int], plane: str,
+                        rows: List[int], bytes_: List[int],
+                        query_id: Optional[str] = None) -> Dict[str, Any]:
+    """Derive the stage-boundary statistics of one materialized exchange
+    from its per-partition row/byte observations: partition count, p50
+    and max partition bytes, and the skew factor (max partition bytes
+    over the MEAN partition bytes — 1.0 is perfectly balanced; the AQE
+    skew splitter compares this shape against its threshold)."""
+    import statistics
+    n = len(bytes_)
+    total_b = int(sum(bytes_))
+    total_r = int(sum(rows))
+    p50 = float(statistics.median(bytes_)) if bytes_ else 0.0
+    mx = int(max(bytes_)) if bytes_ else 0
+    mean = total_b / n if n else 0.0
+    skew = round(mx / mean, 4) if mean > 0 else 1.0
+    return {"stageId": stage_id, "queryId": query_id, "plane": plane,
+            "partitions": n,
+            "rows": [int(r) for r in rows],
+            "bytes": [int(b) for b in bytes_],
+            "totalRows": total_r, "totalBytes": total_b,
+            "p50Bytes": p50, "maxBytes": mx, "skew": skew}
+
+
+def publish_stage_stats(stats: Dict[str, Any]) -> None:
+    """Surface one exchange's stage statistics into the continuous
+    telemetry layer: per-partition bytes into the
+    ``tpu_exchange_partition_bytes`` histogram, the derived shape into
+    the last-exchange gauges, and a flight-recorder breadcrumb (kind
+    ``stage``, query id auto-stamped by the funnel). Bumped once per
+    exchange at materialization, never per batch."""
+    from ..service.telemetry import MetricsRegistry, flight_record
+    flight_record("stage", f"stage-{stats.get('stageId')}",
+                  {k: stats[k] for k in ("plane", "partitions", "totalRows",
+                                         "totalBytes", "maxBytes", "skew")})
+    try:
+        reg = MetricsRegistry.get()
+        plane = stats["plane"]
+        h = reg.histogram("tpu_exchange_partition_bytes",
+                          "post-shuffle partition sizes at exchange "
+                          "materialization", _PARTITION_BYTE_BUCKETS,
+                          plane=plane)
+        for b in stats["bytes"]:
+            h.observe(b)
+        reg.gauge("tpu_exchange_skew_factor",
+                  "last exchange's max/mean partition-size ratio",
+                  plane=plane).set(stats["skew"])
+        reg.gauge("tpu_exchange_p50_bytes",
+                  "last exchange's median partition bytes",
+                  plane=plane).set(stats["p50Bytes"])
+        reg.gauge("tpu_exchange_max_bytes",
+                  "last exchange's largest partition bytes",
+                  plane=plane).set(stats["maxBytes"])
+    except Exception:
+        pass               # telemetry must never fail the exchange
+
+
+def assign_stage(node) -> None:
+    """Draw ``node``'s query id + stage id for THIS execution from the
+    ambient query context (exec/query_context.py). Exchange ``execute()``
+    runs on the single driving thread during plan-tree construction, so
+    stage ids are deterministic per query — lockstep workers number
+    their exchanges identically."""
+    from ..exec import query_context as qc
+    ctx = qc.current()
+    node.query_id = ctx.query_id if ctx is not None else None
+    node.stage_id = ctx.next_stage_id() if ctx is not None else None
+    node.stage_stats = None            # fresh per execution
+
+
+def record_local_shuffle_stats(node, shuffle) -> None:
+    """Per-partition rows/bytes from a LocalShuffle's registered
+    map-output slices (the local DCN plane's materialization boundary);
+    commits + publishes the node's stage statistics. Gated by
+    ``sql.metrics.enabled`` — the dataSize AQE feed stays load-bearing
+    regardless."""
+    from ..exec.metrics import metrics_enabled
+    if not metrics_enabled():
+        return
+    rows: List[int] = []
+    bytes_: List[int] = []
+    for p in range(node.num_partitions):
+        r = b = 0
+        for s in shuffle.slices[p]:
+            try:
+                r += int(s.num_rows)
+            except Exception:
+                pass           # a closed/lazy slice: rows stay partial
+            b += int(getattr(s, "size_bytes", 0) or 0)
+        rows.append(r)
+        bytes_.append(b)
+    node.stage_stats = compute_stage_stats(
+        node.stage_id, "dcn", rows, bytes_, query_id=node.query_id)
+    publish_stage_stats(node.stage_stats)
+
+
+def collect_stage_stats(root) -> List[Dict[str, Any]]:
+    """Every exchange's stage statistics in an executed plan tree, in
+    tree order with the operator name attached —
+    ``session.last_stage_stats()``'s data, shaped so the AQE feedback
+    loop (ROADMAP item 2) consumes it without rework."""
+    out: List[Dict[str, Any]] = []
+
+    def walk(node) -> None:
+        st = getattr(node, "stage_stats", None)
+        if st:
+            out.append({"operator": type(node).__name__, **st})
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(root)
+    return out
+
+
+def stage_stats_annotations(root) -> Dict[str, List[str]]:
+    """Per-exchange EXPLAIN ANALYZE annotations keyed by the same
+    root->node class-name path the contract validator and
+    ``stage_compiler.fusion_annotations`` use."""
+    out: Dict[str, List[str]] = {}
+
+    def walk(node, path: str, idx: Optional[int] = None) -> None:
+        name = type(node).__name__
+        here = f"{path}/{idx}.{name}" if path else name
+        st = getattr(node, "stage_stats", None)
+        if st:
+            out[here] = [
+                f"* stage {st.get('stageId')} exchange [{st['plane']}]: "
+                f"partitions={st['partitions']} rows={st['totalRows']} "
+                f"p50Bytes={int(st['p50Bytes'])} "
+                f"maxBytes={st['maxBytes']} skew={st['skew']}"]
+        for i, c in enumerate(getattr(node, "children", ())):
+            walk(c, here, i)
+
+    walk(root, "")
+    return out
+
+
 def shuffle_report(root) -> List[Dict[str, Any]]:
     """Per-exchange shuffle accounting for an executed plan tree: which
     plane each exchange took, bytes written/read, write/fetch seconds and
@@ -282,6 +432,13 @@ class TpuShuffleExchangeExec(TpuExec):
         self.mesh = mesh
         self.split_depth = split_depth
         self.plane_used: Optional[str] = None
+        # query-lifecycle identity + the exchange's stage-boundary
+        # statistics (docs/observability.md §8): assigned at execute time
+        # from the ambient query context, refreshed per execution (cached
+        # plan trees re-execute under new query ids)
+        self.query_id: Optional[str] = None
+        self.stage_id: Optional[int] = None
+        self.stage_stats: Optional[Dict[str, Any]] = None
 
     @property
     def schema(self):
@@ -348,8 +505,27 @@ class TpuShuffleExchangeExec(TpuExec):
         self.metrics.inc("dcnExchanges")
         note_plane("dcn", total, time.perf_counter() - t0)
 
+    def _assign_stage(self) -> None:
+        assign_stage(self)
+
+    def _finish_stage_stats(self, plane: str, rows: List[int],
+                            bytes_: List[int]) -> None:
+        """Commit + publish this exchange's materialization statistics
+        (stats collection rides the sql.metrics.enabled gate; the
+        dataSize AQE feed stays load-bearing regardless)."""
+        from ..exec.metrics import metrics_enabled
+        if not metrics_enabled():
+            return
+        self.stage_stats = compute_stage_stats(
+            self.stage_id, plane, rows, bytes_, query_id=self.query_id)
+        publish_stage_stats(self.stage_stats)
+
+    def _record_local_stats(self, shuffle: "LocalShuffle") -> None:
+        record_local_shuffle_stats(self, shuffle)
+
     def execute(self) -> List[Partition]:
         from .manager import WorkerContext
+        self._assign_stage()
         ctx = WorkerContext.current
         plane = self._resolve_plane(ctx)
         self.plane_used = plane
@@ -358,6 +534,7 @@ class TpuShuffleExchangeExec(TpuExec):
         if plane == "ici":
             return self._execute_ici()
         shuffle = self._local_map_with_retry()
+        self._record_local_stats(shuffle)
         groups = self._reduce_groups(shuffle)
         return [self._read_group(shuffle, g) for g in groups]
 
@@ -491,6 +668,18 @@ class TpuShuffleExchangeExec(TpuExec):
                 mesh, shards, pids, self.num_partitions)
         self.metrics.inc("iciExchanges")
         note_plane("ici", moved, time.perf_counter() - t0)
+        # stage-boundary statistics from the ONE counts readback that
+        # already came home: per-partition rows are the column sums of
+        # the [n, num_partitions] counts; bytes are estimated from the
+        # exchange's fixed-width row footprint (moved / total rows) —
+        # the ICI plane never stages per-slice host bytes to measure
+        counts = [r[1] for r in results]
+        rows = [int(sum(int(c[p]) for c in counts))
+                for p in range(self.num_partitions)]
+        total_rows = sum(rows)
+        bpr = (moved / total_rows) if total_rows else 0.0
+        self._finish_stage_stats("ici", rows,
+                                 [int(r * bpr) for r in rows])
 
         def gen(p: int) -> Partition:
             from ..columnar.column import bucket
@@ -524,8 +713,10 @@ class TpuShuffleExchangeExec(TpuExec):
         from .manager import WorkerContext
         assert WorkerContext.current is None, \
             "skew split is a local-mode path"
+        self._assign_stage()
         self.plane_used = "dcn"       # skew split is a host-plane feature
         shuffle = self._local_map_with_retry()
+        self._record_local_stats(shuffle)
         out: List[List[Partition]] = []
         for p in range(self.num_partitions):
             sizes = [s.size_bytes for s in shuffle.slices[p]]
@@ -651,6 +842,7 @@ class TpuShuffleExchangeExec(TpuExec):
         recovery.retry_stage("shuffle-map", attempt, on_retry=discard,
                              retryable=gate)
         shuffle.finish_writes()
+        self._record_distributed_stats(shuffle, ctx)
 
         def owned(p):
             with trace_span("shuffle_fetch", self.metrics, "fetchWaitTime"):
@@ -665,6 +857,29 @@ class TpuShuffleExchangeExec(TpuExec):
 
         return [owned(p) if ctx.owns_reduce(p) else empty()
                 for p in range(self.num_partitions)]
+
+    def _record_distributed_stats(self, shuffle, ctx) -> None:
+        """Per-partition rows/bytes of THIS worker's map outputs, read
+        from the shuffle store's registered buffer metadata (the
+        distributed plane's materialization boundary). Each worker
+        records its own map-side contribution; the union across workers
+        is the exchange's global shape — summing here would cost a
+        cross-worker round trip per exchange."""
+        from ..exec.metrics import metrics_enabled
+        if not metrics_enabled():
+            return
+        rows = [0] * self.num_partitions
+        bytes_ = [0] * self.num_partitions
+        try:
+            metas = ctx.store.metas(shuffle.shuffle_id,
+                                    list(range(self.num_partitions)))
+            for m in metas:
+                if 0 <= m.reduce_id < self.num_partitions:
+                    rows[m.reduce_id] += int(m.num_rows)
+                    bytes_[m.reduce_id] += int(m.total_bytes)
+        except Exception:
+            return             # stats must never fail the exchange
+        self._finish_stage_stats("dcn", rows, bytes_)
 
     def _reduce_groups(self, shuffle: LocalShuffle) -> List[List[int]]:
         """Adaptive partition coalescing: group adjacent reduce partitions
@@ -839,6 +1054,9 @@ class TpuRangeExchangeExec(TpuExec):
         self.orders = [lp.SortOrder(bind_refs(o.child, child.schema),
                                     o.ascending, o.nulls_first)
                        for o in orders]
+        self.query_id: Optional[str] = None
+        self.stage_id: Optional[int] = None
+        self.stage_stats: Optional[Dict[str, Any]] = None
 
     @property
     def schema(self):
@@ -866,6 +1084,7 @@ class TpuRangeExchangeExec(TpuExec):
     def execute(self) -> List[Partition]:
         from ..plan.physical import accumulate_spillable
         from .partitioning import RangePartitioner
+        assign_stage(self)
         spillables = accumulate_spillable(self.children[0].execute())
         if not spillables:
             def empty():
@@ -894,6 +1113,7 @@ class TpuRangeExchangeExec(TpuExec):
                 shuffle.write_deferred(win, partitioner, s.get_batch())
                 s.close()
             win.flush()
+        record_local_shuffle_stats(self, shuffle)
         return [shuffle.read(p, self.schema)
                 for p in range(self.num_partitions)]
 

@@ -77,7 +77,10 @@ class WorkerContext:
                     recovery.spill_dir(),
                     f"shuffle-durable-w{worker_id}")
         self.durable_dir = durable_dir
-        self.store = ShuffleStore(durable_dir=durable_dir)
+        from ..exec import recovery as _recovery
+        self.store = ShuffleStore(
+            durable_dir=durable_dir,
+            durable_budget=_recovery.durable_max_bytes())
         self.store.release_quorum = n_workers
         if durable_dir:
             # a rejoining worker (fresh process, same durable dir)

@@ -64,6 +64,17 @@ def transport_totals() -> Dict[str, int]:
         return dict(_TOTALS)
 
 
+def _current_query_id():
+    """The ambient query id for protocol headers (None outside a
+    collect — e.g. liveness probes); guarded so the transport never
+    fails on observability."""
+    try:
+        from ..exec.query_context import current_query_id
+        return current_query_id()
+    except Exception:
+        return None
+
+
 class ShuffleFetchError(RuntimeError):
     """Fetch failed after retries (RapidsShuffleFetchFailedException analog:
     the caller maps this to a stage retry / recompute)."""
@@ -112,7 +123,8 @@ class ShuffleStore:
     the rejoining worker's peers re-fetch instead of aborting (the
     checkpoint/resume trade of SURVEY §5, docs/resilience.md)."""
 
-    def __init__(self, durable_dir: Optional[str] = None):
+    def __init__(self, durable_dir: Optional[str] = None,
+                 durable_budget: int = 0):
         self._mu = named_lock("shuffle.transport.ShuffleStore._mu")
         self._next_id = 1
         self._buffers: Dict[int, Tuple[BufferDesc, List[np.ndarray]]] = {}
@@ -127,6 +139,14 @@ class ShuffleStore:
         self.durable_dir = durable_dir
         self._durable_files: Dict[int, Tuple[str, str]] = {}
         self._durable_max_sid = 0
+        # durable-tier GC budget (conf shuffle.durable.maxBytes, wired
+        # by WorkerContext; 0 = unbounded): total .npz bytes on disk,
+        # per-shuffle byte shares, and the completion order the
+        # oldest-completed eviction walks
+        self.durable_budget = int(durable_budget)
+        self._durable_bytes = 0
+        self._durable_sid_bytes: Dict[int, int] = {}
+        self._durable_complete_order: List[int] = []
 
     def register_batch(self, shuffle_id: int, reduce_id: int,
                        batch: ColumnarBatch) -> int:
@@ -161,12 +181,51 @@ class ShuffleStore:
         np.savez(stem + ".npz", *arrays)
         with open(stem + ".json", "w") as f:
             _json.dump(desc.to_json(), f)
+        nbytes = int(sum(a.nbytes for a in arrays))
         with self._mu:
             self._durable_files[bid] = (stem + ".npz", stem + ".json")
+            self._durable_bytes += nbytes
+            self._durable_sid_bytes[desc.shuffle_id] = \
+                self._durable_sid_bytes.get(desc.shuffle_id, 0) + nbytes
         from ..service.telemetry import flight_record
         flight_record("spill", f"shuffle-durable-{bid}",
                       {"shuffle": desc.shuffle_id,
                        "reduce": desc.reduce_id})
+        self._enforce_durable_budget()
+
+    def _enforce_durable_budget(self) -> None:
+        """Durable-tier GC (conf ``shuffle.durable.maxBytes``): while the
+        .npz tier exceeds its disk budget, evict the OLDEST COMPLETED
+        shuffle's durable files — the in-memory outputs keep serving this
+        process unchanged; only the dead-worker rejoin re-serve for that
+        old shuffle is given up. The newest completed shuffle is never
+        evicted (it is the one an in-flight retry most plausibly needs),
+        so a long-lived ``shuffle.durable`` session degrades to bounded
+        disk instead of filling it. Evicted bytes are metered into
+        ``tpu_durable_evicted_bytes_total``."""
+        if not self.durable_budget or not self.durable_dir:
+            return
+        while True:
+            with self._mu:
+                if self._durable_bytes <= self.durable_budget or \
+                        len(self._durable_complete_order) <= 1:
+                    return
+                sid = self._durable_complete_order.pop(0)
+                freed = self._durable_sid_bytes.pop(sid, 0)
+                self._durable_bytes -= freed
+                bids = [b for b, (d, _a) in self._buffers.items()
+                        if d.shuffle_id == sid and b in self._durable_files]
+            self._unlink_durable(bids, shuffle_id=sid)
+            from ..service.telemetry import MetricsRegistry, flight_record
+            flight_record("spill", f"shuffle-durable-evict-{sid}",
+                          {"shuffle": sid, "bytes": freed})
+            try:
+                MetricsRegistry.get().counter(
+                    "tpu_durable_evicted_bytes_total",
+                    "durable shuffle-tier bytes evicted by the "
+                    "shuffle.durable.maxBytes GC budget").inc(freed)
+            except Exception:
+                pass           # telemetry must never fail the eviction
 
     def reload_durable(self) -> int:
         """Rebuild the store from a durable directory (a rejoining
@@ -209,9 +268,14 @@ class ShuffleStore:
                 self._durable_files[bid] = (npz_path, meta_path)
                 self._durable_max_sid = max(self._durable_max_sid,
                                             desc.shuffle_id)
+                nbytes = int(sum(a.nbytes for a in arrays))
+                self._durable_bytes += nbytes
+                self._durable_sid_bytes[desc.shuffle_id] = \
+                    self._durable_sid_bytes.get(desc.shuffle_id, 0) + \
+                    nbytes
             n += 1
-        for marker in glob.glob(
-                os.path.join(self.durable_dir, "complete-*")):
+        for marker in sorted(glob.glob(
+                os.path.join(self.durable_dir, "complete-*"))):
             try:
                 sid = int(os.path.basename(marker).split("-", 1)[1])
             except ValueError:
@@ -219,6 +283,13 @@ class ShuffleStore:
             with self._mu:
                 self._complete.add(sid)
                 self._durable_max_sid = max(self._durable_max_sid, sid)
+                if sid not in self._durable_complete_order:
+                    self._durable_complete_order.append(sid)
+        # the reloaded tier obeys the budget too (sorted marker order
+        # approximates completion order; ids are monotonic per worker)
+        with self._mu:
+            self._durable_complete_order.sort()
+        self._enforce_durable_budget()
         for fp_path in glob.glob(
                 os.path.join(self.durable_dir, "fp-*")):
             try:
@@ -275,6 +346,11 @@ class ShuffleStore:
         ordering Spark provides; a flag replaces it standalone)."""
         with self._mu:
             self._complete.add(shuffle_id)
+            if self.durable_dir and shuffle_id >= 0 and \
+                    shuffle_id not in self._durable_complete_order:
+                # completion order drives the GC budget's oldest-first
+                # eviction walk
+                self._durable_complete_order.append(shuffle_id)
         if self.durable_dir and shuffle_id >= 0:
             # completion survives a worker death with the slices: the
             # rejoined server answers completion polls immediately
@@ -282,6 +358,7 @@ class ShuffleStore:
             with open(os.path.join(self.durable_dir,
                                    f"complete-{shuffle_id}"), "w"):
                 pass
+            self._enforce_durable_budget()
 
     def is_complete(self, shuffle_id: int) -> bool:
         with self._mu:
@@ -360,6 +437,10 @@ class ShuffleStore:
                     removed.append(bid)
             self._complete.discard(shuffle_id)
             self._fingerprints.pop(shuffle_id, None)
+            self._durable_bytes -= self._durable_sid_bytes.pop(
+                shuffle_id, 0)
+            if shuffle_id in self._durable_complete_order:
+                self._durable_complete_order.remove(shuffle_id)
         if self.durable_dir:
             self._unlink_durable(removed, shuffle_id=shuffle_id)
 
@@ -495,6 +576,21 @@ class ShuffleServer:
                 msg_type, header, _payload = reader.next_frame()
                 if msg_type == META_REQ:
                     sid = header["shuffle_id"]
+                    peer_q = header.get("query_id")
+                    if peer_q and header.get("reduce_ids"):
+                        # the fetching peer's query id rides the protocol
+                        # header: an ACTUAL data serve lands in THIS
+                        # worker's flight ring attributed to the same
+                        # query id the peer's events carry — the
+                        # cross-process join key post-mortems filter on.
+                        # Completion polls (empty reduce_ids, up to one
+                        # per 50ms-1s during straggler waits) are NOT
+                        # recorded — they would churn identical
+                        # breadcrumbs through the fixed-size ring,
+                        # displacing the events a post-mortem needs
+                        from ..service.telemetry import flight_record
+                        flight_record("serve", f"shuffle-{sid}",
+                                      {"query": peer_q})
                     conflict = self.store.check_fingerprint(
                         sid, header.get("fingerprint"))
                     if conflict is not None:
@@ -681,7 +777,8 @@ class ShuffleClient:
                 conn = self._connect()
                 conn.send(encode_frame(META_REQ, {
                     "shuffle_id": shuffle_id, "reduce_ids": [],
-                    "fingerprint": fingerprint}))
+                    "fingerprint": fingerprint,
+                    "query_id": _current_query_id()}))
                 reader = FrameReader(conn.read_exact)
                 msg_type, header, _ = reader.next_frame()
                 if msg_type == ERROR and header.get("code") in (
@@ -770,7 +867,8 @@ class ShuffleClient:
         try:
             conn.send(encode_frame(META_REQ, {
                 "shuffle_id": shuffle_id, "reduce_ids": reduce_ids,
-                "fingerprint": fingerprint}))
+                "fingerprint": fingerprint,
+                "query_id": _current_query_id()}))
             reader = FrameReader(conn.read_exact)
             msg_type, header, _ = reader.next_frame()
             if msg_type == ERROR:

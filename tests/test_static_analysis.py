@@ -169,6 +169,39 @@ def test_rule_telemetry_key_undeclared():
     assert len(v2) == 1 and "TELEMETRY_KEYS" in v2[0].message
 
 
+def test_rule_querylog_key_undeclared():
+    """A top-level record field build_record emits (rec dict literal or
+    rec["..."] assign) not declared in QUERY_LOG_FIELDS trips
+    querylog-key; declared fields pass; nested dict literals NOT
+    assigned to rec are out of scope; a missing QUERY_LOG_FIELDS tuple
+    is itself a violation."""
+    src = (
+        'QUERY_LOG_FIELDS = ("queryId", "wallS")\n'
+        'def build_record(session):\n'
+        '    inner = {"notAField": 1}\n'
+        '    rec = {"queryId": "q1", "wallS": 0.5, "rogueField": inner}\n'
+        '    rec["alsoRogue"] = 2\n'
+        '    return rec\n')
+    v = lint.check_querylog_keys(src, "service/query_log.py")
+    assert [x.rule for x in v] == ["querylog-key"] * 2, v
+    msgs = "\n".join(x.message for x in v)
+    assert "rogueField" in msgs and "alsoRogue" in msgs
+    assert "queryId" not in msgs and "notAField" not in msgs
+    v2 = lint.check_querylog_keys("X = 1\n", "service/query_log.py")
+    assert len(v2) == 1 and "QUERY_LOG_FIELDS" in v2[0].message
+
+
+def test_querylog_fields_surface_in_sync_now():
+    """The live query-log writer emits only declared fields, and the
+    declared tuple parses to the engine's exported surface."""
+    path = os.path.join(PKG, "service", "query_log.py")
+    with open(path) as f:
+        src = f.read()
+    assert lint.check_querylog_keys(src, path) == []
+    from spark_rapids_tpu.service.query_log import QUERY_LOG_FIELDS
+    assert lint.querylog_declared_keys(src) == set(QUERY_LOG_FIELDS)
+
+
 def test_telemetry_keys_surface_in_sync_now():
     """Every registry metric name the package emits is declared (the
     live telemetry-key gate over the real tree), and the declared tuple

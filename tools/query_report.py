@@ -1,0 +1,151 @@
+"""Render a query-log JSONL into per-query human digests.
+
+Usage::
+
+    python -m tools.query_report path/to/query_log-*.jsonl [--top 5]
+
+Reads one or more structured query-log files (conf
+``spark.rapids.tpu.sql.telemetry.queryLog.dir``, service/query_log.py)
+and prints, per query id: the headline (wall, rows, cache verdicts), the
+top operators by time, the skewest exchange, the worst
+estimate-vs-actual drift, and retries/faults — the "what happened in
+this CI artifact" answer without opening JSON by hand. Records from
+multiple workers sharing a query id (a distributed run) merge into one
+digest with per-worker stage lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_records(paths: List[str]) -> List[dict]:
+    out: List[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue            # torn tail line: skip, not fatal
+    return out
+
+
+def _skewest(records: List[dict]) -> dict:
+    best = None
+    for rec in records:
+        for st in rec.get("stageStats", ()) or ():
+            if best is None or st.get("skew", 0) > best.get("skew", 0):
+                best = st
+    return best or {}
+
+
+def _worst_drift(records: List[dict]) -> dict:
+    best = None
+    best_mag = 0.0
+    for rec in records:
+        worst = (rec.get("drift") or {}).get("worst")
+        if not worst:
+            continue
+        r = float(worst.get("ratio", 1.0)) or 1e-9
+        mag = max(r, 1.0 / r)
+        if mag > best_mag:
+            best, best_mag = worst, mag
+    return best or {}
+
+
+def digest(query_id: str, records: List[dict], top: int = 5) -> str:
+    """One query's digest text from its (possibly multi-worker)
+    records."""
+    lines: List[str] = []
+    head = records[0]
+    wall = max(float(r.get("wallS", 0) or 0) for r in records)
+    rows = sum(int(r.get("rows", 0) or 0) for r in records)
+    retries = sum(int(r.get("stageRetries", 0) or 0) for r in records)
+    faults = sum(int(r.get("faultsFired", 0) or 0) for r in records)
+    lines.append(f"query {query_id}  "
+                 f"({len(records)} worker record(s))")
+    lines.append(
+        f"  wallS={wall} rows={rows} "
+        f"planCache={head.get('planCache')} "
+        f"resultCache={head.get('resultCache')} "
+        f"params={head.get('params', 0)}")
+    if retries or faults:
+        lines.append(f"  retries: stage={retries} "
+                     f"fetch={sum(int(r.get('fetchRetries', 0) or 0) for r in records)} "
+                     f"faultsFired={faults}")
+    # top operators by time, merged across workers
+    ops: Dict[str, dict] = {}
+    for rec in records:
+        for op in rec.get("operators", ()) or ():
+            e = ops.setdefault(op["operator"],
+                               {"opTimeS": 0.0, "rows": 0})
+            e["opTimeS"] += float(op.get("opTimeS", 0) or 0)
+            e["rows"] += int(op.get("rows", 0) or 0)
+    ranked = sorted(ops.items(), key=lambda kv: -kv[1]["opTimeS"])[:top]
+    if ranked:
+        lines.append("  top operators by time:")
+        for name, e in ranked:
+            lines.append(f"    {name}: {round(e['opTimeS'], 4)}s "
+                         f"rows={e['rows']}")
+    sk = _skewest(records)
+    if sk:
+        lines.append(
+            f"  skewest exchange: stage {sk.get('stageId')} "
+            f"[{sk.get('plane')}] skew={sk.get('skew')} "
+            f"p50Bytes={int(sk.get('p50Bytes', 0))} "
+            f"maxBytes={sk.get('maxBytes')} "
+            f"partitions={sk.get('partitions')}")
+    wd = _worst_drift(records)
+    if wd:
+        lines.append(
+            f"  worst drift: {wd.get('operator')} "
+            f"est={wd.get('estRows')} actual={wd.get('actualRows')} "
+            f"ratio={wd.get('ratio')}x")
+    flagged = sum((r.get("drift") or {}).get("flagged", 0)
+                  for r in records)
+    if flagged:
+        lines.append(f"  drift flags past threshold: {flagged}")
+    hbm = max((int(r.get("hbmPeakBytes", 0) or 0) for r in records),
+              default=0)
+    if hbm:
+        op = next((r.get("hbmPeakOperator") for r in records
+                   if r.get("hbmPeakOperator")), None)
+        lines.append(f"  hbm peak: {hbm} bytes"
+                     + (f" ({op})" if op else ""))
+    return "\n".join(lines)
+
+
+def render(paths: List[str], top: int = 5) -> str:
+    records = load_records(paths)
+    if not records:
+        return "no query-log records found"
+    by_query: Dict[str, List[dict]] = {}
+    order: List[str] = []
+    for rec in records:
+        qid = str(rec.get("queryId"))
+        if qid not in by_query:
+            order.append(qid)
+        by_query.setdefault(qid, []).append(rec)
+    return "\n\n".join(digest(q, by_query[q], top=top) for q in order)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render query-log JSONL into per-query digests")
+    ap.add_argument("paths", nargs="+", help="query_log-*.jsonl files")
+    ap.add_argument("--top", type=int, default=5,
+                    help="operators per query in the time ranking")
+    args = ap.parse_args(argv)
+    print(render(args.paths, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
