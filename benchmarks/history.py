@@ -77,11 +77,27 @@ WARM_TRAFFIC_Q6_S = "warm_traffic_q6_s"
 #: (identical rows, >=1 stage retry, every armed fault fired).
 CHAOS_Q6_RECOVERY_S = "chaos_q6_recovery_s"
 
+#: traffic-replay series stamped by benchmarks/replay.py (ISSUE 15,
+#: docs/service.md §7): REPLAY_QPS is completed queries per second of N
+#: concurrent mixed-tenant TPC-H streams through ONE engine under
+#: lockdep=enforce (higher is better); REPLAY_P50_S / REPLAY_P99_S are
+#: the submit->result latency percentiles of that traffic (lower is
+#: better) — the first p99-under-concurrent-load numbers the north star
+#: asks for. REPLAY_CHAOS_P99_S is the same p99 with the chaos harness
+#: armed (--faults), stamped only when results matched the fault-free
+#: oracle and every armed fault fired.
+REPLAY_QPS = "replay_qps"
+REPLAY_P50_S = "replay_p50_s"
+REPLAY_P99_S = "replay_p99_s"
+REPLAY_CHAOS_P99_S = "replay_chaos_p99_s"
+
 #: queries whose direction flips relative to their round's
 #: ``higherIsBetter`` flag (seconds-valued series riding a throughput
 #: round): recorded per entry so old history lines stay judgeable
 INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP,
-                              WARM_TRAFFIC_Q6_S, CHAOS_Q6_RECOVERY_S})
+                              WARM_TRAFFIC_Q6_S, CHAOS_Q6_RECOVERY_S,
+                              REPLAY_P50_S, REPLAY_P99_S,
+                              REPLAY_CHAOS_P99_S})
 
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)

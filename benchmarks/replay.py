@@ -1,0 +1,312 @@
+"""Traffic-replay benchmark: N concurrent TPC-H streams, one engine.
+
+The bench suite so far measured queries ONE AT A TIME — the "heavy
+traffic from millions of users" scenario (ROADMAP item 4) was invisible:
+no number said what p99 latency or queries/second this engine sustains
+when concurrent tenants hammer shared TPU state. This module is that
+measurement:
+
+* ``streams`` worker streams (the TPC-H throughput-test shape) submit
+  TPC-H-shaped queries to ONE :class:`QueryService` over ONE session,
+  alternating between a high-priority ``gold`` tenant and a
+  low-priority ``bronze`` tenant (mixed-tenant traffic);
+* parameters ROTATE through prepared statements (the PR 12 serving
+  front door): every stream re-executes the same plan with different
+  literal windows, so the replay measures the serving hot path, not
+  repeated planning;
+* the whole replay runs under ``lockdep=enforce`` — a lock-order
+  inversion anywhere in the concurrent engine fails the bench loudly;
+* ``faults`` arms the chaos harness (PR 13) during the replay: results
+  must still match the fault-free oracle and recovery must be absorbed
+  by stage retries under concurrent load.
+
+Artifact series (benchmarks/history.py, kind ``replay``):
+``replay_qps`` (higher better), ``replay_p50_s`` / ``replay_p99_s``
+(submit->result latency percentiles, lower better), and
+``replay_chaos_p99_s`` for the chaos mode. Stamped only when every
+query returned oracle-correct rows (and, under chaos, every armed fault
+fired) — a wrong-answer replay is void, not fast.
+
+CLI::
+
+    python -m benchmarks.replay --sf 0.002 --streams 4 --iters 6
+    python -m benchmarks.replay --faults "fetch.fail;task.poison"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: the default chaos spec for ``--faults default`` (one failed fetch +
+#: one poisoned map batch, the bench_chaos pair, absorbed by stage retry)
+DEFAULT_FAULTS = "fetch.fail;task.poison"
+
+
+def _rows_close(a, b, rel_tol=1e-9) -> bool:
+    """Row-wise equality with fp tolerance (the bench.py rule: retries
+    and concurrent scheduling legally reorder float aggregation)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if not math.isclose(va, vb, rel_tol=rel_tol,
+                                    abs_tol=1e-12):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted latency list."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, math.ceil(q * len(sorted_vals)) - 1)
+    return sorted_vals[idx]
+
+
+def _window(i: int):
+    """Rotating one-year date window (epoch days), 24 phases."""
+    import datetime
+    lo = datetime.date(1993, 1, 1) + datetime.timedelta(days=30 * (i % 24))
+    return lo, lo + datetime.timedelta(days=365)
+
+
+#: the replay's prepared-statement shapes (SQL with :name placeholders
+#: bound per iteration). q6-shaped: tight filter + global sum; q1-shaped:
+#: filter + grouped wide aggregate. Both read the lineitem view.
+_Q6_SQL = ("SELECT sum(l_extendedprice * l_discount) AS revenue "
+           "FROM replay_lineitem "
+           "WHERE l_shipdate >= :lo AND l_shipdate < :hi "
+           "AND l_discount >= 0.05 AND l_discount <= 0.07 "
+           "AND l_quantity < 24")
+_Q1_SQL = ("SELECT l_returnflag, sum(l_quantity) AS sum_qty, "
+           "avg(l_extendedprice) AS avg_price, count(*) AS cnt "
+           "FROM replay_lineitem WHERE l_shipdate < :hi "
+           "GROUP BY l_returnflag ORDER BY l_returnflag")
+
+
+def _build_session(faults: Optional[str], extra_conf: Optional[dict]):
+    from spark_rapids_tpu.api.session import TpuSession
+    conf = {
+        "spark.rapids.tpu.sql.explain": "NONE",
+        # the whole replay runs under ENFORCE: any lock-order inversion
+        # in the concurrent engine raises instead of logging
+        "spark.rapids.tpu.sql.analysis.lockdep": "enforce",
+        "spark.rapids.tpu.sql.shuffle.partitions": "4",
+    }
+    if faults:
+        # chaos injection points live on the DCN map/fetch paths
+        conf["spark.rapids.tpu.sql.shuffle.plane"] = "dcn"
+        conf["spark.rapids.tpu.sql.recovery.retryBackoff"] = "0.0"
+    conf.update(extra_conf or {})
+    return TpuSession.builder.config(conf).getOrCreate()
+
+
+def run_replay(sf: float = 0.002, streams: int = 4,
+               queries_per_stream: int = 6,
+               faults: Optional[str] = None,
+               stamp: bool = True,
+               history_path: Optional[str] = None,
+               extra_conf: Optional[dict] = None) -> Dict:
+    """Drive the replay and return the artifact dict (see module doc).
+    ``faults`` arms the chaos harness for the traffic window (results
+    still must match the fault-free oracle)."""
+    import jax
+    from benchmarks import datagen
+    from benchmarks import queries as Q
+    from spark_rapids_tpu.analysis import faults as faults_mod
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.service.server import QueryService, TenantSpec
+    from spark_rapids_tpu.service.telemetry import MetricsRegistry
+
+    session = _build_session(faults, extra_conf)
+    tables = datagen.register_tables(session, sf)
+    tables["lineitem"].createOrReplaceTempView("replay_lineitem")
+
+    # chaos traffic must traverse a DCN exchange (the injection points):
+    # a q6-shaped aggregate over a hash-repartitioned lineitem
+    shuffled = dict(tables)
+    shuffled["lineitem"] = tables["lineitem"].repartition(
+        4, col("l_orderkey"))
+
+    def make_query(stream: int, i: int):
+        """(kind, execute-thunk-args) for stream position i."""
+        if faults:
+            return ("shuffle_q6", None)
+        return ("q6", _window(stream + i)) if (stream + i) % 2 == 0 \
+            else ("q1", _window(stream + i))
+
+    # ---- fault-free oracle: every (kind, params) executed DIRECTLY once
+    oracle: Dict[tuple, list] = {}
+    for s in range(streams):
+        for i in range(queries_per_stream):
+            kind, win = make_query(s, i)
+            key = (kind, win)
+            if key in oracle:
+                continue
+            if kind == "shuffle_q6":
+                oracle[key] = Q.QUERIES["q6"](shuffled).collect()
+            else:
+                stmt = session.prepare(_Q6_SQL if kind == "q6"
+                                       else _Q1_SQL)
+                params = {"lo": win[0], "hi": win[1]} if kind == "q6" \
+                    else {"hi": win[1]}
+                oracle[key] = stmt.execute(**params).rows()
+
+    def retries_total() -> float:
+        try:
+            return float(MetricsRegistry.get().counter(
+                "tpu_stage_retries_total", "x").value)
+        except Exception:
+            return 0.0
+
+    svc = QueryService(session, tenants=[
+        TenantSpec("gold", priority=10, slots=max(1, streams // 2),
+                   memory_budget_bytes=1 << 30),
+        TenantSpec("bronze", priority=0, slots=max(1, streams // 2),
+                   memory_budget_bytes=256 << 20)])
+
+    latencies: List[float] = []
+    wrong: List[str] = []
+    errors: List[str] = []
+    lat_mu = threading.Lock()  # lint: raw-lock-ok bench-local result list, dies with the run
+
+    def stream_body(s: int) -> None:
+        # one PreparedStatement per shape PER STREAM: a statement binds
+        # in place, so it must never have two in-flight executes
+        stmts = {"q6": session.prepare(_Q6_SQL),
+                 "q1": session.prepare(_Q1_SQL)}
+        tenant = "gold" if s % 2 == 0 else "bronze"
+        for i in range(queries_per_stream):
+            kind, win = make_query(s, i)
+            if kind == "shuffle_q6":
+                ticket = svc.submit(
+                    tenant, Q.QUERIES["q6"](shuffled),
+                    label=f"s{s}-{i}-{kind}")
+            else:
+                params = {"lo": win[0], "hi": win[1]} if kind == "q6" \
+                    else {"hi": win[1]}
+                ticket = svc.submit(tenant, stmts[kind], params=params,
+                                    label=f"s{s}-{i}-{kind}")
+            try:
+                rows = ticket.result(timeout=600).rows()
+            except Exception as e:
+                with lat_mu:
+                    errors.append(f"s{s}-{i}-{kind}: "
+                                  f"{type(e).__name__}: {e}"[:200])
+                continue
+            ok = _rows_close(rows, oracle[(kind, win)])
+            with lat_mu:
+                latencies.append(ticket.latency_s())
+                if not ok:
+                    wrong.append(f"s{s}-{i}-{kind}")
+
+    retries0 = retries_total()
+    armed = 0
+    try:
+        if faults:
+            armed = faults_mod.install(faults)
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=stream_body, args=(s,),
+                                    name=f"replay-stream-{s}")
+                   for s in range(streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        fired = faults_mod.fired_total() if faults else 0
+    finally:
+        if faults:
+            faults_mod.reset()         # never leak chaos downstream
+        svc.close()
+    stage_retries = retries_total() - retries0
+
+    total = streams * queries_per_stream
+    latencies.sort()
+    qps = len(latencies) / wall if wall > 0 else 0.0
+    p50 = _percentile(latencies, 0.50)
+    p99 = _percentile(latencies, 0.99)
+    ok = (not wrong and not errors and len(latencies) == total and
+          (not faults or (fired >= armed and stage_retries >= 1)))
+    line: Dict = {
+        "metric": "traffic replay",
+        "backend": jax.devices()[0].platform,
+        "sf": sf,
+        "streams": streams,
+        "queries": total,
+        "completed": len(latencies),
+        "wall_s": round(wall, 4),
+        "replay_qps": round(qps, 3),
+        "replay_p50_s": round(p50, 4),
+        "replay_p99_s": round(p99, 4),
+        "faults_spec": faults or "",
+        "faults_fired": int(fired),
+        "stage_retries": int(stage_retries),
+        "replay_ok": ok,
+        "service": svc.stats(),
+    }
+    if wrong:
+        line["wrong_results"] = wrong[:10]
+    if errors:
+        line["errors"] = errors[:10]
+    if faults:
+        line["replay_chaos_p99_s"] = round(p99, 4)
+
+    if stamp and ok:
+        # the regression gate (benchmarks/history.py): replay latency
+        # and throughput ride the same verdict machinery as every bench
+        from benchmarks import history as bh
+        if faults:
+            queries = {bh.REPLAY_CHAOS_P99_S: line["replay_chaos_p99_s"]}
+        else:
+            queries = {bh.REPLAY_QPS: line["replay_qps"],
+                       bh.REPLAY_P50_S: line["replay_p50_s"],
+                       bh.REPLAY_P99_S: line["replay_p99_s"]}
+        gate = bh.stamp("replay", queries, backend=line["backend"],
+                        higher_is_better=True,
+                        meta={"sf": sf, "streams": streams,
+                              "faults": faults or ""},
+                        path=history_path)
+        line["regression"] = {q: v.get("verdict")
+                              for q, v in gate["verdicts"].items()}
+        line["regression_overall"] = gate["overall"]
+    return line
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="concurrent mixed-tenant TPC-H traffic replay "
+                    "through the multi-tenant query service")
+    ap.add_argument("--sf", type=float, default=0.002,
+                    help="TPC-H scale factor of the generated tables")
+    ap.add_argument("--streams", type=int, default=4,
+                    help="concurrent submission streams")
+    ap.add_argument("--iters", type=int, default=6,
+                    help="queries per stream")
+    ap.add_argument("--faults", default=None,
+                    help="chaos spec for the replay window ('default' = "
+                         f"{DEFAULT_FAULTS!r})")
+    ap.add_argument("--no-stamp", action="store_true",
+                    help="skip the bench-history regression stamp")
+    args = ap.parse_args(argv)
+    faults = DEFAULT_FAULTS if args.faults == "default" else args.faults
+    line = run_replay(sf=args.sf, streams=args.streams,
+                      queries_per_stream=args.iters, faults=faults,
+                      stamp=not args.no_stamp)
+    print(json.dumps(line, default=str))
+    return 0 if line.get("replay_ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

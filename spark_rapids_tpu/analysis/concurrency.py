@@ -72,7 +72,10 @@ from typing import Dict, List, Optional, Set, Tuple
 from .lint import LintViolation
 
 SCOPE_PREFIXES = ("exec/", "shuffle/", "analysis/")
-SCOPE_FILES = ("config.py", "api/session.py")
+SCOPE_FILES = ("config.py", "api/session.py",
+               # the multi-tenant service is thread-reachable by
+               # construction (worker pool + cross-thread submit)
+               "service/server.py", "service/tenants.py")
 # the instrumentation layer's own internals cannot be self-instrumented
 RAW_LOCK_EXEMPT = ("analysis/lockdep.py",)
 

@@ -282,6 +282,10 @@ class DataFrame:
         self.session._last_plan_time_s = time.perf_counter() - t0
         self.session._last_exec_plan = exec_plan
         self.session._last_serving = serving
+        # the session attr is an observability surface that concurrent
+        # service workers clobber; the execution pipeline reads THIS
+        # thread's serving info (collect_batch, the prepared capture)
+        pc.note_thread_serving(serving)
         # result-cache key read NOW (snapshot = current table tokens /
         # file stats) so the collect can short-circuit or store
         serving["resultKey"] = pc.result_key(self.session, serving, plan)
@@ -325,16 +329,31 @@ class DataFrame:
         return self
 
     def collect_batch(self):
-        exec_plan = self._execute()
         from ..plan import plan_cache as pc
-        serving = getattr(self.session, "_last_serving", None) or {}
-        hit = pc.serve_result_hit(self.session, serving)
-        if hit is not None:
-            # exact-repeat short circuit: no execution at all — the
-            # stored HOST batch serves (no spans/metrics/listeners
-            # for this collect; EXPLAIN ANALYZE marks the hit)
-            return hit
-        return self._collect_planned(exec_plan, serving)
+        try:
+            exec_plan = self._execute()
+        except BaseException:
+            # plan_for may have CLAIMED a cache entry before a later
+            # step of _execute raised (result-key snapshot, baseline):
+            # release it or the entry reads busy forever. A stale
+            # serving dict from a previous query is harmless — its
+            # planEntry was already popped by that query's release.
+            pc.release_plan_entry(pc.thread_serving())
+            raise
+        serving = pc.thread_serving() or {}
+        try:
+            hit = pc.serve_result_hit(self.session, serving)
+            if hit is not None:
+                # exact-repeat short circuit: no execution at all — the
+                # stored HOST batch serves (no spans/metrics/listeners
+                # for this collect; EXPLAIN ANALYZE marks the hit)
+                return hit
+            return self._collect_planned(exec_plan, serving)
+        finally:
+            # the exec tree claimed from the plan cache is free for the
+            # next execution (concurrent collects on a busy entry plan
+            # fresh trees, plan_cache.PlanEntry.try_begin_execution)
+            pc.release_plan_entry(serving)
 
     def _collect_planned(self, exec_plan, serving):
         import time
@@ -355,10 +374,16 @@ class DataFrame:
         # so distributed workers running the same query mint the same id
         qid = qc.mint_query_id(exec_plan)
         self.session._last_query_id = qid
+        qc.note_thread_query_id(qid)
+        # the context picks up the ambient tenant hint (the service's
+        # tenant_scope on this thread); captured here so the query-log
+        # record and session surface carry it after the scope closes
+        ctx = qc.QueryContext(qid)
+        self.session._last_tenant = ctx.tenant
         from ..analysis import faults as _faults
         faults0 = _faults.fired_total()
         t0 = time.perf_counter()
-        with qc.query_scope(qc.QueryContext(qid)):
+        with qc.query_scope(ctx):
             try:
                 with SyncCounter() as sc, SpanRecorder() as spans:
                     spans.query_id = qid
@@ -408,7 +433,7 @@ class DataFrame:
             # Best-effort — the log must never fail the query.
             from ..service import query_log
             query_log.maybe_log(self.session, exec_plan, serving, qid,
-                                faults_before=faults0)
+                                faults_before=faults0, tenant=ctx.tenant)
         except Exception:
             pass
         return out
@@ -649,7 +674,14 @@ class DataFrameWriter:
         plan = lp.WriteFile(self.df._plan, fmt, path, self._mode,
                             self._options, self._partition_by)
         df = self.df._df(plan)
-        exec_plan = df._execute()
-        for part in exec_plan.execute():
-            for _ in part:
-                pass
+        from ..plan import plan_cache as pc
+        try:
+            exec_plan = df._execute()
+            for part in exec_plan.execute():
+                for _ in part:
+                    pass
+        finally:
+            # writes plan uncacheable today (fingerprint None) so this
+            # is a no-op, but the release hook keeps every _execute()
+            # caller symmetric if that ever changes
+            pc.release_plan_entry(pc.thread_serving())

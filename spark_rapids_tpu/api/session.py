@@ -17,6 +17,11 @@ from ..plan import logical as lp
 from .dataframe import DataFrame
 
 
+# guards every session's SQL-text parse cache (leaf: only dict ops run
+# under it; concurrent service workers hit sql() from pool threads)
+_parse_cache_mu = named_lock("api.session._parse_cache_mu")
+
+
 class TpuSessionBuilder:
     def __init__(self):
         self._conf: Dict[str, Any] = {}
@@ -75,9 +80,11 @@ class RuntimeConf:
             faults.refresh(self._session.conf)
         # ANY conf change drops the session's serving caches: cached
         # plans were analyzed/optimized/validated under the old conf, and
-        # a stored result may have been produced by it
+        # a stored result may have been produced by it (the parse cache
+        # is conf-independent, but dropping it keeps one rule)
         self._session._plan_cache = None
         self._session._result_cache = None
+        self._session._sql_parse_cache = None
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._session.conf.get_key(key, default)
@@ -303,8 +310,63 @@ class TpuSession:
     def sql(self, query: str) -> DataFrame:
         from ..plan import plan_cache as pc
         from .sql import parse_sql
-        pc.serving_stats(self)["parses"] += 1
-        return parse_sql(query, self)
+        st = pc.serving_stats(self)
+        plan = self._parse_cache_get(query)
+        if plan is not None:
+            # SQL-text parse cache hit (docs/plan_cache.md §parse): the
+            # lexer/parser is skipped entirely; the plan-cache
+            # fingerprint downstream still decides plan reuse
+            st["parseCacheHits"] += 1
+            return DataFrame(plan, self)
+        if int(self.conf.get(cfg.PARSE_CACHE_MAX_ENTRIES)) > 0:
+            st["parseCacheMisses"] += 1
+        st["parses"] += 1
+        df = parse_sql(query, self)
+        self._parse_cache_put(query, df.logical_plan())
+        return df
+
+    # -- SQL-text -> parsed-plan cache (PR 12 follow-up: the layer AHEAD
+    # of the plan-cache fingerprint for non-prepared sql() traffic) ------
+    def _parse_cache_views_sig(self) -> tuple:
+        """Identity snapshot of the session catalog: a parsed plan embeds
+        references to the view plan OBJECTS it resolved, so a hit is
+        only legal while every registered view is still the same object
+        (re-registering a temp view invalidates naturally)."""
+        return tuple(sorted((n, id(p)) for n, p in self._views.items()))
+
+    def _parse_cache(self):
+        cache = getattr(self, "_sql_parse_cache", None)
+        if cache is None:
+            from collections import OrderedDict
+            cache = self._sql_parse_cache = OrderedDict()  # lint: unguarded-ok every caller holds _parse_cache_mu (module-level helper lock, not the session class lock)
+        return cache
+
+    def _parse_cache_get(self, query: str):
+        max_entries = int(self.conf.get(cfg.PARSE_CACHE_MAX_ENTRIES))
+        if max_entries <= 0:
+            return None
+        with _parse_cache_mu:
+            cache = self._parse_cache()
+            hit = cache.get(query)
+            if hit is None:
+                return None
+            views_sig, plan = hit
+            if views_sig != self._parse_cache_views_sig():
+                del cache[query]     # a referenced view was re-registered
+                return None
+            cache.move_to_end(query)
+            return plan
+
+    def _parse_cache_put(self, query: str, plan) -> None:
+        max_entries = int(self.conf.get(cfg.PARSE_CACHE_MAX_ENTRIES))
+        if max_entries <= 0:
+            return
+        with _parse_cache_mu:
+            cache = self._parse_cache()
+            cache[query] = (self._parse_cache_views_sig(), plan)
+            cache.move_to_end(query)
+            while len(cache) > max_entries:
+                cache.popitem(last=False)
 
     def prepare(self, query: Union[str, DataFrame]) -> "PreparedStatement":
         """Prepared-statement API (the serving front door,

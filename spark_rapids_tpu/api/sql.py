@@ -42,6 +42,7 @@ from typing import List, Optional
 
 from . import functions as F
 from .column import Col, _unwrap
+from ..analysis.lockdep import named_lock
 from ..ops import expressions as ex
 from ..ops import predicates as pr
 from ..plan import logical as lp
@@ -1237,7 +1238,11 @@ class PreparedStatement:
     # -- the plan-once / execute-many fast path -----------------------------
     def _capture_fast(self) -> None:
         from ..plan import plan_cache as pc
-        serving = getattr(self.session, "_last_serving", None)
+        # THIS thread's serving info, never the session attr: concurrent
+        # service workers clobber session._last_serving, and capturing
+        # another query's fingerprint here would bind this statement's
+        # parameters into a foreign plan (docs/service.md §5)
+        serving = pc.thread_serving()
         if not serving or not serving.get("cacheable"):
             return
         cache, _rc = pc.session_caches(self.session)
@@ -1277,6 +1282,12 @@ class PreparedStatement:
         if entry is None:
             self._fast = None
             return None
+        # claim the tree before binding (the service's concurrent
+        # executes share one statement's cache entry): busy -> the full
+        # path plans a fresh tree for this execution
+        if not entry.try_begin_execution():
+            pc.serving_stats(self.session)["planBusy"] += 1
+            return None
         values = list(template)
         for name, slots in named_slots.items():
             for slot in slots:
@@ -1285,64 +1296,83 @@ class PreparedStatement:
             revalidated, violations = entry.bind(values)
         except Exception:
             # tainted entry: drop it so a clean retry replans
+            entry.end_execution()
             cache.discard(fingerprint)
             self._fast = None
             raise
         if revalidated and violations:
+            entry.end_execution()
             cache.discard(fingerprint)
             self._fast = None
             return None
-        entry.reset_metrics()
-        sess = self.session
-        st = pc.serving_stats(sess)
-        st["planHits"] += 1
-        pc._inc("tpu_plan_cache_hits_total",
-                "parameterized-plan cache hits (analyze/optimize/"
-                "validate/stage-compile skipped)")
         serving = {
             "planCache": "hit", "resultCache": "off",
             "params": len(values), "fingerprint": fingerprint,
             "values": tuple(values), "snapshot": None,
             "cacheable": True, "revalidated": revalidated,
-            "prepared": True,
+            "prepared": True, "planEntry": entry,
         }
-        sess._last_plan_time_s = 0.0
-        sess._last_exec_plan = entry.exec_plan
-        sess._last_overrides = pc._CachedOverrides(entry.overrides,
-                                                   violations)
-        sess._last_serving = serving
-        cat = BufferCatalog.get()
-        sess._mem_baseline = (cat.spilled_device_bytes,
-                              cat.spilled_host_bytes)
-        serving["resultKey"] = pc.result_key(sess, serving,
-                                             entry.logical_plan)
-        hit = pc.serve_result_hit(sess, serving)
-        if hit is not None:
-            return hit
-        return self._df._collect_planned(entry.exec_plan, serving)
+        # from here the claim is released through the serving dict —
+        # every exit (incl. reset_metrics/baseline raising) runs the
+        # release, or the entry would read busy forever
+        try:
+            entry.reset_metrics()
+            sess = self.session
+            st = pc.serving_stats(sess)
+            st["planHits"] += 1
+            pc._inc("tpu_plan_cache_hits_total",
+                    "parameterized-plan cache hits (analyze/optimize/"
+                    "validate/stage-compile skipped)")
+            sess._last_plan_time_s = 0.0
+            sess._last_exec_plan = entry.exec_plan
+            sess._last_overrides = pc._CachedOverrides(entry.overrides,
+                                                       violations)
+            sess._last_serving = serving
+            cat = BufferCatalog.get()
+            sess._mem_baseline = (cat.spilled_device_bytes,
+                                  cat.spilled_host_bytes)
+            serving["resultKey"] = pc.result_key(sess, serving,
+                                                 entry.logical_plan)
+            hit = pc.serve_result_hit(sess, serving)
+            if hit is not None:
+                return hit
+            return self._df._collect_planned(entry.exec_plan, serving)
+        finally:
+            pc.release_plan_entry(serving)
+
+
+#: serializes parses that mutate the session catalog: CTE registration
+#: writes query-scoped temp views into the SHARED ``session._views`` and
+#: restores it afterwards — two concurrent service workers interleaving
+#: that save/mutate/restore would leak one parse's CTEs into the session
+#: (or delete the other's mid-parse), so the whole parse runs under one
+#: leaf lock (parsing takes no engine locks; docs/service.md §5)
+_parse_views_mu = named_lock("api.sql._parse_views_mu")
 
 
 def parse_sql(query: str, session):
     p = _Parser(_lex(query), session)
-    saved_views = dict(session._views)
-    try:
-        first = True
-        while p.at_kw("WITH") or (not first and p.take_op(",")):
-            # WITH name AS (SELECT ...) [, name2 AS (SELECT ...)]...
-            # registered as query-scoped temp views (Catalyst CTEs);
-            # the session catalog is restored after the parse
-            if p.at_kw("WITH"):
-                p.next()
-            name = p.next().text
-            p.expect_kw("AS")
-            p.expect_op("(")
-            sub = p.parse_select()
-            p.expect_op(")")
-            sub.createOrReplaceTempView(name)
-            first = False
-        df = p.parse_select()
-        if p.peek().kind != "end":
-            raise SqlParseError(f"trailing input near {p.peek().text!r}")
-        return df
-    finally:
-        session._views = saved_views
+    with _parse_views_mu:
+        saved_views = dict(session._views)
+        try:
+            first = True
+            while p.at_kw("WITH") or (not first and p.take_op(",")):
+                # WITH name AS (SELECT ...) [, name2 AS (SELECT ...)]...
+                # registered as query-scoped temp views (Catalyst CTEs);
+                # the session catalog is restored after the parse
+                if p.at_kw("WITH"):
+                    p.next()
+                name = p.next().text
+                p.expect_kw("AS")
+                p.expect_op("(")
+                sub = p.parse_select()
+                p.expect_op(")")
+                sub.createOrReplaceTempView(name)
+                first = False
+            df = p.parse_select()
+            if p.peek().kind != "end":
+                raise SqlParseError(
+                    f"trailing input near {p.peek().text!r}")
+            return df
+        finally:
+            session._views = saved_views

@@ -673,6 +673,51 @@ TELEMETRY_QUERY_LOG_DIR = _conf(
     "tools.query_report). Empty disables the log"
 ).string_conf.create_with_default("")
 
+SERVICE_MAX_CONCURRENT = _conf(
+    "spark.rapids.tpu.sql.service.maxConcurrentQueries").doc(
+    "Worker threads of the multi-tenant query service "
+    "(service/server.QueryService): the number of admitted queries "
+    "executing concurrently against the shared engine. Layered ABOVE "
+    "concurrentTpuTasks — the TpuSemaphore still bounds how many of "
+    "those queries' tasks hold the device at once (docs/service.md)"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(4)
+
+SERVICE_DEFAULT_SLOTS = _conf(
+    "spark.rapids.tpu.sql.service.defaultTenantSlots").doc(
+    "Concurrent queries ONE tenant may occupy in the service pool when "
+    "its TenantSpec does not set slots explicitly (the per-tenant "
+    "concurrency bound of docs/service.md §2)"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(2)
+
+SERVICE_DEFAULT_QUEUE_DEPTH = _conf(
+    "spark.rapids.tpu.sql.service.defaultTenantQueueDepth").doc(
+    "Queued (not yet running) queries one tenant may hold before the "
+    "service load-sheds further submissions with a typed "
+    "AdmissionRejected (default for TenantSpecs without an explicit "
+    "max_queue_depth; docs/service.md §2)"
+).integer_conf.check(lambda v: int(v) >= 1).create_with_default(16)
+
+SERVICE_DEFAULT_MEMORY_BYTES = _conf(
+    "spark.rapids.tpu.sql.service.defaultTenantMemoryBytes").doc(
+    "Default per-tenant device-byte budget installed at tenant "
+    "registration when the TenantSpec does not set one: a tenant "
+    "holding more device bytes than its budget spills its OWN buffers "
+    "first at reserve/register boundaries, and its buffers are the "
+    "global cascade's first victims (docs/service.md §3). 0 = "
+    "unbudgeted"
+).bytes_conf.create_with_default(0)
+
+PARSE_CACHE_MAX_ENTRIES = _conf(
+    "spark.rapids.tpu.sql.service.parseCache.maxEntries").doc(
+    "LRU bound on the per-session SQL-text -> parsed-plan cache serving "
+    "non-prepared session.sql() traffic ahead of the plan-cache "
+    "fingerprint (docs/plan_cache.md): a repeated SQL string skips the "
+    "lexer/parser entirely; hits/misses ride serving_stats() as "
+    "parseCacheHits/parseCacheMisses. Entries key on the view identity "
+    "snapshot, so re-registering a temp view invalidates naturally. "
+    "0 disables"
+).integer_conf.check(lambda v: int(v) >= 0).create_with_default(256)
+
 OBSERVABILITY_DRIFT_THRESHOLD = _conf(
     "spark.rapids.tpu.sql.observability.driftThreshold").doc(
     "Estimate-vs-actual row drift ratio at which a plan node is flagged "

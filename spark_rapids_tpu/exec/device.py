@@ -103,6 +103,10 @@ class TpuSemaphore:
         self.wait_s = 0.0
         self.hold_s = 0.0
         self.acquires = 0
+        # threads currently BLOCKED in acquire: the live device-admission
+        # queue depth (the multi-tenant service's dashboard shows it next
+        # to its own per-tenant queue depth, docs/service.md §1)
+        self.waiting = 0
 
     @classmethod
     def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
@@ -131,11 +135,13 @@ class TpuSemaphore:
             cls._instance = None
 
     def stats(self) -> dict:
-        """Cumulative wait/hold seconds + acquire count (bench harness)."""
+        """Cumulative wait/hold seconds + acquire count + live blocked
+        count (bench harness, the service dashboard)."""
         with self._stats_mu:
             return {"waitS": round(self.wait_s, 4),
                     "holdS": round(self.hold_s, 4),
-                    "acquires": self.acquires}
+                    "acquires": self.acquires,
+                    "waiting": self.waiting}
 
     def acquire_if_necessary(self) -> None:
         """Idempotent per-thread acquire (GpuSemaphore.acquireIfNecessary)."""
@@ -144,7 +150,13 @@ class TpuSemaphore:
         if getattr(self._held, "value", False):
             return
         t0 = time.perf_counter()
-        self._sem.acquire()
+        with self._stats_mu:
+            self.waiting += 1
+        try:
+            self._sem.acquire()
+        finally:
+            with self._stats_mu:
+                self.waiting -= 1
         now = time.perf_counter()
         waited = now - t0
         self._held.value = True

@@ -71,11 +71,19 @@ def mint_query_id(plan=None) -> str:
 
 
 class QueryContext:
-    """One query execution's identity: the query id plus the stage-id
-    counter exchanges draw from at their boundaries."""
+    """One query execution's identity: the query id, the TENANT the
+    query runs on behalf of (the multi-tenant service's isolation unit,
+    service/server.py — None for direct caller-owned sessions), plus the
+    stage-id counter exchanges draw from at their boundaries."""
 
-    def __init__(self, query_id: str):
+    def __init__(self, query_id: str, tenant: Optional[str] = None):
         self.query_id = query_id
+        # the tenant hint is installed by service/tenants.tenant_scope on
+        # the SUBMITTING thread before the collect mints this context, so
+        # buffer-catalog accounting, flight events and the query log all
+        # attribute to the tenant without any API change at collect sites
+        self.tenant = tenant if tenant is not None else \
+            getattr(_tls, "tenant", None)
         self._stage_seq = itertools.count(1)
 
     def next_stage_id(self) -> int:
@@ -111,6 +119,51 @@ def current() -> Optional[QueryContext]:
 def current_query_id() -> Optional[str]:
     ctx = current()
     return ctx.query_id if ctx is not None else None
+
+
+def note_thread_query_id(qid: Optional[str]) -> None:
+    """Record the query id THIS thread last executed (set at collect,
+    cleared by the service before each thunk): the per-ticket id surface
+    — ``session._last_query_id`` is last-writer-wins across concurrent
+    workers and must not be joined to a specific execution."""
+    _tls.last_query_id = qid  # lint: unguarded-ok executing thread's own TLS field
+
+
+def thread_last_query_id() -> Optional[str]:
+    return getattr(_tls, "last_query_id", None)
+
+
+def current_tenant() -> Optional[str]:
+    """The tenant the CURRENT work runs on behalf of: the active query
+    context's tenant when one exists (pool worker threads inherit it via
+    :class:`thread_scope`), otherwise the thread's tenant hint (the
+    service worker thread between submit and collect). None outside any
+    tenant scope — single-tenant direct sessions stay untagged."""
+    ctx = current()
+    if ctx is not None and ctx.tenant is not None:
+        return ctx.tenant
+    return getattr(_tls, "tenant", None)
+
+
+class tenant_scope:
+    """TLS tenant hint for THIS thread: every query minted while the
+    scope is open attributes to ``tenant`` (``None`` is a no-op). The
+    multi-tenant service wraps each admitted query's execution in this;
+    nests (the inner scope wins, restored on exit)."""
+
+    def __init__(self, tenant: Optional[str]):
+        self.tenant = tenant
+
+    def __enter__(self) -> Optional[str]:
+        if self.tenant is not None:
+            self._prev = getattr(_tls, "tenant", None)  # lint: unguarded-ok worker thread's own TLS field
+            _tls.tenant = self.tenant
+        return self.tenant
+
+    def __exit__(self, *exc) -> bool:
+        if self.tenant is not None:
+            _tls.tenant = self._prev
+        return False
 
 
 class thread_scope:

@@ -115,10 +115,17 @@ class SpillableBuffer:
     def __init__(self, buffer_id: int, meta: BufferMeta, priority: float,
                  device_arrays: Optional[List[Any]] = None,
                  col_dtypes: Optional[List[dt.DType]] = None,
-                 obj_cols: Optional[Dict[int, Column]] = None):
+                 obj_cols: Optional[Dict[int, Column]] = None,
+                 tenant: Optional[str] = None):
         self.id = buffer_id
         self.meta = meta
         self.priority = priority
+        # the tenant whose query registered this buffer (service
+        # multi-tenancy, docs/service.md): device residency is accounted
+        # per tenant and an over-budget tenant's buffers are the spill
+        # cascade's first victims. None = untenanted (direct sessions,
+        # shared cache entries)
+        self.tenant = tenant
         self.tier = StorageTier.DEVICE
         self.col_dtypes = col_dtypes or []
         self._device_arrays = device_arrays        # list of jax arrays
@@ -337,6 +344,11 @@ class BufferCatalog:
         self.host_bytes = 0
         self.spilled_device_bytes = 0     # metrics: total spilled (task metrics analog)
         self.spilled_host_bytes = 0
+        # per-tenant DEVICE residency (service multi-tenancy): bytes held
+        # on device by each tenant's buffers, maintained at the same
+        # accounting boundaries as device_bytes; entries drop at 0 so an
+        # idle tenant's watermark reads exactly zero
+        self.tenant_device: Dict[str, int] = {}
         self._mu = named_rlock("exec.spill.BufferCatalog._mu")
 
     @classmethod
@@ -387,6 +399,27 @@ class BufferCatalog:
         with self._mu:
             return len(self.buffers)
 
+    # -- per-tenant residency (service multi-tenancy, docs/service.md) ------
+    def _tenant_device_delta_locked(self, buf: "SpillableBuffer",
+                                    delta: int) -> None:
+        """Account ``delta`` device bytes to the buffer's tenant (caller
+        holds ``self._mu``; untenanted buffers are a no-op). Entries
+        drop at <= 0 so per-tenant watermarks return to exactly 0."""
+        t = buf.tenant
+        if t is None or not delta:
+            return
+        cur = self.tenant_device.get(t, 0) + delta
+        if cur > 0:
+            self.tenant_device[t] = cur
+        else:
+            self.tenant_device.pop(t, None)
+
+    def tenant_device_bytes(self) -> Dict[str, int]:
+        """Device bytes currently held per tenant (the
+        ``tpu_tenant_device_bytes`` telemetry gauge's source)."""
+        with self._mu:
+            return dict(self.tenant_device)
+
     def _note_residency(self) -> None:
         """Update the process HBM/host watermarks after an accounting
         change (service/telemetry): current + peak bytes with
@@ -411,14 +444,30 @@ class BufferCatalog:
                 continue
             arrays.extend(c.arrays())
             col_dtypes.append(c.dtype)
+        # tenant attribution (service multi-tenancy): the ambient query
+        # context's tenant owns this buffer's residency. CACHE_PRIORITY
+        # registrations (scan device cache, df.cache()) stay UNTENANTED —
+        # cached tables are shared infrastructure served to every tenant,
+        # and charging them to whichever tenant scanned first would leave
+        # that tenant's watermark pinned above zero forever
+        tenant = None
+        if priority != CACHE_PRIORITY:
+            from .query_context import current_tenant
+            tenant = current_tenant()
         buf = SpillableBuffer(
             next_buffer_id(),
             BufferMeta(batch.schema, batch.num_rows_raw, batch.capacity),
-            priority, arrays, col_dtypes, obj_cols)
+            priority, arrays, col_dtypes, obj_cols, tenant=tenant)
         with self._mu:
             self.buffers[buf.id] = buf
             self.device_bytes += buf.size_bytes
+            self._tenant_device_delta_locked(buf, buf.size_bytes)
             self._maybe_spill_locked()
+            # per-tenant budget at the REGISTER boundary: a tenant past
+            # its device budget spills its OWN buffers first (never the
+            # one just registered — the active batch is not its own
+            # victim; it becomes eligible at the next tenant's pressure)
+            self._enforce_tenant_budget_locked(tenant, exclude_id=buf.id)
             self._note_residency()
         return buf.id
 
@@ -440,6 +489,11 @@ class BufferCatalog:
                 if prev_tier == StorageTier.HOST:
                     self.host_bytes -= buf.size_bytes
                 self.device_bytes += buf.size_bytes
+                self._tenant_device_delta_locked(buf, buf.size_bytes)
+                # re-promotion is a reserve-like boundary: an over-budget
+                # tenant re-admitting a buffer yields its OTHER residents
+                self._enforce_tenant_budget_locked(buf.tenant,
+                                                   exclude_id=buf.id)
                 self._note_residency()
         # device-tier rebuild happens OUTSIDE the catalog lock so concurrent
         # task threads on the (common) unspilled path never serialize here
@@ -476,6 +530,7 @@ class BufferCatalog:
             with self._mu:
                 if prev == StorageTier.DEVICE:
                     self.device_bytes -= buf.size_bytes
+                    self._tenant_device_delta_locked(buf, -buf.size_bytes)
                     self.spilled_device_bytes += buf.size_bytes
                 elif prev == StorageTier.HOST:
                     self.host_bytes -= buf.size_bytes
@@ -486,6 +541,7 @@ class BufferCatalog:
         if moved:
             with self._mu:
                 self.device_bytes -= moved
+                self._tenant_device_delta_locked(buf, -moved)
                 self.host_bytes += moved
                 self.spilled_device_bytes += moved
                 self._note_residency()
@@ -504,6 +560,7 @@ class BufferCatalog:
                 return
             if buf.tier == StorageTier.DEVICE:
                 self.device_bytes -= buf.size_bytes
+                self._tenant_device_delta_locked(buf, -buf.size_bytes)
             elif buf.tier == StorageTier.HOST:
                 self.host_bytes -= buf.size_bytes
             buf.free()
@@ -513,24 +570,44 @@ class BufferCatalog:
     def reserve(self, nbytes: int) -> None:
         """Admission check before materializing ~nbytes on device
         (DeviceMemoryEventHandler.onAllocFailure analog: spill until the
-        allocation fits, DeviceMemoryEventHandler.scala:42-69)."""
+        allocation fits, DeviceMemoryEventHandler.scala:42-69). Also the
+        per-tenant RESERVE boundary: a tenant already past its device
+        budget spills its own resident buffers before growing."""
+        from .query_context import current_tenant
+        tenant = current_tenant()
         with self._mu:
             target = self.device_budget - nbytes
             if self.device_bytes > target:
                 self._spill_device_to_locked(max(target, 0))
+            self._enforce_tenant_budget_locked(tenant)
             self._note_residency()
 
     def _maybe_spill_locked(self) -> None:
         if self.device_bytes > self.device_budget:
             self._spill_device_to_locked(self.device_budget)
 
+    def _over_budget_tenants_locked(self) -> set:
+        """Tenants currently holding more device bytes than their
+        installed budget (service/tenants.py) — the cascade's preferred
+        victim class. Caller holds ``self._mu``."""
+        from ..service import tenants as tn
+        return {t for t, held in self.tenant_device.items()
+                if tn.over_budget(t, held)}
+
     def _spill_device_to_locked(self, target: int) -> None:
         """Pop lowest-priority device buffers and push to host tier
         (RapidsBufferStore.synchronousSpill, RapidsBufferStore.scala:139-201).
-        Caller holds ``self._mu`` (the ``_locked`` convention)."""
+        Caller holds ``self._mu`` (the ``_locked`` convention).
+
+        Cross-tenant spill priority (docs/service.md §3): buffers of
+        tenants OVER their device budget are cascade victims before any
+        under-budget (or untenanted) tenant's, so global pressure caused
+        by one tenant's overdraw lands on that tenant first; within a
+        class the usual spill priority orders."""
+        over = self._over_budget_tenants_locked()
         device_bufs = sorted(
             (b for b in self.buffers.values() if b.tier == StorageTier.DEVICE),
-            key=lambda b: b.priority)
+            key=lambda b: (0 if b.tenant in over else 1, b.priority))
         with lockdep.allowed_while_locked(
                 "synchronous spill: the admission lock serializes tier "
                 "moves by design (DeviceMemoryEventHandler analog)"):
@@ -539,9 +616,47 @@ class BufferCatalog:
                     break
                 moved = buf.spill_to_host()
                 self.device_bytes -= moved
+                self._tenant_device_delta_locked(buf, -moved)
                 self.host_bytes += moved
                 self.spilled_device_bytes += moved
         self._note_residency()     # host tier may have just peaked
+        if self.host_bytes > self.host_budget:
+            self._spill_host_to_locked(self.host_budget)
+
+    def _enforce_tenant_budget_locked(self, tenant: Optional[str],
+                                      exclude_id: Optional[int] = None
+                                      ) -> None:
+        """Per-tenant budget enforcement at the reserve/register
+        boundaries: while ``tenant`` holds more device bytes than its
+        budget (service/tenants.py), its OWN device buffers spill
+        lowest-priority-first — an overdrawing tenant pays with its own
+        residency before any neighbor does. ``exclude_id`` protects the
+        buffer being registered right now (the active batch is never its
+        own victim). Caller holds ``self._mu``."""
+        from ..service import tenants as tn
+        if tenant is None:
+            return
+        held = self.tenant_device.get(tenant, 0)
+        if not tn.over_budget(tenant, held):
+            return
+        budget = tn.budget_for(tenant)
+        victims = sorted(
+            (b for b in self.buffers.values()
+             if b.tier == StorageTier.DEVICE and b.tenant == tenant and
+             b.id != exclude_id),
+            key=lambda b: b.priority)
+        with lockdep.allowed_while_locked(
+                "per-tenant budget spill under the admission lock (the "
+                "synchronous-spill discipline, docs/service.md)"):
+            for buf in victims:
+                if self.tenant_device.get(tenant, 0) <= budget:
+                    break
+                moved = buf.spill_to_host()
+                self.device_bytes -= moved
+                self._tenant_device_delta_locked(buf, -moved)
+                self.host_bytes += moved
+                self.spilled_device_bytes += moved
+        self._note_residency()
         if self.host_bytes > self.host_budget:
             self._spill_host_to_locked(self.host_budget)
 

@@ -416,6 +416,26 @@ class PlanEntry:
         # (analysis/contracts.validate_cached_binding)
         self.validated_dtypes = tuple(p.dtype for p in params)
         self.hits = 0
+        # execution exclusivity (the multi-tenant service runs CONCURRENT
+        # collects on one session, docs/service.md §5): a cached entry's
+        # exec tree is a LIVE object — bind() mutates its Parameters and
+        # exchanges assign per-execution shuffle state — so exactly one
+        # execution may own it at a time. Concurrent hits on a busy entry
+        # plan a fresh tree instead (serving verdict "busy"); try-only,
+        # never blocking, so no lock-order edge exists
+        self._exec_mu = threading.Lock()  # lint: raw-lock-ok try-only leaf lock; no engine lock taken under it
+
+    def try_begin_execution(self) -> bool:
+        """Claim the entry's exec tree for one execution (non-blocking).
+        False -> the tree is mid-execution on another thread; the caller
+        must plan a fresh tree."""
+        return self._exec_mu.acquire(blocking=False)
+
+    def end_execution(self) -> None:
+        try:
+            self._exec_mu.release()
+        except RuntimeError:
+            pass                       # release raced a relief-valve drop
 
     def bind(self, values: List[Any]) -> Tuple[bool, list]:
         """Rebind parameter values for the next execution. Returns
@@ -629,11 +649,41 @@ def serving_stats(session) -> Dict[str, int]:
     if st is None:
         st = session._serving_stats = {
             "parses": 0, "analyzes": 0, "plansBuilt": 0,
-            "planHits": 0, "planMisses": 0,
+            "planHits": 0, "planMisses": 0, "planBusy": 0,
+            "parseCacheHits": 0, "parseCacheMisses": 0,
             "resultHits": 0, "resultMisses": 0, "resultStores": 0,
             "revalidations": 0,
         }
     return st
+
+
+#: the CURRENT thread's serving info for the execution in flight —
+#: ``session._last_serving`` is a cross-thread observability surface that
+#: concurrent service workers clobber, so the execution pipeline
+#: (collect_batch -> release, the prepared-statement capture) reads the
+#: thread-local copy instead (docs/service.md §5)
+_tls_serving = threading.local()
+
+
+def note_thread_serving(serving: Optional[dict]) -> None:
+    _tls_serving.value = serving  # lint: unguarded-ok executing thread's own TLS field
+
+
+def thread_serving() -> Optional[dict]:
+    return getattr(_tls_serving, "value", None)
+
+
+def release_plan_entry(serving: Optional[dict]) -> None:
+    """End-of-execution hook for the entry exclusivity claimed in
+    :func:`plan_for` / the prepared fast path: pops ``planEntry`` from
+    the serving info (so a double call is a no-op) and releases the
+    tree for the next execution. Call from a ``finally`` wherever an
+    exec tree obtained through the serving front door finishes."""
+    if not serving:
+        return
+    entry = serving.pop("planEntry", None)
+    if entry is not None:
+        entry.end_execution()
 
 
 class _CachedOverrides:
@@ -697,6 +747,14 @@ def plan_for(session, plan: lp.LogicalPlan):
     serving["values"] = tuple(values)
     serving["cacheable"] = True
     entry = cache.get(fingerprint)
+    busy = False
+    if entry is not None:
+        # claim the tree BEFORE binding: bind() mutates the Parameters
+        # the live tree shares, and a concurrent execution may be
+        # mid-flight on them (the service's concurrent-collect shape)
+        if not entry.try_begin_execution():
+            busy = True
+            entry = None
     if entry is not None:
         try:
             revalidated, violations = entry.bind(values)
@@ -704,6 +762,7 @@ def plan_for(session, plan: lp.LogicalPlan):
             # error-mode drift raises out of the binding validation: the
             # tainted entry must not stay cached (a retry with clean
             # values would re-raise forever)
+            entry.end_execution()
             cache.discard(fingerprint)
             raise
         if revalidated:
@@ -712,11 +771,13 @@ def plan_for(session, plan: lp.LogicalPlan):
         if revalidated and violations:
             # the binding broke the validated contract: drop the entry
             # and replan from scratch (never execute a known-bad tree)
+            entry.end_execution()
             cache.discard(fingerprint)
         else:
             entry.reset_metrics()
             st["planHits"] += 1
             serving["planCache"] = "hit"
+            serving["planEntry"] = entry
             _inc("tpu_plan_cache_hits_total",
                  "parameterized-plan cache hits (analyze/optimize/"
                  "validate/stage-compile skipped)")
@@ -727,19 +788,34 @@ def plan_for(session, plan: lp.LogicalPlan):
                 entry.overrides, violations)
             return entry.exec_plan, serving
 
-    st["planMisses"] += 1
-    serving["planCache"] = "miss"
-    _inc("tpu_plan_cache_misses_total",
-         "parameterized-plan cache misses (full planning pass)")
+    if busy:
+        # the cached tree is executing on another thread: plan a FRESH
+        # tree for this execution and leave the cache alone (the busy
+        # entry keeps serving future hits). Counted separately so the
+        # service's concurrency shows up in serving_stats instead of
+        # masquerading as cold misses.
+        st["planBusy"] += 1
+        serving["planCache"] = "busy"
+    else:
+        st["planMisses"] += 1
+        serving["planCache"] = "miss"
+        _inc("tpu_plan_cache_misses_total",
+             "parameterized-plan cache misses (full planning pass)")
     ov = Overrides(session.conf)
     exec_plan = ov.apply(plan)
     session._last_overrides = ov
     st["plansBuilt"] += 1
-    mode = str(session.conf.get(cfg.ANALYSIS_VALIDATE_PLAN))
-    cache.put(PlanEntry(fingerprint, exec_plan, ov, params, mode,
-                        logical_plan=plan))
-    _gauge_set("tpu_plan_cache_entries",
-               "live parameterized-plan cache entries", len(cache))
+    if not busy:
+        mode = str(session.conf.get(cfg.ANALYSIS_VALIDATE_PLAN))
+        fresh = PlanEntry(fingerprint, exec_plan, ov, params, mode,
+                          logical_plan=plan)
+        # the fresh entry is about to EXECUTE: claim it before it becomes
+        # visible in the cache, or a concurrent hit could bind over it
+        fresh.try_begin_execution()
+        serving["planEntry"] = fresh
+        cache.put(fresh)
+        _gauge_set("tpu_plan_cache_entries",
+                   "live parameterized-plan cache entries", len(cache))
     return exec_plan, serving
 
 

@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 #: every top-level key a query-log record may carry (lint rule
 #: ``querylog-key`` checks :func:`build_record`'s literals against this)
 QUERY_LOG_FIELDS: Tuple[str, ...] = (
-    "queryId", "tS", "wallS", "planTimeS", "rows",
+    "queryId", "tenant", "tS", "wallS", "planTimeS", "rows",
     "fingerprint", "planCache", "resultCache", "params",
     "stageStats", "stageWallS", "stageRetries", "fetchRetries",
     "faultsFired", "shufflePlanes", "hbmPeakBytes", "hbmPeakOperator",
@@ -118,7 +118,8 @@ def _plane_bytes(exec_plan) -> Dict[str, int]:
 
 def build_record(session, exec_plan, serving: Dict[str, Any],
                  query_id: Optional[str],
-                 faults_before: int = 0) -> Dict[str, Any]:
+                 faults_before: int = 0,
+                 tenant: Optional[str] = None) -> Dict[str, Any]:
     """Assemble one query-log record (every key declared in
     :data:`QUERY_LOG_FIELDS`). Pure read of post-execution state."""
     import hashlib
@@ -138,6 +139,10 @@ def build_record(session, exec_plan, serving: Dict[str, Any],
         root_rows = 0
     rec: Dict[str, Any] = {
         "queryId": query_id,
+        # the tenant the query ran on behalf of (service multi-tenancy;
+        # None for direct caller-owned sessions) — tools/query_report
+        # groups its per-tenant rollup on this
+        "tenant": tenant,
         "tS": round(time.time(), 3),
         "wallS": round(getattr(session, "_last_execute_time_s", 0.0), 4),
         "planTimeS": round(getattr(session, "_last_plan_time_s", 0.0), 4),
@@ -173,7 +178,8 @@ def log_dir(session) -> Optional[str]:
 
 
 def maybe_log(session, exec_plan, serving, query_id,
-              faults_before: int = 0) -> Optional[str]:
+              faults_before: int = 0,
+              tenant: Optional[str] = None) -> Optional[str]:
     """Append one record when the query log is enabled; returns the log
     path. Never raises — a broken log directory must not fail queries
     (callers also guard, belt and braces)."""
@@ -182,7 +188,7 @@ def maybe_log(session, exec_plan, serving, query_id,
         return None
     try:
         rec = build_record(session, exec_plan, serving, query_id,
-                           faults_before=faults_before)
+                           faults_before=faults_before, tenant=tenant)
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"query_log-{os.getpid()}.jsonl")
         with open(path, "a") as f:

@@ -1,0 +1,497 @@
+"""Multi-tenant query service: admission control, budgets, scheduling.
+
+The reference runs as an executor-resident plugin whose GpuSemaphore
+bounds concurrent device tasks (SURVEY §2.7 ``GpuSemaphore.scala:27-161``)
+and whose bootstrap initializes device+memory once per long-lived
+executor (§2.1 ``Plugin.scala:108-154``); Spark's scheduler above it
+decides WHICH tasks run. Standalone there is no scheduler — this module
+is it: a long-lived in-process :class:`QueryService` that admits
+concurrent queries from named tenants against ONE shared engine
+(session, device, buffer catalog), layered over the existing admission
+primitives:
+
+* ``TpuSemaphore`` still bounds threads holding the device (the
+  concurrentGpuTasks analog) — the service bounds QUERIES above it;
+* per-tenant ``slots`` bound a tenant's concurrent queries, and a
+  bounded ``max_queue_depth`` load-sheds excess submissions with a typed
+  :class:`AdmissionRejected` instead of queueing unboundedly;
+* the queue orders on (priority DESC, deadline, arrival) — a
+  low-priority flood cannot starve a high-priority tenant, and a query
+  whose deadline lapses in the queue fails fast with a typed
+  :class:`DeadlineExceededError` without ever occupying a slot;
+* per-tenant device-byte budgets are enforced by the buffer catalog
+  (``exec/spill.py``) through the ambient tenant the service installs
+  around each execution (``service/tenants.tenant_scope``).
+
+Every admit / reject / deadline-shed decision is flight-recorded (kind
+``admission``) and counted in the tenant-labeled telemetry series
+(``tpu_tenant_queue_depth`` / ``tpu_tenant_admitted_total`` /
+``tpu_tenant_rejected_total`` / ``tpu_query_queue_seconds``), so a
+saturated tenant is diagnosable from the same scrape surface as any
+other engine pressure (docs/service.md).
+
+Scope: one service per process-resident engine, in-process callers
+(the traffic-replay bench, ``tools/serve``). Concurrent DISTRIBUTED
+queries are out of scope — the lockstep shuffle-id contract serializes
+multi-process queries (docs/shuffle.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..analysis.lockdep import named_lock
+from . import tenants as tn
+from .tenants import TenantSpec, tenant_scope
+
+_INF = float("inf")
+
+
+class AdmissionRejected(RuntimeError):
+    """Load shedding: the tenant's queue is at its bound (or the service
+    is closed) — the submission was REFUSED, nothing was queued. Typed
+    so callers can distinguish back-pressure from query failure and
+    retry with their own policy."""
+
+    def __init__(self, tenant: str, reason: str):
+        super().__init__(f"tenant {tenant!r}: {reason}")
+        self.tenant = tenant
+        self.reason = reason
+
+
+class DeadlineExceededError(RuntimeError):
+    """The query's deadline lapsed before (or while) it could run; it
+    never occupied an execution slot past the deadline."""
+
+    def __init__(self, tenant: str, label: str, late_s: float):
+        super().__init__(
+            f"tenant {tenant!r} query {label!r} missed its deadline "
+            f"by {late_s:.3f}s")
+        self.tenant = tenant
+        self.late_s = late_s
+
+
+class ServiceClosed(RuntimeError):
+    """The service shut down before this query could run."""
+
+
+class QueryTicket:
+    """One submitted query's handle: wait on :meth:`result`. Carries the
+    admission timeline (submitted/started/finished) the replay bench's
+    latency percentiles are computed from."""
+
+    _seq = itertools.count(1)
+
+    def __init__(self, tenant: str, label: str, priority: int,
+                 deadline_at: Optional[float], thunk: Callable[[], Any]):
+        self.tenant = tenant
+        self.label = label
+        self.priority = priority
+        self.deadline_at = deadline_at      # perf_counter timestamp
+        self.seq = next(QueryTicket._seq)
+        self.thunk = thunk
+        self.submitted_at = time.perf_counter()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.query_id: Optional[str] = None
+        self._done = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+
+    @property
+    def sort_key(self):
+        """(priority DESC, deadline, arrival): the queue order."""
+        return (-self.priority,
+                self.deadline_at if self.deadline_at is not None else _INF,
+                self.seq)
+
+    def queue_wait_s(self) -> float:
+        if self.started_at is None:
+            return 0.0
+        return self.started_at - self.submitted_at
+
+    def latency_s(self) -> float:
+        """Submit -> finished wall seconds (inf while unfinished)."""
+        if self.finished_at is None:
+            return _INF
+        return self.finished_at - self.submitted_at
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the query's result; re-raises its typed failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.label!r} (tenant {self.tenant!r}) still "
+                f"pending after {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _finish(self, result=None, exc: Optional[BaseException] = None
+                ) -> None:
+        self.finished_at = time.perf_counter()
+        self._result = result
+        self._exc = exc
+        self._done.set()
+
+
+class _TenantState:
+    """One registered tenant's live admission state (guarded by the
+    service condition's lock)."""
+
+    def __init__(self, spec: TenantSpec, slots: int, depth: int,
+                 budget: int):
+        self.spec = spec
+        self.name = spec.name
+        self.priority = int(spec.priority)
+        self.slots = max(1, int(slots))
+        self.max_queue_depth = max(1, int(depth))
+        self.memory_budget_bytes = max(0, int(budget))
+        self.queued = 0
+        self.running = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.failed = 0
+        self.deadline_expired = 0
+        self.queue_wait_s_total = 0.0
+        self.queue_wait_s_max = 0.0
+
+
+class QueryService:
+    """The long-lived in-process query front door (see module doc).
+
+    ::
+
+        svc = QueryService(session, tenants=[
+            TenantSpec("gold", priority=10, slots=2,
+                       memory_budget_bytes=1 << 30),
+            TenantSpec("bronze", priority=0, slots=1,
+                       memory_budget_bytes=64 << 20)])
+        t = svc.submit("gold", "SELECT sum(v) FROM t", deadline_s=5.0)
+        batch = t.result(timeout=30)
+        svc.close()
+
+    ``submit`` accepts SQL text (parsed through the session's SQL-text
+    parse cache), a DataFrame, a (PreparedStatement, params) pair, or a
+    zero-argument callable returning the result."""
+
+    def __init__(self, session, tenants=(),
+                 max_workers: Optional[int] = None):
+        from .. import config as cfg
+        self.session = session
+        conf = session.conf
+        self._default_slots = int(conf.get(cfg.SERVICE_DEFAULT_SLOTS))
+        self._default_depth = int(
+            conf.get(cfg.SERVICE_DEFAULT_QUEUE_DEPTH))
+        self._default_budget = int(
+            conf.get(cfg.SERVICE_DEFAULT_MEMORY_BYTES))
+        if max_workers is None:
+            max_workers = int(conf.get(cfg.SERVICE_MAX_CONCURRENT))
+        self.max_workers = max(1, int(max_workers))
+        # ONE leaf lock guards the queue + tenant states; the workers
+        # wait/notify on the condition built over it. No engine lock is
+        # ever taken under it (execution happens outside), so it cannot
+        # participate in an inversion with the catalog/device locks.
+        self._mu = named_lock("service.server.QueryService._mu")
+        self._cond = threading.Condition(self._mu)  # lint: raw-lock-ok condition OVER the named service lock; wait/notify not expressible through NamedLock alone
+        self._queue: List[QueryTicket] = []
+        self._tenants: Dict[str, _TenantState] = {}
+        self._closed = False
+        for spec in tenants:
+            self.register_tenant(spec)
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"tpu-service-{i}")
+            for i in range(self.max_workers)]
+        for w in self._workers:
+            w.start()
+
+    # -- tenant registry -----------------------------------------------------
+    def register_tenant(self, spec) -> _TenantState:
+        """Register a tenant from a :class:`TenantSpec` (a bare name
+        registers with the ``service.*`` conf defaults), or UPDATE a
+        live one's bounds IN PLACE: re-registering must never reset the
+        running/queued accounting of in-flight work (a fresh zeroed
+        state would let the scheduler overshoot the slot bound).
+        Installs the tenant's device budget into the process budget
+        table the buffer catalog enforces."""
+        if isinstance(spec, str):
+            spec = TenantSpec(spec)
+        slots = spec.slots if spec.slots is not None else \
+            self._default_slots
+        depth = spec.max_queue_depth if spec.max_queue_depth is not None \
+            else self._default_depth
+        budget = spec.memory_budget_bytes \
+            if spec.memory_budget_bytes is not None else \
+            self._default_budget
+        with self._cond:
+            state = self._tenants.get(spec.name)
+            if state is None:
+                state = _TenantState(spec, slots, depth, budget)
+                self._tenants[spec.name] = state
+            else:
+                state.spec = spec
+                state.priority = int(spec.priority)
+                state.slots = max(1, int(slots))
+                state.max_queue_depth = max(1, int(depth))
+                state.memory_budget_bytes = max(0, int(budget))
+            self._cond.notify_all()    # a raised slot bound unblocks
+        tn.set_budget(spec.name, state.memory_budget_bytes)
+        return state
+
+    def _state(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = self.register_tenant(tenant)
+        return st
+
+    # -- submission ----------------------------------------------------------
+    def _thunk_for(self, query, params: Optional[dict]):
+        from ..api.dataframe import DataFrame
+        if callable(query) and not isinstance(query, DataFrame):
+            return query
+        if isinstance(query, str):
+            text = query
+            return lambda: self.session.sql(text).collect_batch()
+        if isinstance(query, DataFrame):
+            return query.collect_batch
+        # PreparedStatement (duck-typed: anything with .execute(**kw));
+        # NOTE a statement binds in place — at most one in-flight
+        # execute per statement object (one per stream, docs/service.md)
+        if hasattr(query, "execute"):
+            kw = dict(params or {})
+            return lambda: query.execute(**kw)
+        raise TypeError(f"unsupported query form: {type(query).__name__}")
+
+    def submit(self, tenant: str, query, *, params: Optional[dict] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               label: str = "") -> QueryTicket:
+        """Queue one query for ``tenant``. Raises
+        :class:`AdmissionRejected` (load shed) when the tenant's queue
+        is at its bound, :class:`DeadlineExceededError` when
+        ``deadline_s`` is already non-positive. ``priority`` overrides
+        the tenant's default for this query only."""
+        from .telemetry import flight_record
+        state = self._state(tenant)
+        label = label or (query if isinstance(query, str) else
+                          type(query).__name__)[:80]
+        if deadline_s is not None and deadline_s <= 0:
+            state.deadline_expired += 1
+            self._count("tpu_tenant_rejected_total", tenant)
+            flight_record("admission", "deadline-expired",
+                          {"tenant": tenant, "label": label})
+            raise DeadlineExceededError(tenant, label, -float(deadline_s))
+        ticket = QueryTicket(
+            tenant, label,
+            priority if priority is not None else state.priority,
+            time.perf_counter() + deadline_s if deadline_s is not None
+            else None,
+            self._thunk_for(query, params))
+        with self._cond:
+            if self._closed:
+                raise AdmissionRejected(tenant, "service is closed")
+            if state.queued >= state.max_queue_depth:
+                state.rejected += 1
+                self._count("tpu_tenant_rejected_total", tenant)
+                flight_record("admission", "queue-full",
+                              {"tenant": tenant, "label": label,
+                               "depth": state.queued})
+                raise AdmissionRejected(
+                    tenant, f"queue depth {state.queued} at bound "
+                            f"{state.max_queue_depth} (load shed)")
+            self._queue.append(ticket)
+            state.queued += 1
+            self._gauge("tpu_tenant_queue_depth", tenant, state.queued)
+            self._cond.notify()
+        return ticket
+
+    # -- scheduling ----------------------------------------------------------
+    def _pop_eligible_locked(self) -> Optional[QueryTicket]:
+        """The best queued ticket whose tenant has a free slot, by
+        (priority DESC, deadline, arrival); None when every queued
+        tenant is saturated. Deadline-lapsed tickets fail fast HERE —
+        they are removed and finished without consuming a slot. Caller
+        holds the condition's lock."""
+        from .telemetry import flight_record
+        now = time.perf_counter()
+        expired = [t for t in self._queue
+                   if t.deadline_at is not None and now >= t.deadline_at]
+        for t in expired:
+            self._queue.remove(t)
+            state = self._tenants[t.tenant]
+            state.queued -= 1
+            state.deadline_expired += 1
+            self._gauge("tpu_tenant_queue_depth", t.tenant, state.queued)
+            flight_record("admission", "deadline-shed",
+                          {"tenant": t.tenant, "label": t.label,
+                           "lateS": round(now - t.deadline_at, 4)})
+            t._finish(exc=DeadlineExceededError(
+                t.tenant, t.label, now - t.deadline_at))
+        best = None
+        for t in self._queue:
+            if self._tenants[t.tenant].running >= \
+                    self._tenants[t.tenant].slots:
+                continue
+            if best is None or t.sort_key < best.sort_key:
+                best = t
+        if best is not None:
+            self._queue.remove(best)
+        return best
+
+    def _worker_loop(self) -> None:
+        from .telemetry import MetricsRegistry, flight_record
+        while True:
+            with self._cond:
+                ticket = None
+                while not self._closed:
+                    ticket = self._pop_eligible_locked()
+                    if ticket is not None:
+                        break
+                    self._cond.wait(0.2)
+                if ticket is None:          # closed and drained
+                    return
+                state = self._tenants[ticket.tenant]
+                state.queued -= 1
+                state.running += 1
+                state.admitted += 1
+                ticket.started_at = time.perf_counter()
+                wait = ticket.queue_wait_s()
+                state.queue_wait_s_total += wait
+                state.queue_wait_s_max = max(state.queue_wait_s_max, wait)
+                self._gauge("tpu_tenant_queue_depth", ticket.tenant,
+                            state.queued)
+            self._count("tpu_tenant_admitted_total", ticket.tenant)
+            try:
+                MetricsRegistry.get().histogram(
+                    "tpu_query_queue_seconds",
+                    "service admission-queue wait seconds",
+                    tenant=ticket.tenant).observe(wait)
+            except Exception:
+                pass               # telemetry must never fail the query
+            flight_record("admission", "admit",
+                          {"tenant": ticket.tenant, "label": ticket.label,
+                           "queueWaitS": round(wait, 4)})
+            try:
+                from ..exec import query_context as qc
+                # cleared before, read after: the id THIS thread's thunk
+                # executed (a result-cache hit executes nothing -> None);
+                # session._last_query_id is last-writer-wins and must
+                # not be joined to a ticket
+                qc.note_thread_query_id(None)
+                with tenant_scope(ticket.tenant):
+                    out = ticket.thunk()
+                ticket.query_id = qc.thread_last_query_id()
+                ticket._finish(result=out)
+                ok = True
+            except BaseException as e:      # typed failure rides the ticket
+                ticket._finish(exc=e)
+                ok = False
+            finally:
+                with self._cond:
+                    state.running -= 1
+                    if ok:
+                        state.completed += 1
+                    else:
+                        state.failed += 1
+                    self._cond.notify_all()
+
+    # -- observability -------------------------------------------------------
+    @staticmethod
+    def _count(name: str, tenant: str, n: int = 1) -> None:
+        from .telemetry import MetricsRegistry
+        try:
+            MetricsRegistry.get().counter(
+                name, "service per-tenant admission counter",
+                tenant=tenant).inc(n)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _gauge(name: str, tenant: str, value: float) -> None:
+        from .telemetry import MetricsRegistry
+        try:
+            MetricsRegistry.get().gauge(
+                name, "service per-tenant admission gauge",
+                tenant=tenant).set(value)
+        except Exception:
+            pass
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-tenant service counters plus the catalog's per-tenant
+        device residency and the device semaphore's live admission state
+        — the dashboard dict (docs/service.md §6)."""
+        from ..exec.device import TpuSemaphore
+        from ..exec.spill import BufferCatalog
+        cat = BufferCatalog.peek()
+        dev = cat.tenant_device_bytes() if cat is not None else {}
+        out: Dict[str, Any] = {"tenants": {}, "queued": 0, "running": 0}
+        sem = TpuSemaphore.peek()
+        if sem is not None:
+            # the layer BELOW the service (docs/service.md §1): how many
+            # admitted queries' tasks are blocked on the device right now
+            out["device"] = dict(sem.stats(),
+                                 permits=sem.max_concurrent)
+        with self._cond:
+            for name, st in sorted(self._tenants.items()):
+                done = st.completed + st.failed
+                out["tenants"][name] = {
+                    "priority": st.priority,
+                    "slots": st.slots,
+                    "maxQueueDepth": st.max_queue_depth,
+                    "memoryBudgetBytes": st.memory_budget_bytes,
+                    "queued": st.queued,
+                    "running": st.running,
+                    "admitted": st.admitted,
+                    "rejected": st.rejected,
+                    "completed": st.completed,
+                    "failed": st.failed,
+                    "deadlineExpired": st.deadline_expired,
+                    "queueWaitAvgS": round(
+                        st.queue_wait_s_total / done, 4) if done else 0.0,
+                    "queueWaitMaxS": round(st.queue_wait_s_max, 4),
+                    "deviceBytes": dev.get(name, 0),
+                }
+                out["queued"] += st.queued
+                out["running"] += st.running
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop admitting, fail queued work with :class:`ServiceClosed`,
+        join workers with a bounded timeout (running queries finish)."""
+        from ..exec.tasks import record_join_timeout
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            pending, self._queue = self._queue, []
+            for t in pending:
+                st = self._tenants.get(t.tenant)
+                if st is not None:
+                    st.queued -= 1
+                    self._gauge("tpu_tenant_queue_depth", t.tenant,
+                                st.queued)
+                t._finish(exc=ServiceClosed(
+                    f"service closed before {t.label!r} ran"))
+            self._cond.notify_all()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        for w in self._workers:
+            w.join(timeout=max(0.1, deadline - time.monotonic()))
+        alive = [w.name for w in self._workers if w.is_alive()]
+        if alive:
+            record_join_timeout("tpu-service", alive,
+                                logger="spark_rapids_tpu.service")
+
+    def __enter__(self) -> "QueryService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -130,6 +130,12 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_durable_evicted_bytes_total",  # durable-tier GC budget evictions
     "tpu_query_log_records_total",      # structured query-log lines
     "tpu_query_drift_flags_total",      # plan nodes past driftThreshold
+    # multi-tenant query service (service/server.py, docs/service.md)
+    "tpu_tenant_queue_depth",           # gauge, label tenant=<name>
+    "tpu_tenant_admitted_total",        # counter, label tenant=<name>
+    "tpu_tenant_rejected_total",        # load sheds, label tenant=<name>
+    "tpu_tenant_device_bytes",          # gauge, harvested, label tenant
+    "tpu_query_queue_seconds",          # histogram, label tenant=<name>
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
@@ -656,13 +662,21 @@ def flight_record(kind: str, name: str, data: Optional[Dict] = None) -> None:
     _flight_tls.busy = True
     try:
         try:
-            from ..exec.query_context import current_query_id
+            from ..exec.query_context import current_query_id, \
+                current_tenant
             qid = current_query_id()
+            tenant = current_tenant()
         except Exception:
-            qid = None
-        if qid is not None:
+            qid = tenant = None
+        if qid is not None or tenant is not None:
             data = dict(data) if data else {}
-            data.setdefault("query", qid)
+            if qid is not None:
+                data.setdefault("query", qid)
+            # the tenant rides NEXT to the query id (docs/service.md):
+            # a post-mortem groups one tenant's events without joining
+            # through the query log
+            if tenant is not None:
+                data.setdefault("tenant", tenant)
         FlightRecorder.get().record(kind, name, data)
     finally:
         _flight_tls.busy = False
@@ -802,6 +816,22 @@ def _harvest(reg: MetricsRegistry) -> None:
             cat.spilled_device_bytes)
         reg.gauge("tpu_spilled_host_bytes_total").set(cat.spilled_host_bytes)
         reg.gauge("tpu_spill_buffers").set(cat.buffer_count())
+        # per-tenant device residency (service multi-tenancy): one gauge
+        # sample per tenant. Previously-seen tenants whose buffers all
+        # left the device are explicitly zeroed — a scrape must show the
+        # watermark RETURNING to 0, not a stale last value
+        tenant_dev = cat.tenant_device_bytes()
+        with reg._values_mu:        # snapshot keys: a concurrent scrape
+            fam = reg._families.get("tpu_tenant_device_bytes")
+            known = [dict(k).get("tenant")
+                     for k in fam.samples] if fam is not None else []
+        for t in known:
+            if t and t not in tenant_dev:
+                reg.gauge("tpu_tenant_device_bytes", tenant=t).set(0)
+        for t, nbytes in tenant_dev.items():
+            reg.gauge("tpu_tenant_device_bytes",
+                      "device bytes held per service tenant",
+                      tenant=t).set(nbytes)
 
     # shuffle transport process totals (both wire directions)
     from ..shuffle import transport

@@ -1,0 +1,100 @@
+"""Tenant identity, budgets and priorities for the multi-tenant service.
+
+The reference plugin isolates concurrent Spark tasks only at the device
+level (GpuSemaphore permits, SURVEY §2.7); a query SERVICE needs one
+more axis: WHO a query runs for. A :class:`TenantSpec` names a tenant
+and carries its scheduling weight (``priority``), its admission bounds
+(``slots`` concurrent queries, ``max_queue_depth`` before load-shedding)
+and its device-memory budget. The spec's enforcement is split across
+layers:
+
+* admission/scheduling — ``service/server.QueryService`` (slots, queue
+  depth, priority/deadline ordering);
+* memory — ``exec/spill.BufferCatalog`` reads the process-global budget
+  table kept HERE at its reserve/register boundaries and spills an
+  over-budget tenant's own buffers first (docs/service.md §3);
+* attribution — ``exec/query_context.tenant_scope`` (re-exported here)
+  makes the tenant ambient for a query's execution, so buffer
+  registration, flight-recorder events, the shuffle protocol and the
+  query log all tag the tenant with no per-callsite plumbing.
+
+The budget table is process-global (like the watermarks) because the
+buffer catalog is a process singleton: two services on one engine share
+one memory truth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..analysis.lockdep import named_lock
+# re-export: the ambient tenant machinery lives with the query context
+# (exec/query_context.py) so exec/ never imports service/; service code
+# and tests reach it from here
+from ..exec.query_context import current_tenant, tenant_scope  # noqa: F401
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's contract with the service. ``priority``: HIGHER runs
+    first (the queue orders on (-priority, deadline, arrival)).
+    ``slots``: concurrent queries this tenant may occupy in the service
+    pool (its concurrentGpuTasks analog one level up). ``max_queue_depth``:
+    queued (not yet running) queries beyond this are load-shed with a
+    typed ``AdmissionRejected``. ``memory_budget_bytes``: device bytes
+    this tenant may hold before its own buffers become the first spill
+    victims; 0 = unbudgeted. ``None`` fields fall back to the
+    ``service.*`` conf defaults at registration."""
+
+    name: str
+    priority: int = 0
+    slots: Optional[int] = None
+    max_queue_depth: Optional[int] = None
+    memory_budget_bytes: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Process-global device-memory budget table (the spill layer's view)
+# ---------------------------------------------------------------------------
+
+_mu = named_lock("service.tenants._mu")
+_budgets: Dict[str, int] = {}
+
+
+def set_budget(tenant: str, nbytes: int) -> None:
+    """Install/replace one tenant's device-byte budget (0 removes it —
+    an unbudgeted tenant is never a preferred spill victim)."""
+    with _mu:
+        if nbytes and int(nbytes) > 0:
+            _budgets[tenant] = int(nbytes)
+        else:
+            _budgets.pop(tenant, None)
+
+
+def budget_for(tenant: Optional[str]) -> int:
+    """The tenant's device budget in bytes, 0 when unbudgeted (or for
+    untenanted buffers)."""
+    if tenant is None:
+        return 0
+    with _mu:
+        return _budgets.get(tenant, 0)
+
+
+def budgets() -> Dict[str, int]:
+    with _mu:
+        return dict(_budgets)
+
+
+def reset_budgets() -> None:
+    """Drop every installed budget (test/service teardown)."""
+    with _mu:
+        _budgets.clear()
+
+
+def over_budget(tenant: Optional[str], held_bytes: int) -> bool:
+    """True when ``tenant`` holds more device bytes than its budget
+    allows — the spill cascade's victim-ordering predicate (an
+    unbudgeted tenant is never over)."""
+    b = budget_for(tenant)
+    return b > 0 and held_bytes > b

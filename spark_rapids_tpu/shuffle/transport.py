@@ -75,6 +75,18 @@ def _current_query_id():
         return None
 
 
+def _current_tenant():
+    """The ambient tenant for protocol headers (service multi-tenancy):
+    rides META_REQ next to the query id so the SERVING peer — a
+    different process with no ambient context for the fetching query —
+    attributes the serve to the right tenant in its flight ring."""
+    try:
+        from ..exec.query_context import current_tenant
+        return current_tenant()
+    except Exception:
+        return None
+
+
 class ShuffleFetchError(RuntimeError):
     """Fetch failed after retries (RapidsShuffleFetchFailedException analog:
     the caller maps this to a stage retry / recompute)."""
@@ -577,6 +589,7 @@ class ShuffleServer:
                 if msg_type == META_REQ:
                     sid = header["shuffle_id"]
                     peer_q = header.get("query_id")
+                    peer_tenant = header.get("tenant")
                     if peer_q and header.get("reduce_ids"):
                         # the fetching peer's query id rides the protocol
                         # header: an ACTUAL data serve lands in THIS
@@ -589,8 +602,10 @@ class ShuffleServer:
                         # breadcrumbs through the fixed-size ring,
                         # displacing the events a post-mortem needs
                         from ..service.telemetry import flight_record
-                        flight_record("serve", f"shuffle-{sid}",
-                                      {"query": peer_q})
+                        data = {"query": peer_q}
+                        if peer_tenant:
+                            data["tenant"] = peer_tenant
+                        flight_record("serve", f"shuffle-{sid}", data)
                     conflict = self.store.check_fingerprint(
                         sid, header.get("fingerprint"))
                     if conflict is not None:
@@ -778,7 +793,8 @@ class ShuffleClient:
                 conn.send(encode_frame(META_REQ, {
                     "shuffle_id": shuffle_id, "reduce_ids": [],
                     "fingerprint": fingerprint,
-                    "query_id": _current_query_id()}))
+                    "query_id": _current_query_id(),
+                    "tenant": _current_tenant()}))
                 reader = FrameReader(conn.read_exact)
                 msg_type, header, _ = reader.next_frame()
                 if msg_type == ERROR and header.get("code") in (
@@ -868,7 +884,8 @@ class ShuffleClient:
             conn.send(encode_frame(META_REQ, {
                 "shuffle_id": shuffle_id, "reduce_ids": reduce_ids,
                 "fingerprint": fingerprint,
-                "query_id": _current_query_id()}))
+                "query_id": _current_query_id(),
+                "tenant": _current_tenant()}))
             reader = FrameReader(conn.read_exact)
             msg_type, header, _ = reader.next_frame()
             if msg_type == ERROR:

@@ -69,8 +69,11 @@ def digest(query_id: str, records: List[dict], top: int = 5) -> str:
     rows = sum(int(r.get("rows", 0) or 0) for r in records)
     retries = sum(int(r.get("stageRetries", 0) or 0) for r in records)
     faults = sum(int(r.get("faultsFired", 0) or 0) for r in records)
+    tenant = next((r.get("tenant") for r in records if r.get("tenant")),
+                  None)
     lines.append(f"query {query_id}  "
-                 f"({len(records)} worker record(s))")
+                 + (f"tenant={tenant}  " if tenant else "")
+                 + f"({len(records)} worker record(s))")
     lines.append(
         f"  wallS={wall} rows={rows} "
         f"planCache={head.get('planCache')} "
@@ -122,6 +125,40 @@ def digest(query_id: str, records: List[dict], top: int = 5) -> str:
     return "\n".join(lines)
 
 
+def tenant_rollup(records: List[dict]) -> str:
+    """Per-tenant summary across every record carrying a tenant id
+    (service multi-tenancy, docs/service.md): query count, wall seconds,
+    rows, retries — empty string when no record is tenant-tagged.
+    Multi-worker records sharing a query id count as ONE query (wall =
+    the slowest worker, the digest() rule; rows/retries sum across
+    workers, each worker returns/retries its own partitions)."""
+    by_query: Dict[tuple, List[dict]] = {}
+    for rec in records:
+        t = rec.get("tenant")
+        if not t:
+            continue
+        by_query.setdefault((t, str(rec.get("queryId"))),
+                            []).append(rec)
+    by_tenant: Dict[str, dict] = {}
+    for (t, _qid), recs in by_query.items():
+        e = by_tenant.setdefault(t, {"queries": 0, "wallS": 0.0,
+                                     "rows": 0, "retries": 0})
+        e["queries"] += 1
+        e["wallS"] += max(float(r.get("wallS", 0) or 0) for r in recs)
+        e["rows"] += sum(int(r.get("rows", 0) or 0) for r in recs)
+        e["retries"] += sum(int(r.get("stageRetries", 0) or 0)
+                            for r in recs)
+    if not by_tenant:
+        return ""
+    lines = ["per-tenant summary:"]
+    for t, e in sorted(by_tenant.items()):
+        lines.append(
+            f"  {t}: queries={e['queries']} "
+            f"wallS={round(e['wallS'], 4)} rows={e['rows']}"
+            + (f" stageRetries={e['retries']}" if e["retries"] else ""))
+    return "\n".join(lines)
+
+
 def render(paths: List[str], top: int = 5) -> str:
     records = load_records(paths)
     if not records:
@@ -133,7 +170,11 @@ def render(paths: List[str], top: int = 5) -> str:
         if qid not in by_query:
             order.append(qid)
         by_query.setdefault(qid, []).append(rec)
-    return "\n\n".join(digest(q, by_query[q], top=top) for q in order)
+    out = "\n\n".join(digest(q, by_query[q], top=top) for q in order)
+    roll = tenant_rollup(records)
+    if roll:
+        out += "\n\n" + roll
+    return out
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,128 @@
+"""CLI front door for the multi-tenant query service.
+
+Two modes (docs/service.md §6):
+
+One-shot SQL through the service (admission + tenant attribution on a
+single query)::
+
+    python -m tools.serve --sf 0.001 --tenant gold \
+        --sql "SELECT count(*) AS n FROM lineitem"
+
+Mixed-tenant demo traffic (the benchmarks/replay.py engine, without the
+history stamp) printing the per-tenant service stats::
+
+    python -m tools.serve --sf 0.001 --streams 4 --iters 4
+    python -m tools.serve --faults "fetch.fail;task.poison"
+
+Tenants are declared ``name:key=value:...`` with keys ``priority``,
+``slots``, ``depth`` (max queue depth) and ``budget`` (device bytes,
+byte suffixes allowed)::
+
+    --tenants "gold:priority=10:slots=2:budget=1g,bronze:priority=0"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_tenant_specs(text: str):
+    """``name:key=value:...`` comma-separated -> [TenantSpec]."""
+    from spark_rapids_tpu.config import parse_bytes
+    from spark_rapids_tpu.service.tenants import TenantSpec
+    specs = []
+    for raw in (text or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        name, kw = parts[0], {}
+        for p in parts[1:]:
+            if "=" not in p:
+                raise ValueError(
+                    f"bad tenant field {p!r} in {raw!r} "
+                    "(expect key=value)")
+            k, v = p.split("=", 1)
+            if k == "priority":
+                kw["priority"] = int(v)
+            elif k == "slots":
+                kw["slots"] = int(v)
+            elif k == "depth":
+                kw["max_queue_depth"] = int(v)
+            elif k == "budget":
+                kw["memory_budget_bytes"] = parse_bytes(v)
+            else:
+                raise ValueError(f"unknown tenant field {k!r} in {raw!r}")
+        specs.append(TenantSpec(name, **kw))
+    return specs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run queries through the multi-tenant query service")
+    ap.add_argument("--sf", type=float, default=0.001,
+                    help="TPC-H scale factor of the generated tables")
+    ap.add_argument("--tenants",
+                    default="gold:priority=10:slots=2,"
+                            "bronze:priority=0:slots=1",
+                    help="tenant specs: name:key=value:... (keys: "
+                         "priority, slots, depth, budget)")
+    ap.add_argument("--tenant", default="gold",
+                    help="tenant for --sql submissions")
+    ap.add_argument("--sql", action="append", default=[],
+                    help="SQL to run through the service (repeatable; "
+                         "TPC-H tables are registered as views)")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-query deadline seconds for --sql")
+    ap.add_argument("--streams", type=int, default=4,
+                    help="demo-traffic concurrent streams (no --sql)")
+    ap.add_argument("--iters", type=int, default=4,
+                    help="demo-traffic queries per stream")
+    ap.add_argument("--faults", default=None,
+                    help="chaos spec for the demo traffic")
+    args = ap.parse_args(argv)
+
+    if not args.sql:
+        # demo traffic: the replay engine without the history stamp
+        from benchmarks.replay import run_replay
+        line = run_replay(sf=args.sf, streams=args.streams,
+                          queries_per_stream=args.iters,
+                          faults=args.faults, stamp=False)
+        print(json.dumps(line, default=str))
+        return 0 if line.get("replay_ok") else 1
+
+    from benchmarks import datagen
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.service.server import QueryService
+    session = TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    datagen.register_tables(session, args.sf)
+    svc = QueryService(session, tenants=parse_tenant_specs(args.tenants))
+    rc = 0
+    try:
+        for sql in args.sql:
+            ticket = svc.submit(args.tenant, sql,
+                                deadline_s=args.deadline)
+            try:
+                batch = ticket.result(timeout=600)
+                print(json.dumps({
+                    "tenant": ticket.tenant, "sql": sql,
+                    "queryId": ticket.query_id,
+                    "queueWaitS": round(ticket.queue_wait_s(), 4),
+                    "latencyS": round(ticket.latency_s(), 4),
+                    "rows": batch.rows()}, default=str))
+            except Exception as e:
+                rc = 1
+                print(json.dumps({
+                    "tenant": ticket.tenant, "sql": sql,
+                    "error": f"{type(e).__name__}: {e}"}, default=str))
+        print(json.dumps({"service": svc.stats()}, default=str))
+    finally:
+        svc.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
