@@ -22,6 +22,7 @@ Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -450,6 +451,194 @@ def bench_chaos(sf: float = 0.002):
     }
 
 
+def bench_aqe_skew(n_rows: int = 20_000):
+    """AQE skewed-workload bench (ISSUE 16, docs/aqe.md): a deliberately
+    skewed q3-shaped join+aggregate — one hot key owns 90% of the fact
+    side, so one reduce partition dwarfs the rest — run warm with
+    adaptive execution ON (``aqe_skew_q3_s``, lower is better) and OFF,
+    with the on/off wall ratio stamped as ``aqe_ab_q3`` (< 1 means the
+    re-planner pays for itself on skew).
+
+    Honesty checks gate the stamp (``aqe_ok``): identical rows on/off;
+    at least one APPLIED coalesce, skew-split, join-promote and
+    join-demote decision across the legs; each decision visible in
+    EXPLAIN ANALYZE, the query log record, and the
+    ``tpu_aqe_decisions_total`` telemetry counter; and the demoted
+    re-planned stage passing contract validation in ERROR mode. The
+    skew leg repeats on a mesh/ICI-attached plan (needs >= 2 devices;
+    recorded in ``aqe_ici_skew_split``): the first execution records the
+    stage-stats baseline, the second falls the skewed stage back to DCN
+    and splits."""
+    import glob
+    import tempfile
+    from benchmarks import queries as Q  # noqa: F401  (q3 shape reference)
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.service.telemetry import MetricsRegistry
+
+    hot = int(n_rows * 0.9)
+    ks = [7] * hot + [i % 40 for i in range(n_rows - hot)]
+    vs = [float(i % 13) for i in range(n_rows)]
+    dim_k = list(range(41))
+    dim_w = [k * 10.0 for k in dim_k]
+    log_dir = tempfile.mkdtemp(prefix="aqe_bench_log_")
+
+    def q3_shaped(s):
+        fact = s.createDataFrame({"k": ks, "v": vs})
+        dim = s.createDataFrame({"k": dim_k, "w": dim_w})
+        return (fact.join(dim, on="k", how="inner")
+                .groupBy("k").agg(F.sum(col("v") + col("w")).alias("rev")))
+
+    def timed(q):
+        q.collect()                          # cold: compile
+        t0 = time.perf_counter()
+        rows = sorted(q.collect())
+        return rows, time.perf_counter() - t0
+
+    base_conf = {
+        "spark.rapids.tpu.sql.explain": "NONE",
+        "spark.rapids.tpu.sql.shuffle.partitions": "4",
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": "-1",
+        "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThreshold":
+            "4096",
+    }
+    counts = {"coalesce": 0, "skew-split": 0, "join-promote": 0,
+              "join-demote": 0}
+    surfaced = {"explain": set(), "log": set(), "telemetry": set()}
+
+    def note(session, log_rec=None):
+        """Fold one leg's decisions into the honesty tallies."""
+        applied = [d for d in session.last_aqe_decisions() if d["applied"]]
+        for d in applied:
+            if d["rule"] in counts:
+                counts[d["rule"]] += 1
+        text = session.explain_analyze()
+        for d in applied:
+            if f"* aqe {d['rule']}:" in text:
+                surfaced["explain"].add(d["rule"])
+        for rule, c in ((log_rec or {}).get("aqe", {})
+                        .get("rules", {}).items()):
+            if c.get("applied"):
+                surfaced["log"].add(rule)
+        return applied
+
+    # -- skew leg: AQE on (with query log) vs off ---------------------------
+    s_on = TpuSession.builder.config(dict(
+        base_conf, **{
+            "spark.rapids.tpu.sql.adaptive.enabled": "true",
+            "spark.rapids.tpu.sql.telemetry.queryLog.dir": log_dir,
+        })).getOrCreate()
+    rows_on, on_s = timed(q3_shaped(s_on))
+    log_rec = None
+    try:
+        lines = []
+        for p in glob.glob(os.path.join(log_dir, "query_log-*.jsonl")):
+            with open(p) as f:
+                lines += [json.loads(ln) for ln in f if ln.strip()]
+        log_rec = lines[-1] if lines else None
+    except Exception:
+        pass
+    note(s_on, log_rec)
+    s_off = TpuSession.builder.config(dict(
+        base_conf, **{
+            "spark.rapids.tpu.sql.adaptive.enabled": "false",
+            # same log overhead as the ON leg: the A/B compares planning,
+            # not artifact writes
+            "spark.rapids.tpu.sql.telemetry.queryLog.dir":
+                tempfile.mkdtemp(prefix="aqe_bench_log_off_"),
+        })).getOrCreate()
+    rows_off, off_s = timed(q3_shaped(s_off))
+
+    # -- ICI leg: the skewed stage falls back to DCN on repeat execution ----
+    ici_ok = False
+    ici_skipped = None
+    try:
+        import jax
+        if len(jax.devices()) < 2:
+            ici_skipped = (f"{len(jax.devices())} device(s): mesh needs a "
+                           "multi-device ICI plane")
+        else:
+            s_ici = TpuSession.builder.config(dict(
+                base_conf, **{
+                    "spark.rapids.tpu.sql.adaptive.enabled": "true",
+                    "spark.rapids.tpu.sql.mesh.enabled": "true",
+                    "spark.rapids.tpu.sql.shuffle.plane": "ici",
+                    "spark.rapids.tpu.sql.mesh.maxStageBytes": "1024",
+                })).getOrCreate()
+            q = q3_shaped(s_ici)
+            q.collect()                  # run 1 records the baseline
+            rows_ici = sorted(q.collect())
+            ici_ok = rows_ici == rows_on and any(
+                d["rule"] == "skew-split" and d["applied"] and
+                "[ici->dcn]" in str(d.get("after"))
+                for d in note(s_ici))
+    except Exception as e:
+        ici_skipped = str(e)[:120]
+
+    # -- join-switch legs: promote (observed small) / demote (observed big)
+    promote_demote_ok = True
+    try:
+        s_sw = TpuSession.builder.config({
+            "spark.rapids.tpu.sql.explain": "NONE",
+            "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": "65536",
+            "spark.rapids.tpu.sql.adaptive.enabled": "true",
+            # acceptance: the demoted re-planned stage must PASS contract
+            # validation in error mode
+            "spark.rapids.tpu.sql.analysis.validatePlan": "error",
+        }).getOrCreate()
+        big = s_sw.createDataFrame({"k": [i % 50 for i in range(2000)],
+                                    "v": [float(i) for i in range(2000)]})
+        # estimates say a 32k-row build side shuffles; the aggregate's
+        # observed output (50 groups) lands under threshold -> promote
+        small = (s_sw.createDataFrame(
+            {"k": [i % 50 for i in range(32000)],
+             "w": [float(i) for i in range(32000)]})
+            .groupBy("k").agg(F.sum(col("w")).alias("w")))
+        big.join(small, on="k", how="inner").collect()
+        note(s_sw)
+        # arrow-side estimates say broadcast; device strings pad to the
+        # max length, so the OBSERVED build blows the threshold -> demote
+        strs = ["x" * (2000 if i == 0 else 2) for i in range(200)]
+        fact = s_sw.createDataFrame({"k": [i % 200 for i in range(4000)],
+                                     "v": [float(i) for i in range(4000)]})
+        dim = s_sw.createDataFrame({"k": list(range(200)), "t": strs})
+        fact.join(dim, on="k", how="inner").select(
+            col("k"), col("v")).collect()
+        note(s_sw)
+    except Exception:
+        promote_demote_ok = False
+
+    # telemetry surface: every counted rule has a counter sample
+    try:
+        snap = MetricsRegistry.get().snapshot()["metrics"]
+        for sample in snap.get("tpu_aqe_decisions_total",
+                               {}).get("samples", ()):
+            surfaced["telemetry"].add(sample["labels"].get("rule"))
+    except Exception:
+        pass
+
+    need = set(counts)
+    ok = (_rows_close(rows_on, rows_off) and promote_demote_ok and
+          all(counts[r] >= 1 for r in need) and
+          need <= surfaced["explain"] and
+          need <= surfaced["telemetry"] and
+          # the query log leg only sees the skew/coalesce rules
+          {"coalesce", "skew-split"} <= surfaced["log"] and
+          (ici_ok or ici_skipped is not None))
+    out = {
+        "aqe_skew_q3_s": round(on_s, 4),
+        "aqe_off_q3_s": round(off_s, 4),
+        "aqe_ab_q3": round(on_s / off_s, 3) if off_s > 0 else None,
+        "aqe_decisions": dict(counts),
+        "aqe_ici_skew_split": ici_ok,
+        "aqe_ok": ok,
+    }
+    if ici_skipped:
+        out["aqe_ici_skipped"] = ici_skipped
+    return out
+
+
 def _pandas_query(query: str, li):
     import pandas as pd
     if query == "q6":
@@ -581,6 +770,16 @@ def main():
     except Exception as e:
         engine["chaos_error"] = str(e)[:120]
 
+    # adaptive execution (ISSUE 16): deliberately skewed q3-shaped join —
+    # AQE-on wall + on/off ratio ride the gate lower-is-better
+    aqe_bench = None
+    try:
+        aqe_bench = bench_aqe_skew(
+            200_000 if platform != "cpu" else 20_000)
+        engine.update(aqe_bench)
+    except Exception as e:
+        engine["aqe_error"] = str(e)[:120]
+
     bytes_per_row = 8 + 1 + 8 + 1 + 1            # key, kvalid, val, vvalid, flag
     gbytes_per_s = tpu_rows_per_s * bytes_per_row / 1e9
     # one-hot matmul flops: rows x slots x 2 (mul+add) x planned feature
@@ -661,6 +860,15 @@ def main():
             # every armed fault fired) — lower-is-better
             from benchmarks.history import CHAOS_Q6_RECOVERY_S
             queries[CHAOS_Q6_RECOVERY_S] = chaos["chaos_q6_recovery_s"]
+        if aqe_bench and aqe_bench.get("aqe_ok"):
+            # adaptive execution (ISSUE 16): stamped only when the
+            # honesty checks held (rows on == off, every rule applied
+            # at least once and visible on all decision surfaces) —
+            # both lower-is-better
+            from benchmarks.history import AQE_AB_Q3, AQE_SKEW_Q3_S
+            queries[AQE_SKEW_Q3_S] = aqe_bench["aqe_skew_q3_s"]
+            if aqe_bench.get("aqe_ab_q3"):
+                queries[AQE_AB_Q3] = aqe_bench["aqe_ab_q3"]
         gate = bh.stamp(
             "bench", queries, backend=line["backend"], degraded=degraded,
             error=probe.get("error") if degraded else None,

@@ -91,13 +91,24 @@ REPLAY_P50_S = "replay_p50_s"
 REPLAY_P99_S = "replay_p99_s"
 REPLAY_CHAOS_P99_S = "replay_chaos_p99_s"
 
+#: adaptive-execution series stamped by bench.py (ISSUE 16, docs/aqe.md):
+#: AQE_SKEW_Q3_S is the warm wall seconds of a deliberately skewed
+#: q3-shaped join+aggregate with the re-planner ON (lower is better);
+#: AQE_AB_Q3 is the AQE on/off wall ratio on that workload (lower is
+#: better; < 1 means adaptive re-planning pays for itself under skew).
+#: Stamped only when the bench's honesty checks pass (identical rows
+#: on/off, every decision rule applied and visible on every surface).
+AQE_SKEW_Q3_S = "aqe_skew_q3_s"
+AQE_AB_Q3 = "aqe_ab_q3"
+
 #: queries whose direction flips relative to their round's
 #: ``higherIsBetter`` flag (seconds-valued series riding a throughput
 #: round): recorded per entry so old history lines stay judgeable
 INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP,
                               WARM_TRAFFIC_Q6_S, CHAOS_Q6_RECOVERY_S,
                               REPLAY_P50_S, REPLAY_P99_S,
-                              REPLAY_CHAOS_P99_S})
+                              REPLAY_CHAOS_P99_S,
+                              AQE_SKEW_Q3_S, AQE_AB_Q3})
 
 #: default history file, committed with the repo so the gate has memory
 #: across rounds (each bench round is a fresh process)

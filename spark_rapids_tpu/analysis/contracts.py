@@ -470,6 +470,29 @@ def validate_cached_binding(root, params, validated_dtypes,
 _warned_once = False
 
 
+def validate_replan(root, mode: str) -> List[Violation]:
+    """Re-validate a RUNTIME re-planned subtree (plan/aqe.py): an AQE
+    coalesce/split/join-switch replacement must satisfy the same
+    contracts the planner's original tree did — a silent co-partitioning
+    or schema break here would produce wrong rows, not a crash. Same
+    policy knob as plan-time validation
+    (``spark.rapids.tpu.sql.analysis.validatePlan``): ``off`` skips,
+    ``warn`` logs, ``error`` raises :class:`PlanContractError` before
+    the replacement executes."""
+    mode = (mode or "warn").lower()
+    if mode == "off":
+        return []
+    violations = validate_plan(root)
+    if not violations:
+        return []
+    diag = ("! AQE re-planned stage failed contract validation\n"
+            + format_violations(violations))
+    if mode == "error":
+        raise PlanContractError(diag)
+    logger.warning("%s", diag)
+    return violations
+
+
 def enforce(root, meta, mode: str
             ) -> Tuple[Optional[str], List[Violation]]:
     """Run validation per ``mode``: returns ``(diagnostic text to append

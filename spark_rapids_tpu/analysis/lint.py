@@ -672,6 +672,88 @@ def check_querylog_keys(source: str, path: str) -> List[LintViolation]:
 
 
 # ---------------------------------------------------------------------------
+# adaptive-execution decision rules (aqe-decision rule)
+# ---------------------------------------------------------------------------
+
+#: module declaring the adaptive-execution rule surface
+AQE_MODULE = "plan/aqe.py"
+
+
+def aqe_declared_rules(source: str):
+    """The string names in ``AQE_RULES = (...)``, or None when the
+    module declares no such tuple."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if node.value is not None and any(
+                isinstance(t, ast.Name) and t.id == "AQE_RULES"
+                for t in targets):
+            return {n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant) and
+                    isinstance(n.value, str)}
+    return None
+
+
+def aqe_rule_usages(source: str):
+    """(line, rule) for every ``record_decision(node, "...")`` call with
+    a literal rule name, whether called bare or as ``aqe.record_decision``."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        named = (isinstance(fn, ast.Name) and fn.id == "record_decision") \
+            or (isinstance(fn, ast.Attribute) and
+                fn.attr == "record_decision")
+        if named and len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                isinstance(node.args[1].value, str):
+            out.append((node.lineno, node.args[1].value))
+    return out
+
+
+def check_aqe_rules(sources: Dict[str, Tuple[str, str]]
+                    ) -> List[LintViolation]:
+    """``aqe-decision``: every literal rule name passed to
+    ``plan/aqe.record_decision`` anywhere in the package is declared in
+    ``AQE_RULES`` — the telemetry-key discipline applied to the
+    adaptive-execution decision surface (EXPLAIN ANALYZE, query log,
+    ``tpu_aqe_decisions_total{rule}``)."""
+    decl_entry = sources.get(AQE_MODULE)
+    if decl_entry is None:
+        return []                          # no adaptive subsystem yet
+    decl_path, decl_src = decl_entry
+    declared = aqe_declared_rules(decl_src)
+    if declared is None:
+        return [LintViolation(
+            decl_path, 0, "aqe-decision",
+            "plan/aqe.py declares no AQE_RULES tuple — the adaptive "
+            "decision-rule surface must be declared")]
+    out: List[LintViolation] = []
+    for rel, (path, src) in sorted(sources.items()):
+        for line, rule in aqe_rule_usages(src):
+            if rule not in declared:
+                out.append(LintViolation(
+                    path, line, "aqe-decision",
+                    f"AQE decision rule {rule!r} is not declared in "
+                    "plan/aqe.AQE_RULES — declare it so the decision "
+                    "surface stays greppable"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # conf <-> docs agreement
 # ---------------------------------------------------------------------------
 
@@ -766,6 +848,8 @@ def run(package_dir: str, docs_dir: Optional[str] = None
             out.extend(lint_source(src, rel, path=full))
     # cross-module: registry metric names vs the TELEMETRY_KEYS surface
     out.extend(check_telemetry_keys(sources))
+    # cross-module: adaptive decision rules vs the AQE_RULES surface
+    out.extend(check_aqe_rules(sources))
     config_path = os.path.join(package_dir, "config.py")
     if docs_dir is None:
         docs_dir = os.path.join(os.path.dirname(package_dir), "docs")

@@ -382,6 +382,15 @@ class DataFrame:
         self.session._last_tenant = ctx.tenant
         from ..analysis import faults as _faults
         faults0 = _faults.fired_total()
+        # AQE pre-execution hook (plan/aqe.py): clear the prior run's
+        # decision records and fold stored observed cardinalities for
+        # this fingerprint back into est_rows (drift feedback).
+        # Best-effort — adaptive machinery must never fail the query.
+        try:
+            from ..plan import aqe
+            aqe.begin_query(self.session, exec_plan, serving)
+        except Exception:
+            pass
         t0 = time.perf_counter()
         with qc.query_scope(ctx):
             try:
@@ -399,6 +408,14 @@ class DataFrame:
                 dump_on_error(e)
                 raise
         self.session._last_execute_time_s = time.perf_counter() - t0
+        try:
+            # AQE post-execution hook: store observed cardinalities +
+            # exchange bytes under this fingerprint for the NEXT
+            # execution (drift feedback, admission cost weighting)
+            from ..plan import aqe
+            aqe.note_execution(self.session, exec_plan, serving)
+        except Exception:
+            pass
         try:
             from ..service.telemetry import MetricsRegistry
             MetricsRegistry.get().histogram(

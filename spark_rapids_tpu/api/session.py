@@ -119,6 +119,9 @@ def _annotated_plan_lines(plan, violations, conf=None) -> List[str]:
     from ..plan.estimates import drift_annotations
     for path, notes in drift_annotations(plan, conf=conf).items():
         by_path.setdefault(path, []).extend(notes)
+    from ..plan.aqe import aqe_annotations
+    for path, notes in aqe_annotations(plan).items():
+        by_path.setdefault(path, []).extend(notes)
     return plan.metrics_lines(
         annotate=lambda path: list(by_path.get(path, ())))
 
@@ -449,6 +452,18 @@ class TpuSession:
             raise RuntimeError("no plan executed yet")
         from ..shuffle.exchange import collect_stage_stats
         return collect_stage_stats(self._last_exec_plan)
+
+    def last_aqe_decisions(self) -> List[dict]:
+        """Adaptive-execution decisions of the last executed query, in
+        plan-tree order: per record the rule (coalesce / skew-split /
+        join-promote / join-demote / drift-feedback), whether it was
+        applied or declined, the owning operator + plan path, the
+        before/after shapes, and the reason (plan/aqe.py,
+        docs/aqe.md)."""
+        if self._last_exec_plan is None:
+            raise RuntimeError("no plan executed yet")
+        from ..plan.aqe import collect_decisions
+        return collect_decisions(self._last_exec_plan)
 
     def last_drift_report(self) -> List[dict]:
         """Estimate-vs-actual row drift of the last executed query, worst

@@ -366,6 +366,58 @@ SKEW_JOIN_THRESHOLD = _conf(
     "ShuffledBatchRDD.scala:202). 0 disables skew splitting."
 ).bytes_conf.create_with_default(256 * 1024 * 1024)
 
+ADAPTIVE_COALESCE_ENABLED = _conf(
+    "spark.rapids.tpu.sql.adaptive.coalescePartitions.enabled").doc(
+    "AQE rule toggle (plan/aqe.py, docs/aqe.md): merge small post-shuffle "
+    "partitions up to coalescePartitions.minPartitionSize from observed "
+    "map-side sizes. Subordinate to adaptive.enabled (ref: spark.sql."
+    "adaptive.coalescePartitions.enabled)"
+).boolean_conf.create_with_default(True)
+
+ADAPTIVE_SKEW_JOIN_ENABLED = _conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.enabled").doc(
+    "AQE rule toggle (plan/aqe.py, docs/aqe.md): split a shuffled join's "
+    "oversized stream partitions into mapper-subset tasks at runtime. "
+    "Subordinate to adaptive.enabled (ref: spark.sql.adaptive.skewJoin."
+    "enabled)").boolean_conf.create_with_default(True)
+
+ADAPTIVE_SKEW_FACTOR = _conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor").doc(
+    "A partition is skewed when its observed bytes exceed BOTH "
+    "skewedPartitionThreshold and this factor times the median partition "
+    "bytes of its exchange — the relative half of the skew test, so one "
+    "uniformly-large shuffle does not split everything (ref: spark.sql."
+    "adaptive.skewJoin.skewedPartitionFactor)").double_conf.check(
+        lambda v: float(v) >= 1.0).create_with_default(5.0)
+
+ADAPTIVE_JOIN_SWITCH_ENABLED = _conf(
+    "spark.rapids.tpu.sql.adaptive.joinSwitch.enabled").doc(
+    "AQE rule toggle (plan/aqe.py, docs/aqe.md): switch join strategy from "
+    "observed build-side size — promote shuffled->broadcast when the "
+    "materialized build lands at or under autoBroadcastJoinThreshold, "
+    "demote broadcast->shuffled when it lands over threshold x "
+    "joinSwitch.demoteFactor. Subordinate to adaptive.enabled"
+).boolean_conf.create_with_default(True)
+
+ADAPTIVE_JOIN_DEMOTE_FACTOR = _conf(
+    "spark.rapids.tpu.sql.adaptive.joinSwitch.demoteFactor").doc(
+    "Hysteresis band of the AQE join-strategy switch: a planned broadcast "
+    "only demotes to a shuffled join when its observed device bytes exceed "
+    "autoBroadcastJoinThreshold times this factor, and a shuffled join "
+    "only promotes at or under the bare threshold — observed sizes inside "
+    "(threshold, threshold*factor] change nothing, so a borderline build "
+    "side cannot flap between strategies across repeat executions"
+).double_conf.check(lambda v: float(v) >= 1.0).create_with_default(2.0)
+
+ADAPTIVE_FEEDBACK_ENABLED = _conf(
+    "spark.rapids.tpu.sql.adaptive.feedback.enabled").doc(
+    "AQE rule toggle (plan/aqe.py, docs/aqe.md): fold observed per-node "
+    "actual row counts back into est_rows on the next execution of the "
+    "same plan fingerprint, so plan-cache repeat queries estimate from "
+    "observed cardinalities instead of the static selectivity heuristics "
+    "(the cardinality-feedback loop over plan/estimates.py drift). "
+    "Subordinate to adaptive.enabled").boolean_conf.create_with_default(True)
+
 AUTO_BROADCAST_JOIN_THRESHOLD = _conf(
     "spark.rapids.tpu.sql.autoBroadcastJoinThreshold").doc(
     "Build sides at or under this many bytes broadcast (materialize once, "
@@ -705,6 +757,16 @@ SERVICE_DEFAULT_MEMORY_BYTES = _conf(
     "first at reserve/register boundaries, and its buffers are the "
     "global cascade's first victims (docs/service.md §3). 0 = "
     "unbudgeted"
+).bytes_conf.create_with_default(0)
+
+SERVICE_ADMISSION_EXPENSIVE_BYTES = _conf(
+    "spark.rapids.tpu.sql.service.admission.expensiveBytes").doc(
+    "Observed-cost admission weighting (docs/service.md, plan/aqe.py): a "
+    "plan fingerprint whose last execution shuffled more than this many "
+    "total exchange bytes charges one extra queue-depth unit per multiple "
+    "on its tenant's next admit — an observed-expensive repeat query "
+    "consumes budget proportional to what it actually cost, not a flat "
+    "1 unit. 0 disables cost weighting (every admit charges 1)"
 ).bytes_conf.create_with_default(0)
 
 PARSE_CACHE_MAX_ENTRIES = _conf(

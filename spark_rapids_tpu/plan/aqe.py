@@ -1,0 +1,542 @@
+"""Adaptive query execution: the runtime re-planner that closes the
+stats->plan loop (docs/aqe.md; the reference's AQE integration, SURVEY
+§2.6 and the query-stage prep rules of §3.2).
+
+PR 14 shipped the measurement half — per-partition rows/bytes/skew at
+every exchange materialization (``session.last_stage_stats()``) and the
+estimate-vs-actual drift report (plan/estimates.py). This module is the
+decision half: it consumes those observed statistics at stage
+materialization boundaries and re-plans the DOWNSTREAM stages before
+they run. Four rules, each behind a ``spark.rapids.tpu.sql.adaptive.*``
+conf (master switch ``adaptive.enabled``, per-rule toggles):
+
+* **coalesce** — group adjacent small post-shuffle partitions up to
+  ``adaptive.minPartitionSize`` observed bytes so downstream tasks don't
+  pay per-partition overhead for near-empty slices
+  (:func:`plan_coalesce`, wired into the exchange's reduce-group
+  planner).
+* **skew-split** — split reduce partitions whose observed bytes exceed
+  ``max(skewJoin.threshold, skewedPartitionFactor x median)`` into
+  mapper-subset tasks. On the ICI plane — where the device-resident
+  exchange has no per-slice host sizes to split on — the rule uses the
+  PRIOR execution's stage statistics for the same exchange fingerprint
+  (:func:`ici_skew_fallback`): a fingerprint observed skewed falls the
+  skewed stage only back to the DCN plane instead of declining outright.
+* **join-strategy switch** — promote shuffled->broadcast when the
+  observed build side lands under the broadcast threshold
+  (physical.py's ``_maybe_runtime_broadcast``), and DEMOTE
+  broadcast->shuffled when a planned broadcast build materializes over
+  ``threshold x joinSwitch.demoteFactor`` observed bytes
+  (:func:`maybe_demote_broadcast`). The factor is a hysteresis dead
+  band: a borderline build inside ``(threshold, threshold x factor]``
+  records a declined decision and changes nothing, so repeat executions
+  don't flap between strategies.
+* **drift feedback** — fold observed operator cardinalities back into
+  ``est_rows`` keyed by the serving plan fingerprint
+  (:func:`begin_query` / :func:`note_execution`), so the plan cache's
+  repeat queries plan from actuals instead of the 0.25-selectivity
+  heuristic.
+
+Every decision — applied or declined — is a structured
+:class:`AqeDecision` hung on the plan node that owns it: flight-recorded
+(kind ``aqe``), rendered per node in EXPLAIN ANALYZE
+(:func:`aqe_annotations`), written into the query log (the ``aqe``
+field), and counted in telemetry (``tpu_aqe_decisions_total{rule=...}``).
+Re-planned subtrees re-validate against the plan contracts
+(``analysis/contracts.validate_replan``) before they execute.
+
+The module also owns the observed-cost table behind service admission
+weighting (:func:`admission_cost_units`): a plan fingerprint observed
+moving many exchange bytes charges more queue slots against its
+tenant's budget on the next admit (docs/service.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis.contracts import exec_contract
+from .physical import TpuExec, exec_metrics
+
+log = logging.getLogger("spark_rapids_tpu.aqe")
+
+#: Every decision-rule string :func:`record_decision` may emit. The
+#: ``aqe-decision`` lint rule (analysis/lint.py) checks each literal
+#: rule argument in the package against this tuple — an undeclared rule
+#: string fails tier-1, mirroring the telemetry-key pattern.
+AQE_RULES: Tuple[str, ...] = (
+    "coalesce",
+    "skew-split",
+    "join-promote",
+    "join-demote",
+    "drift-feedback",
+)
+
+#: Test seam: when set, applied to a re-planned subtree BEFORE contract
+#: re-validation (the seeded-corruption error-mode test corrupts the
+#: replacement plan here and asserts validate_replan catches it).
+_REPLAN_CORRUPTION_HOOK: Optional[Callable[[Any], None]] = None
+
+
+# ---------------------------------------------------------------------------
+# Decision records
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AqeDecision:
+    """One adaptive decision (applied or declined) on one plan node."""
+
+    rule: str                        # one of AQE_RULES
+    applied: bool = True             # False = considered and declined
+    stage_id: Optional[int] = None
+    before: Any = None               # shape before the decision
+    after: Any = None                # shape after (None when declined)
+    reason: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "applied": self.applied,
+                "stageId": self.stage_id, "before": self.before,
+                "after": self.after, "reason": self.reason}
+
+
+def record_decision(node, rule: str, *, applied: bool = True,
+                    stage_id: Optional[int] = None, before: Any = None,
+                    after: Any = None, reason: str = "") -> AqeDecision:
+    """Record one decision on ``node``: appended to the node's
+    ``_aqe_decisions`` (EXPLAIN ANALYZE / query-log surface), flight-
+    recorded, and counted in ``tpu_aqe_decisions_total{rule}``."""
+    d = AqeDecision(rule, applied=applied, stage_id=stage_id,
+                    before=before, after=after, reason=reason)
+    if getattr(node, "_aqe_decisions", None) is None:
+        node._aqe_decisions = []
+    node._aqe_decisions.append(d)
+    try:
+        from ..service.telemetry import MetricsRegistry, flight_record
+        flight_record("aqe", rule, {
+            "applied": applied, "stageId": stage_id,
+            "operator": type(node).__name__,
+            "before": before, "after": after, "reason": reason})
+        MetricsRegistry.get().counter(
+            "tpu_aqe_decisions_total",
+            "adaptive-execution decisions (applied and declined)",
+            rule=rule).inc()
+    except Exception:
+        pass               # observability must never fail the decision
+    return d
+
+
+def clear_decisions(root) -> None:
+    """Drop every decision in the tree (fresh per execution; a cached
+    plan re-executing must not accumulate the prior run's records)."""
+    if getattr(root, "_aqe_decisions", None):
+        root._aqe_decisions = []
+    for c in getattr(root, "children", ()):
+        clear_decisions(c)
+
+
+def _walk_paths(node, path: str = "", idx: Optional[int] = None):
+    # same path convention as contracts.validate_plan / metrics_tree
+    here = (f"{path}/{idx}.{type(node).__name__}" if path
+            else type(node).__name__)
+    yield here, node
+    for i, c in enumerate(getattr(node, "children", ())):
+        yield from _walk_paths(c, here, i)
+
+
+def collect_decisions(root) -> List[Dict[str, Any]]:
+    """Every decision in an executed plan tree, in tree order, each
+    tagged with its operator and root->node path — the query-log ``aqe``
+    field and ``session.last_aqe_decisions()``'s data."""
+    out: List[Dict[str, Any]] = []
+    for here, node in _walk_paths(root):
+        for d in getattr(node, "_aqe_decisions", None) or ():
+            out.append({"operator": type(node).__name__, "path": here,
+                        **d.to_dict()})
+    return out
+
+
+def aqe_annotations(root) -> Dict[str, List[str]]:
+    """Per-node EXPLAIN ANALYZE lines keyed by plan path (the
+    ``_annotated_plan_lines`` merge format, api/session.py)."""
+    out: Dict[str, List[str]] = {}
+    for here, node in _walk_paths(root):
+        for d in getattr(node, "_aqe_decisions", None) or ():
+            if d.applied:
+                line = f"* aqe {d.rule}: {d.before} -> {d.after}"
+                if d.reason:
+                    line += f" ({d.reason})"
+            else:
+                line = f"* aqe {d.rule} declined: {d.reason}"
+            out.setdefault(here, []).append(line)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: coalesce small post-shuffle partitions
+# ---------------------------------------------------------------------------
+
+def plan_coalesce(sizes: List[int], target: int) -> List[List[int]]:
+    """Group ADJACENT reduce partitions up to ``target`` observed bytes:
+    each group accumulates consecutive partitions until it reaches the
+    target; an undersized tail merges into the last group. Adjacency
+    keeps the grouping a pure reader-side re-map (the reference's
+    CoalescedPartitionSpec over contiguous reducer ranges) — hash
+    disjointness is preserved because every input partition lands in
+    exactly one group."""
+    if target <= 0:
+        return [[p] for p in range(len(sizes))]
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for p, sz in enumerate(sizes):
+        cur.append(p)
+        cur_bytes += int(sz)
+        if cur_bytes >= target:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        if groups:
+            groups[-1].extend(cur)   # tail merges into the last group
+        else:
+            groups.append(cur)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Stage-statistics history (the cross-execution feed)
+# ---------------------------------------------------------------------------
+# Keyed by the exchange's structural plan fingerprint
+# (shuffle/exchange.plan_fingerprint): the same logical exchange re-
+# executing — a plan-cache repeat, or the second run of a benchmark —
+# finds what its previous materialization actually produced. This is
+# what lets the ICI plane make a skew decision BEFORE running its map
+# phase, where the device-resident path has nothing host-side to
+# measure.
+
+_history_mu = threading.Lock()
+_STAGE_HISTORY: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_STAGE_HISTORY_MAX = 512
+
+#: observed per-fingerprint query cost (total exchange bytes moved) —
+#: the service-admission weighting feed (admission_cost_units)
+_COSTS: "OrderedDict[str, int]" = OrderedDict()
+_COSTS_MAX = 512
+
+
+def note_stage_stats(node) -> None:
+    """Fold one exchange's just-committed ``stage_stats`` into the
+    fingerprint-keyed history (called at every materialization boundary;
+    exchanges without a structural fingerprint are skipped)."""
+    st = getattr(node, "stage_stats", None)
+    if not st or not hasattr(node, "plan_fingerprint"):
+        return
+    try:
+        fp = node.plan_fingerprint()
+    except Exception:
+        return
+    with _history_mu:
+        _STAGE_HISTORY.pop(fp, None)
+        _STAGE_HISTORY[fp] = dict(st)
+        while len(_STAGE_HISTORY) > _STAGE_HISTORY_MAX:
+            _STAGE_HISTORY.popitem(last=False)
+
+
+def observed_stage_stats(fingerprint: str) -> Optional[Dict[str, Any]]:
+    """The most recent stage statistics observed for an exchange
+    fingerprint, or None when it has never materialized here."""
+    with _history_mu:
+        st = _STAGE_HISTORY.get(fingerprint)
+        return dict(st) if st is not None else None
+
+
+def effective_skew_threshold(threshold: int, factor: Optional[float],
+                             median_bytes: float) -> int:
+    """The skew cut line: at least ``threshold`` bytes, raised to
+    ``factor x median`` when the factor-scaled median is higher — a
+    partition must be BOTH large in absolute terms and an outlier
+    relative to its siblings (the reference's skewedPartitionFactor x
+    median rule, OptimizeSkewedJoin)."""
+    eff = int(threshold)
+    if factor is not None and factor > 0 and median_bytes > 0:
+        eff = max(eff, int(float(factor) * float(median_bytes)))
+    return eff
+
+
+# ---------------------------------------------------------------------------
+# Rule 2 (ICI half): prior-stats skew fallback
+# ---------------------------------------------------------------------------
+
+def ici_skew_fallback(exchange, threshold: int,
+                      factor: Optional[float]) -> Tuple[bool, str]:
+    """Decide whether an exchange that WOULD take the ICI plane should
+    fall back to DCN so the skew splitter can run. The device-resident
+    exchange has no per-slice host sizes, so the decision reads the
+    PRIOR execution's stage statistics for the same structural
+    fingerprint: first execution declines (and records the baseline);
+    a repeat whose prior run observed a partition past the effective
+    threshold falls the skewed stage only back to the host plane."""
+    try:
+        fp = exchange.plan_fingerprint()
+    except Exception:
+        return False, "exchange has no structural fingerprint"
+    prior = observed_stage_stats(fp)
+    if prior is None:
+        return False, ("no prior stage stats for fingerprint "
+                       f"{fp} (first execution records the baseline)")
+    eff = effective_skew_threshold(threshold, factor,
+                                   prior.get("p50Bytes", 0.0))
+    mx = int(prior.get("maxBytes", 0))
+    if mx > eff:
+        return True, (f"prior run observed maxBytes={mx} > {eff} "
+                      f"(skew={prior.get('skew')}): skewed stage falls "
+                      "back to dcn")
+    return False, (f"prior run observed maxBytes={mx} <= {eff}: "
+                   "no skew to split")
+
+
+# ---------------------------------------------------------------------------
+# Rule 3 (demote half): broadcast -> shuffled at runtime
+# ---------------------------------------------------------------------------
+
+class _MaterializedBuildExec(TpuExec):
+    """An already-materialized broadcast build batch served as a
+    single-partition exec, so a demoted join can hash-exchange the build
+    side without recomputing it (the spillable handle stays owned by the
+    broadcast exchange; this node only reads it)."""
+
+    CONTRACT = exec_contract(schema="defined", partitioning="defined")
+    METRICS = exec_metrics()
+
+    def __init__(self, schema, handle):
+        super().__init__()
+        self._schema = schema
+        self._handle = handle
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def output_partitions(self) -> int:
+        return 1
+
+    def execute(self):
+        def gen():
+            batch = self._handle.get_batch()
+            if batch.num_rows > 0:
+                self.metrics.inc("numOutputRows", batch.num_rows)
+                yield batch
+        return [gen()]
+
+
+def _chained(group):
+    """One generator draining a group of partitions in order (the
+    demoted join's output re-packed to the planned partition count)."""
+    for part in group:
+        for batch in part:
+            yield batch
+
+
+def maybe_demote_broadcast(join, bx, handle):
+    """AQE join-strategy DEMOTION: the planner chose broadcast from
+    estimated build bytes, but the materialized build is observed over
+    ``threshold x demoteFactor`` device bytes — re-plan this join as a
+    co-partitioned shuffled join over DCN hash exchanges, reusing the
+    already-built batch as the build-side source. Returns the demoted
+    join's partitions (re-packed to the planned partition count) or None
+    when broadcast stands. An observed size inside the hysteresis dead
+    band ``(threshold, threshold x factor]`` records a declined decision
+    and keeps broadcast — repeat executions of a borderline build must
+    not flap between strategies."""
+    policy = getattr(join, "aqe_demote_policy", None)
+    if not policy:
+        return None
+    thr = policy.get("threshold")
+    factor = float(policy.get("factor", 2.0) or 2.0)
+    if thr is None or thr < 0:
+        return None
+    try:
+        observed = int(bx.metrics.resolve().get("dataSize", 0) or 0)
+    except Exception:
+        return None
+    if observed <= 0 or observed <= thr:
+        return None                    # broadcast stands, no record
+    stage_id = getattr(bx, "stage_id", None)
+    if join.how not in ("inner", "left", "left_semi", "left_anti"):
+        # a demoted right/full outer would need the full-outer single-
+        # partition merge the broadcast form already provides
+        record_decision(join, "join-demote", applied=False,
+                        stage_id=stage_id, before="broadcast",
+                        reason=f"how={join.how} cannot re-shuffle")
+        return None
+    if observed <= int(thr * factor):
+        record_decision(
+            join, "join-demote", applied=False, stage_id=stage_id,
+            before="broadcast",
+            reason=(f"observed build {observed}B in hysteresis band "
+                    f"({thr}B, {int(thr * factor)}B]: keeping broadcast"))
+        return None
+
+    from ..shuffle.exchange import TpuHashExchangeExec
+    from .physical import TpuShuffledJoinExec
+    n = max(1, int(policy.get("partitions", 0) or
+                   join.children[0].output_partitions))
+    build_src = _MaterializedBuildExec(bx.schema, handle)
+    # keys re-bind against identical child schemas; BoundReferences pass
+    # through bind_refs unchanged, so rebuilding from the join's bound
+    # keys is safe. The replacement carries NO aqe_broadcast_threshold:
+    # promoting it straight back would be the flap hysteresis exists to
+    # prevent.
+    rep = TpuShuffledJoinExec(
+        TpuHashExchangeExec(join.children[0], n, list(join.left_keys),
+                            plane="dcn"),
+        TpuHashExchangeExec(build_src, n, list(join.right_keys),
+                            plane="dcn"),
+        join.how, list(join.left_keys), list(join.right_keys),
+        join.condition)
+    hook = _REPLAN_CORRUPTION_HOOK
+    if hook is not None:
+        hook(rep)
+    from ..analysis import contracts
+    contracts.validate_replan(rep, policy.get("validate", "warn"))
+    record_decision(
+        join, "join-demote", stage_id=stage_id,
+        before="broadcast", after=f"shuffled[{n}]",
+        reason=(f"observed build {observed}B > threshold {thr}B x "
+                f"demoteFactor {factor}"))
+    join._aqe_demoted = rep
+    parts = rep.execute()
+    orig = max(1, int(join.output_partitions))
+    if len(parts) <= orig:
+        return parts
+    # re-pack to the partition count the parent planned around; strided
+    # groups keep hash disjointness (each input partition lands in
+    # exactly one output group)
+    groups = [parts[i::orig] for i in range(orig)]
+    return [_chained(g) for g in groups]
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: drift feedback (plan-cache repeats plan from actuals)
+# ---------------------------------------------------------------------------
+
+_FEEDBACK: "OrderedDict[str, Dict[str, int]]" = OrderedDict()
+_FEEDBACK_MAX = 256
+
+
+def fingerprint_key(serving: Optional[Dict[str, Any]]) -> Optional[str]:
+    fp = (serving or {}).get("fingerprint")
+    return repr(fp) if fp is not None else None
+
+
+def begin_query(session, exec_plan, serving) -> None:
+    """Pre-execution hook (dataframe collect): clear the prior run's
+    decision records tree-wide, then fold any stored observed
+    cardinalities for this serving fingerprint back into the plan's
+    ``est_rows`` — the drift-feedback rule. Best-effort: adaptive
+    machinery must never fail a query."""
+    try:
+        clear_decisions(exec_plan)
+        from .. import config as cfg
+        conf = session.conf
+        if not (conf.get(cfg.ADAPTIVE_ENABLED) and
+                conf.get(cfg.ADAPTIVE_FEEDBACK_ENABLED)):
+            return
+        key = fingerprint_key(serving)
+        if key is None:
+            return
+        with _history_mu:
+            actuals = dict(_FEEDBACK.get(key) or ())
+        if not actuals:
+            return
+        applied = 0
+        for here, node in _walk_paths(exec_plan):
+            rows = actuals.get(here)
+            if rows is not None and getattr(node, "est_rows", None) is not None:
+                if int(node.est_rows) != int(rows):
+                    node.est_rows = int(rows)
+                    applied += 1
+        if applied:
+            record_decision(
+                exec_plan, "drift-feedback",
+                before="estimated cardinalities", after=f"{applied} observed",
+                reason=(f"re-planned {applied} operator estimate(s) from "
+                        "the previous execution of this fingerprint"))
+    except Exception:
+        log.debug("aqe.begin_query failed", exc_info=True)
+
+
+def note_execution(session, exec_plan, serving) -> None:
+    """Post-execution hook: store this run's observed per-operator
+    cardinalities and total exchange bytes under the serving
+    fingerprint, feeding the NEXT execution's drift feedback and the
+    service-admission cost weighting. Best-effort."""
+    try:
+        key = fingerprint_key(serving)
+        if key is None:
+            return
+        actuals: Dict[str, int] = {}
+        for here, node in _walk_paths(exec_plan):
+            if getattr(node, "est_rows", None) is None:
+                continue
+            try:
+                rows = node.metrics.resolve().get("numOutputRows")
+            except Exception:
+                continue
+            if rows:
+                actuals[here] = int(rows)
+        cost = 0
+        from ..shuffle.exchange import collect_stage_stats
+        for st in collect_stage_stats(exec_plan):
+            cost += int(st.get("totalBytes", 0) or 0)
+        with _history_mu:
+            if actuals:
+                _FEEDBACK.pop(key, None)
+                _FEEDBACK[key] = actuals
+                while len(_FEEDBACK) > _FEEDBACK_MAX:
+                    _FEEDBACK.popitem(last=False)
+            _COSTS.pop(key, None)
+            _COSTS[key] = cost
+            while len(_COSTS) > _COSTS_MAX:
+                _COSTS.popitem(last=False)
+    except Exception:
+        log.debug("aqe.note_execution failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# Service-admission cost weighting (docs/service.md)
+# ---------------------------------------------------------------------------
+
+def observed_cost_bytes(fingerprint_key: Optional[str]) -> int:
+    """Total exchange bytes the fingerprint's last execution moved (0
+    when never observed)."""
+    if not fingerprint_key:
+        return 0
+    with _history_mu:
+        return int(_COSTS.get(fingerprint_key, 0))
+
+
+def admission_cost_units(fingerprint_key: Optional[str],
+                         expensive_bytes: int) -> int:
+    """Queue-slot cost of admitting a query whose plan fingerprint was
+    previously observed: ``1 + observedBytes // expensiveBytes``. An
+    unknown fingerprint — or cost weighting disabled
+    (``service.admission.expensiveBytes`` = 0) — charges the flat 1."""
+    if not expensive_bytes or expensive_bytes <= 0:
+        return 1
+    b = observed_cost_bytes(fingerprint_key)
+    if b <= 0:
+        return 1
+    return 1 + int(b) // int(expensive_bytes)
+
+
+def reset_for_tests() -> None:
+    """Drop every cross-execution table (unit-test isolation)."""
+    with _history_mu:
+        _STAGE_HISTORY.clear()
+        _FEEDBACK.clear()
+        _COSTS.clear()

@@ -836,7 +836,9 @@ class Overrides:
                 # key ownership) — the AQE shuffle-reader behavior
                 exch = TpuHashExchangeExec(
                     partial, self.conf.shuffle_partitions, keys,
-                    adaptive_ok=bool(self.conf.get(cfg.ADAPTIVE_ENABLED)),
+                    adaptive_ok=(
+                        bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and
+                        bool(self.conf.get(cfg.ADAPTIVE_COALESCE_ENABLED))),
                     adaptive_min_bytes=int(
                         self.conf.get(cfg.ADAPTIVE_MIN_PARTITION_BYTES)),
                     **xkw)
@@ -982,6 +984,19 @@ class Overrides:
                 stream, TpuBroadcastExchangeExec(build), how,
                 stream_keys, build_keys, residual)
             j.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
+            if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and \
+                    bool(self.conf.get(cfg.ADAPTIVE_JOIN_SWITCH_ENABLED)):
+                # AQE join-strategy demotion (plan/aqe.py): estimates said
+                # broadcast; a materialized build observed past threshold x
+                # demoteFactor re-plans as a co-partitioned shuffled join
+                j.aqe_demote_policy = {
+                    "threshold": threshold,
+                    "factor": float(
+                        self.conf.get(cfg.ADAPTIVE_JOIN_DEMOTE_FACTOR)),
+                    "partitions": self.conf.shuffle_partitions,
+                    "validate": str(
+                        self.conf.get(cfg.ANALYSIS_VALIDATE_PLAN)),
+                }
             return j
         from ..shuffle.exchange import TpuHashExchangeExec
         n = self.conf.shuffle_partitions
@@ -1015,7 +1030,9 @@ class Overrides:
             TpuHashExchangeExec(build, n, pk_build, **xkw),
             how, stream_keys, build_keys, residual)
         j.pipeline_depth = int(self.conf.get(cfg.JOIN_PIPELINE_DEPTH))
-        if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and threshold >= 0:
+        adaptive = bool(self.conf.get(cfg.ADAPTIVE_ENABLED))
+        if adaptive and threshold >= 0 and \
+                bool(self.conf.get(cfg.ADAPTIVE_JOIN_SWITCH_ENABLED)):
             # AQE: estimates said shuffle; observed map-side sizes may
             # overrule at runtime (physical._maybe_runtime_broadcast).
             # Multi-worker included: the runtime decision is made from the
@@ -1023,13 +1040,18 @@ class Overrides:
             # worker takes the same branch and a switch materializes the
             # complete build side from all peers' slices
             j.aqe_broadcast_threshold = threshold
-        if bool(self.conf.get(cfg.ADAPTIVE_ENABLED)) and not multiworker:
+            j.aqe_demote_factor = float(
+                self.conf.get(cfg.ADAPTIVE_JOIN_DEMOTE_FACTOR))
+        if adaptive and not multiworker and \
+                bool(self.conf.get(cfg.ADAPTIVE_SKEW_JOIN_ENABLED)):
             # AQE skew split: hot stream partitions spread across
             # mapper-subset tasks (local mode; partition->worker ownership
             # must stay fixed multi-worker)
             skew = int(self.conf.get(cfg.SKEW_JOIN_THRESHOLD))
             if skew > 0:
                 j.aqe_skew_threshold = skew
+                j.aqe_skew_factor = float(
+                    self.conf.get(cfg.ADAPTIVE_SKEW_FACTOR))
         return j
 
 

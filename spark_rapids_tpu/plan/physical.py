@@ -2655,6 +2655,11 @@ class TpuSortMergeJoinExec(TpuExec):
                              extras=("join_schema",))
     METRICS = exec_metrics("joinTime", "buildTime")
 
+    # AQE join-strategy demotion policy: dict(threshold, factor,
+    # partitions, validate) stamped by the planner on a broadcast-form
+    # join when adaptive execution is on (plan/aqe.py). None = off.
+    aqe_demote_policy: Optional[dict] = None
+
     def __init__(self, left: TpuExec, right: TpuExec, how: str,
                  left_keys: List[ex.Expression], right_keys: List[ex.Expression],
                  condition: Optional[ex.Expression] = None):
@@ -2703,9 +2708,19 @@ class TpuSortMergeJoinExec(TpuExec):
         # re-acquire it, so it can spill between partition tasks.
         from ..exec.spill import SpillableColumnarBatch
         from ..shuffle.exchange import TpuBroadcastExchangeExec
+        self._aqe_decisions = []       # fresh per execution (plan/aqe.py)
         bchild = self.children[1]
         if isinstance(bchild, TpuBroadcastExchangeExec):
             handle = bchild.materialize()
+            if getattr(self, "aqe_demote_policy", None):
+                # AQE join-strategy demotion: the planner chose broadcast
+                # from estimates, but the materialized build is observed
+                # oversized — re-plan as a co-partitioned shuffled join
+                # reusing the already-built batch (plan/aqe.py)
+                from . import aqe
+                demoted = aqe.maybe_demote_broadcast(self, bchild, handle)
+                if demoted is not None:
+                    return demoted
         else:
             # metered separately from the stream loop (the reference's
             # buildTime vs joinTime split, GpuMetricNames)
@@ -2728,6 +2743,10 @@ class TpuSortMergeJoinExec(TpuExec):
         if h is not None:
             h.close()
             self._build_handle = None
+        rep = getattr(self, "_aqe_demoted", None)
+        if rep is not None:
+            rep.cleanup()              # idempotent per exec contract
+            self._aqe_demoted = None
 
     def _pipeline_depth(self) -> int:
         """Join pipeline window depth: planner-set override (the session
@@ -2875,12 +2894,21 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
     # against the SAME build partition (OptimizeSkewedJoin +
     # GpuCustomShuffleReaderExec partial-mapper specs). None = off.
     aqe_skew_threshold: Optional[int] = None
+    # skewedPartitionFactor: raises the cut line to factor x median
+    # observed partition bytes when higher (plan/aqe.py). None = absolute
+    # threshold only.
+    aqe_skew_factor: Optional[float] = None
+    # joinSwitch.demoteFactor: the promote side of the hysteresis dead
+    # band — an observed build in (threshold, threshold x factor] records
+    # a declined decision and stays shuffled (no flapping)
+    aqe_demote_factor: Optional[float] = None
 
     @property
     def output_partitions(self) -> int:
         return self.children[0].output_partitions
 
     def execute(self) -> List[Partition]:
+        self._aqe_decisions = []       # fresh per execution (plan/aqe.py)
         switched, rparts = self._maybe_runtime_broadcast()
         if switched is not None:
             return switched
@@ -2907,15 +2935,39 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
         if thr is None or thr <= 0 or self.how in ("right", "full") or \
                 WorkerContext.current is not None:
             return None
+        from . import aqe
         sx = self.children[0]
         if not isinstance(sx, TpuShuffleExchangeExec):
             return None
         if sx.would_use_ici():
             # device-resident exchange (docs/shuffle.md): rows never stage
             # as host slices, so there are no per-slice observed sizes to
-            # split on — skew splitting is a host-plane feature
-            return None
-        sgroups = sx.execute_skew(thr)
+            # split on. The PRIOR execution's stage stats for the same
+            # exchange fingerprint can still prove skew — then the skewed
+            # stage only falls back to DCN (execute_skew forces the host
+            # plane); otherwise this run records the baseline and stays
+            # on the ICI plane.
+            fall_back, why = aqe.ici_skew_fallback(
+                sx, thr, getattr(self, "aqe_skew_factor", None))
+            if not fall_back:
+                aqe.record_decision(self, "skew-split", applied=False,
+                                    reason=f"ici plane: {why}")
+                return None
+            ici_fell_back = True
+        else:
+            ici_fell_back = False
+        sgroups = sx.execute_skew(thr,
+                                  getattr(self, "aqe_skew_factor", None))
+        hot = sum(1 for g in sgroups if len(g) > 1)
+        if hot:
+            aqe.record_decision(
+                self, "skew-split", stage_id=sx.stage_id,
+                before=f"{len(sgroups)} partitions"
+                       + (" [ici]" if ici_fell_back else ""),
+                after=(f"{hot} hot partition(s) split into "
+                       f"{sum(len(g) for g in sgroups)} tasks"
+                       + (" [ici->dcn]" if ici_fell_back else "")),
+                reason=f"observed partition bytes past threshold {thr}")
         if all(len(g) == 1 for g in sgroups):
             # nothing hot: fall through to the plain co-partitioned loop
             return [self._join_copart(g[0], bp)
@@ -2978,7 +3030,18 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
             # shuffle-id streams — and the fingerprint handshake would
             # abort the query)
             observed = ctx.allreduce_bytes(bx._shuffle.shuffle_id, observed)
+        from . import aqe
         if observed > thr:
+            f = float(getattr(self, "aqe_demote_factor", None) or 2.0)
+            if observed <= int(thr * f):
+                # hysteresis dead band: a borderline build must not flap
+                # between strategies across repeat executions
+                aqe.record_decision(
+                    self, "join-promote", applied=False,
+                    stage_id=bx.stage_id, before="shuffled",
+                    reason=(f"observed build {observed}B in hysteresis "
+                            f"band ({thr}B, {int(thr * f)}B]: staying "
+                            "shuffled"))
             # stay co-partitioned (stream exchange proceeds as planned)
             return None, bparts
         from ..exec.spill import SpillableColumnarBatch
@@ -2999,6 +3062,10 @@ class TpuShuffledJoinExec(TpuSortMergeJoinExec):
                                      accumulate_spillable(bparts))
         self._rt_broadcast = SpillableColumnarBatch(build)
         self.metrics.inc("runtimeBroadcastJoins")
+        aqe.record_decision(
+            self, "join-promote", stage_id=bx.stage_id,
+            before=f"shuffled[{len(bparts)}]", after="broadcast",
+            reason=f"observed build {observed}B <= threshold {thr}B")
 
         def gen(p):
             yield from self._join_part(p, self._rt_broadcast)

@@ -28,8 +28,22 @@ QUERY_LOG_FIELDS: Tuple[str, ...] = (
     "fingerprint", "planCache", "resultCache", "params",
     "stageStats", "stageWallS", "stageRetries", "fetchRetries",
     "faultsFired", "shufflePlanes", "hbmPeakBytes", "hbmPeakOperator",
-    "drift", "operators", "hostSyncs", "recompiles",
+    "drift", "operators", "hostSyncs", "recompiles", "aqe",
 )
+
+
+def aqe_summary(exec_plan) -> Dict[str, Any]:
+    """Adaptive-execution decisions reduced to the artifact shape:
+    per-rule applied/declined counts plus the full decision records
+    (plan/aqe.py; ``tools/query_report`` renders the per-query
+    section)."""
+    from ..plan.aqe import collect_decisions
+    decisions = collect_decisions(exec_plan)
+    rules: Dict[str, Dict[str, int]] = {}
+    for d in decisions:
+        e = rules.setdefault(d["rule"], {"applied": 0, "declined": 0})
+        e["applied" if d["applied"] else "declined"] += 1
+    return {"rules": rules, "decisions": decisions}
 
 
 def stage_summaries(exec_plan) -> list:
@@ -164,6 +178,7 @@ def build_record(session, exec_plan, serving: Dict[str, Any],
         "operators": _top_operators(exec_plan),
         "hostSyncs": int(sync.get("hostSyncs", 0) or 0),
         "recompiles": _metric_total(exec_plan, "recompiles"),
+        "aqe": aqe_summary(exec_plan),
     }
     return rec
 

@@ -93,6 +93,9 @@ class QueryTicket:
         self.deadline_at = deadline_at      # perf_counter timestamp
         self.seq = next(QueryTicket._seq)
         self.thunk = thunk
+        # admission cost in queue-depth units (plan/aqe.py observed-cost
+        # weighting; 1 = unweighted)
+        self.cost = 1
         self.submitted_at = time.perf_counter()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -202,6 +205,11 @@ class QueryService:
         self._cond = threading.Condition(self._mu)  # lint: raw-lock-ok condition OVER the named service lock; wait/notify not expressible through NamedLock alone
         self._queue: List[QueryTicket] = []
         self._tenants: Dict[str, _TenantState] = {}
+        # label -> serving fingerprint key learned from completed
+        # executions: the bridge from a submission (which only has the
+        # label) to AQE's observed-cost table (which keys on the plan
+        # fingerprint). GIL-atomic dict ops; advisory only.
+        self._label_fp: Dict[str, str] = {}
         self._closed = False
         for spec in tenants:
             self.register_tenant(spec)
@@ -294,23 +302,52 @@ class QueryService:
             time.perf_counter() + deadline_s if deadline_s is not None
             else None,
             self._thunk_for(query, params))
+        ticket.cost = self._admission_cost(label)
         with self._cond:
             if self._closed:
                 raise AdmissionRejected(tenant, "service is closed")
-            if state.queued >= state.max_queue_depth:
+            if state.queued + ticket.cost > state.max_queue_depth:
                 state.rejected += 1
                 self._count("tpu_tenant_rejected_total", tenant)
                 flight_record("admission", "queue-full",
                               {"tenant": tenant, "label": label,
-                               "depth": state.queued})
+                               "depth": state.queued,
+                               "cost": ticket.cost})
                 raise AdmissionRejected(
-                    tenant, f"queue depth {state.queued} at bound "
+                    tenant, f"queue depth {state.queued} + cost "
+                            f"{ticket.cost} past bound "
                             f"{state.max_queue_depth} (load shed)")
             self._queue.append(ticket)
-            state.queued += 1
+            state.queued += ticket.cost
             self._gauge("tpu_tenant_queue_depth", tenant, state.queued)
             self._cond.notify()
+        if ticket.cost > 1:
+            # observed-expensive fingerprint: the extra units charged
+            # against the tenant's queue bound, beyond the flat 1
+            self._count("tpu_admission_cost_debits_total", tenant,
+                        ticket.cost - 1)
+            flight_record("admission", "cost-weighted",
+                          {"tenant": tenant, "label": label,
+                           "cost": ticket.cost})
         return ticket
+
+    def _admission_cost(self, label: str) -> int:
+        """Queue-depth units this submission charges: 1, or more when
+        its label's last execution was OBSERVED expensive
+        (``service.admission.expensiveBytes``; plan/aqe.py keeps the
+        fingerprint-keyed cost table, ROADMAP item 1's closing
+        clause)."""
+        from .. import config as cfg
+        try:
+            expensive = int(self.session.conf.get(
+                cfg.SERVICE_ADMISSION_EXPENSIVE_BYTES))
+            if expensive <= 0:
+                return 1
+            from ..plan import aqe
+            return aqe.admission_cost_units(self._label_fp.get(label),
+                                            expensive)
+        except Exception:
+            return 1           # cost weighting must never block submit
 
     # -- scheduling ----------------------------------------------------------
     def _pop_eligible_locked(self) -> Optional[QueryTicket]:
@@ -326,7 +363,7 @@ class QueryService:
         for t in expired:
             self._queue.remove(t)
             state = self._tenants[t.tenant]
-            state.queued -= 1
+            state.queued -= t.cost
             state.deadline_expired += 1
             self._gauge("tpu_tenant_queue_depth", t.tenant, state.queued)
             flight_record("admission", "deadline-shed",
@@ -358,7 +395,7 @@ class QueryService:
                 if ticket is None:          # closed and drained
                     return
                 state = self._tenants[ticket.tenant]
-                state.queued -= 1
+                state.queued -= ticket.cost
                 state.running += 1
                 state.admitted += 1
                 ticket.started_at = time.perf_counter()
@@ -388,6 +425,16 @@ class QueryService:
                 with tenant_scope(ticket.tenant):
                     out = ticket.thunk()
                 ticket.query_id = qc.thread_last_query_id()
+                try:
+                    # learn this label's plan fingerprint so the NEXT
+                    # submit can charge its observed cost (plan/aqe.py)
+                    from ..plan import aqe, plan_cache as pc
+                    fpk = aqe.fingerprint_key(pc.thread_serving())
+                    if fpk is not None:
+                        with self._cond:
+                            self._label_fp[ticket.label] = fpk
+                except Exception:
+                    pass
                 ticket._finish(result=out)
                 ok = True
             except BaseException as e:      # typed failure rides the ticket
@@ -475,7 +522,7 @@ class QueryService:
             for t in pending:
                 st = self._tenants.get(t.tenant)
                 if st is not None:
-                    st.queued -= 1
+                    st.queued -= t.cost
                     self._gauge("tpu_tenant_queue_depth", t.tenant,
                                 st.queued)
                 t._finish(exc=ServiceClosed(

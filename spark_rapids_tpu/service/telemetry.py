@@ -136,6 +136,10 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_tenant_rejected_total",        # load sheds, label tenant=<name>
     "tpu_tenant_device_bytes",          # gauge, harvested, label tenant
     "tpu_query_queue_seconds",          # histogram, label tenant=<name>
+    # adaptive query execution (plan/aqe.py, docs/aqe.md)
+    "tpu_aqe_decisions_total",          # counter, label rule=<AQE_RULES>
+    "tpu_admission_cost_debits_total",  # extra queue slots charged, label
+                                        # tenant=<name>
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,

@@ -156,6 +156,7 @@ def assign_stage(node) -> None:
     node.query_id = ctx.query_id if ctx is not None else None
     node.stage_id = ctx.next_stage_id() if ctx is not None else None
     node.stage_stats = None            # fresh per execution
+    node._aqe_decisions = []           # fresh per execution (plan/aqe.py)
 
 
 def record_local_shuffle_stats(node, shuffle) -> None:
@@ -182,6 +183,19 @@ def record_local_shuffle_stats(node, shuffle) -> None:
     node.stage_stats = compute_stage_stats(
         node.stage_id, "dcn", rows, bytes_, query_id=node.query_id)
     publish_stage_stats(node.stage_stats)
+    _note_aqe_stats(node)
+
+
+def _note_aqe_stats(node) -> None:
+    """Feed one committed materialization into AQE's fingerprint-keyed
+    stage history (plan/aqe.py) — what lets a repeat execution of the
+    same structural exchange decide from observed shape before its map
+    phase runs (the ICI skew fallback). Best-effort."""
+    try:
+        from ..plan import aqe
+        aqe.note_stage_stats(node)
+    except Exception:
+        pass               # the history feed must never fail the exchange
 
 
 def collect_stage_stats(root) -> List[Dict[str, Any]]:
@@ -519,6 +533,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.stage_stats = compute_stage_stats(
             self.stage_id, plane, rows, bytes_, query_id=self.query_id)
         publish_stage_stats(self.stage_stats)
+        _note_aqe_stats(self)
 
     def _record_local_stats(self, shuffle: "LocalShuffle") -> None:
         record_local_shuffle_stats(self, shuffle)
@@ -699,7 +714,9 @@ class TpuShuffleExchangeExec(TpuExec):
 
         return [gen(p) for p in range(self.num_partitions)]
 
-    def execute_skew(self, threshold: int) -> List[List[Partition]]:
+    def execute_skew(self, threshold: int,
+                     factor: Optional[float] = None
+                     ) -> List[List[Partition]]:
         """AQE skew-split form of :meth:`execute` (local mode): run the
         map phase, then return per reduce partition a LIST of
         sub-partitions — one when under ``threshold`` observed bytes,
@@ -717,28 +734,38 @@ class TpuShuffleExchangeExec(TpuExec):
         self.plane_used = "dcn"       # skew split is a host-plane feature
         shuffle = self._local_map_with_retry()
         self._record_local_stats(shuffle)
+        # effective cut line: at least ``threshold`` bytes, raised to
+        # ``factor x median partition bytes`` when that is higher — a
+        # partition must be both large AND an outlier among its siblings
+        # (plan/aqe.py's skewedPartitionFactor rule)
+        totals = [sum(s.size_bytes for s in shuffle.slices[p])
+                  for p in range(self.num_partitions)]
+        import statistics
+        from ..plan import aqe
+        median = float(statistics.median(totals)) if totals else 0.0
+        eff = aqe.effective_skew_threshold(threshold, factor, median)
         out: List[List[Partition]] = []
         for p in range(self.num_partitions):
             sizes = [s.size_bytes for s in shuffle.slices[p]]
-            total = sum(sizes)
-            if total <= threshold:
+            total = totals[p]
+            if total <= eff:
                 out.append([self._read_group(shuffle, [p])])
                 continue
             if len(sizes) < 2:
                 # one giant map slice: split by row ranges instead
-                n_chunks = min(-(-total // threshold), 64)
+                n_chunks = min(-(-total // eff), 64)
                 chunks = [shuffle.read_row_chunk(p, 0, c, n_chunks,
                                                  self.schema)
                           for c in range(n_chunks)]
             else:
                 # split on slice (mapper-output) boundaries into chunks
-                # of ~threshold bytes, at least one slice each
+                # of ~eff bytes, at least one slice each
                 chunks = []
                 lo = 0
                 acc = 0
                 for i, sz in enumerate(sizes):
                     acc += sz
-                    if acc >= threshold and i + 1 > lo:
+                    if acc >= eff and i + 1 > lo:
                         chunks.append(shuffle.read_slices(p, lo, i + 1,
                                                           self.schema))
                         lo, acc = i + 1, 0
@@ -883,31 +910,28 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def _reduce_groups(self, shuffle: LocalShuffle) -> List[List[int]]:
         """Adaptive partition coalescing: group adjacent reduce partitions
-        below minPartitionSize using the map side's observed slice sizes."""
+        below minPartitionSize using the map side's observed slice sizes
+        (the grouping itself is plan/aqe.py's coalesce rule; this method
+        feeds it the observations and records the decision)."""
         all_parts = [[p] for p in range(self.num_partitions)]
         if not self.adaptive_ok or not self.adaptive_min_bytes:
             return all_parts
         target = int(self.adaptive_min_bytes)
         sizes = [sum(s.size_bytes for s in shuffle.slices[p])
                  for p in range(self.num_partitions)]
-        groups: List[List[int]] = []
-        cur: List[int] = []
-        cur_bytes = 0
-        for p, sz in enumerate(sizes):
-            cur.append(p)
-            cur_bytes += sz
-            if cur_bytes >= target:
-                groups.append(cur)
-                cur, cur_bytes = [], 0
-        if cur:
-            if groups:
-                groups[-1].extend(cur)   # tail merges into the last group
-            else:
-                groups.append(cur)
+        from ..plan import aqe
+        groups = aqe.plan_coalesce(sizes, target)
         self.coalesced_to = len(groups)
         if len(groups) < self.num_partitions:
             self.metrics.inc("coalescedPartitions",
                              self.num_partitions - len(groups))
+            aqe.record_decision(
+                self, "coalesce", stage_id=self.stage_id,
+                before=f"{self.num_partitions} partitions",
+                after=f"{len(groups)} partitions",
+                reason=(f"observed {sum(sizes)}B across "
+                        f"{self.num_partitions} partitions; target "
+                        f"{target}B per task"))
         return groups
 
     def _read_group(self, shuffle: LocalShuffle, group: List[int]) -> Partition:

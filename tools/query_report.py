@@ -7,9 +7,10 @@ Usage::
 Reads one or more structured query-log files (conf
 ``spark.rapids.tpu.sql.telemetry.queryLog.dir``, service/query_log.py)
 and prints, per query id: the headline (wall, rows, cache verdicts), the
-top operators by time, the skewest exchange, the worst
-estimate-vs-actual drift, and retries/faults — the "what happened in
-this CI artifact" answer without opening JSON by hand. Records from
+top operators by time, the skewest exchange, the adaptive-execution
+decisions (docs/aqe.md), the worst estimate-vs-actual drift, and
+retries/faults — the "what happened in this CI artifact" answer without
+opening JSON by hand. Records from
 multiple workers sharing a query id (a distributed run) merge into one
 digest with per-worker stage lines.
 """
@@ -60,6 +61,19 @@ def _worst_drift(records: List[dict]) -> dict:
     return best or {}
 
 
+def _aqe_rules(records: List[dict]) -> Dict[str, dict]:
+    """rule -> merged applied/declined counts across worker records
+    (the ``aqe`` record field, plan/aqe.py)."""
+    out: Dict[str, dict] = {}
+    for rec in records:
+        for rule, counts in ((rec.get("aqe") or {}).get("rules")
+                             or {}).items():
+            e = out.setdefault(rule, {"applied": 0, "declined": 0})
+            e["applied"] += int(counts.get("applied", 0) or 0)
+            e["declined"] += int(counts.get("declined", 0) or 0)
+    return out
+
+
 def digest(query_id: str, records: List[dict], top: int = 5) -> str:
     """One query's digest text from its (possibly multi-worker)
     records."""
@@ -105,6 +119,19 @@ def digest(query_id: str, records: List[dict], top: int = 5) -> str:
             f"p50Bytes={int(sk.get('p50Bytes', 0))} "
             f"maxBytes={sk.get('maxBytes')} "
             f"partitions={sk.get('partitions')}")
+    aqe = _aqe_rules(records)
+    if aqe:
+        lines.append("  aqe decisions: " + "  ".join(
+            f"{rule}={e['applied']}"
+            + (f"(+{e['declined']} declined)" if e["declined"] else "")
+            for rule, e in sorted(aqe.items())))
+        applied = [d for rec in records
+                   for d in ((rec.get("aqe") or {}).get("decisions")
+                             or ()) if d.get("applied")]
+        for d in applied[:top]:
+            lines.append(f"    {d.get('rule')} @ {d.get('operator')}: "
+                         f"{d.get('before')} -> {d.get('after')} "
+                         f"({d.get('reason')})")
     wd = _worst_drift(records)
     if wd:
         lines.append(
