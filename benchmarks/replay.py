@@ -22,8 +22,10 @@ measurement:
 
 Artifact series (benchmarks/history.py, kind ``replay``):
 ``replay_qps`` (higher better), ``replay_p50_s`` / ``replay_p99_s``
-(submit->result latency percentiles, lower better), and
-``replay_chaos_p99_s`` for the chaos mode. Stamped only when every
+(submit->result latency percentiles, lower better),
+``first_row_p99_s`` (submit->FIRST-BATCH p99 of the streaming leg's
+``submit_stream`` traffic, lower better), and ``replay_chaos_p99_s``
+for the chaos mode. Stamped only when every
 query returned oracle-correct rows (and, under chaos, every armed fault
 fired) — a wrong-answer replay is void, not fast.
 
@@ -177,9 +179,21 @@ def run_replay(sf: float = 0.002, streams: int = 4,
                    memory_budget_bytes=256 << 20)])
 
     latencies: List[float] = []
+    first_rows: List[float] = []
     wrong: List[str] = []
     errors: List[str] = []
     lat_mu = threading.Lock()  # lint: raw-lock-ok bench-local result list, dies with the run
+
+    # streaming leg (fault-free mode): per stream, a few queries go
+    # through submit_stream and the submit->FIRST-BATCH wall is measured
+    # — the time-to-first-row number the streaming collect exists to
+    # shrink (ISSUE 17; stamped as first_row_p99_s). Oracle rows come
+    # from the same frames' materializing collect.
+    streaming_per_stream = 0 if faults else max(1, queries_per_stream // 3)
+    stream_oracle: Dict[str, list] = {}
+    if streaming_per_stream:
+        stream_oracle = {k: Q.QUERIES[k](tables).collect()
+                         for k in ("q1", "q6")}
 
     def stream_body(s: int) -> None:
         # one PreparedStatement per shape PER STREAM: a statement binds
@@ -210,6 +224,29 @@ def run_replay(sf: float = 0.002, streams: int = 4,
                 latencies.append(ticket.latency_s())
                 if not ok:
                     wrong.append(f"s{s}-{i}-{kind}")
+        for j in range(streaming_per_stream):
+            kind = "q6" if (s + j) % 2 == 0 else "q1"
+            ticket = svc.submit_stream(tenant, Q.QUERIES[kind](tables),
+                                       label=f"s{s}-stream{j}-{kind}")
+            rows = []
+            fr = None
+            try:
+                for b in ticket.stream():
+                    if fr is None:
+                        fr = time.perf_counter() - ticket.submitted_at
+                    rows.extend(b.rows())
+                ticket.result(timeout=600)
+            except Exception as e:
+                with lat_mu:
+                    errors.append(f"s{s}-stream{j}-{kind}: "
+                                  f"{type(e).__name__}: {e}"[:200])
+                continue
+            ok = _rows_close(rows, stream_oracle[kind])
+            with lat_mu:
+                if fr is not None:
+                    first_rows.append(fr)
+                if not ok:
+                    wrong.append(f"s{s}-stream{j}-{kind}")
 
     retries0 = retries_total()
     armed = 0
@@ -233,11 +270,14 @@ def run_replay(sf: float = 0.002, streams: int = 4,
     stage_retries = retries_total() - retries0
 
     total = streams * queries_per_stream
+    expected_streaming = streams * streaming_per_stream
     latencies.sort()
+    first_rows.sort()
     qps = len(latencies) / wall if wall > 0 else 0.0
     p50 = _percentile(latencies, 0.50)
     p99 = _percentile(latencies, 0.99)
     ok = (not wrong and not errors and len(latencies) == total and
+          len(first_rows) == expected_streaming and
           (not faults or (fired >= armed and stage_retries >= 1)))
     line: Dict = {
         "metric": "traffic replay",
@@ -256,6 +296,10 @@ def run_replay(sf: float = 0.002, streams: int = 4,
         "replay_ok": ok,
         "service": svc.stats(),
     }
+    if expected_streaming:
+        line["streaming_queries"] = len(first_rows)
+        line["first_row_p50_s"] = round(_percentile(first_rows, 0.50), 4)
+        line["first_row_p99_s"] = round(_percentile(first_rows, 0.99), 4)
     if wrong:
         line["wrong_results"] = wrong[:10]
     if errors:
@@ -273,6 +317,8 @@ def run_replay(sf: float = 0.002, streams: int = 4,
             queries = {bh.REPLAY_QPS: line["replay_qps"],
                        bh.REPLAY_P50_S: line["replay_p50_s"],
                        bh.REPLAY_P99_S: line["replay_p99_s"]}
+            if expected_streaming:
+                queries[bh.FIRST_ROW_P99_S] = line["first_row_p99_s"]
         gate = bh.stamp("replay", queries, backend=line["backend"],
                         higher_is_better=True,
                         meta={"sf": sf, "streams": streams,

@@ -26,7 +26,8 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
                   trace_dir: Optional[str] = None,
                   probe_timeout_s: float = 30.0,
                   history_path: Optional[str] = None,
-                  compile_cache_dir: Optional[str] = None) -> Dict:
+                  compile_cache_dir: Optional[str] = None,
+                  prewarm: bool = False) -> Dict:
     import os
     # device preflight BEFORE any engine/jax use: a dead tunnel degrades
     # this run to an explicit cpu-degraded measurement instead of hanging
@@ -55,7 +56,23 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         # persistent compile cache: repeated runner invocations against
         # the same dir pay disk hits instead of cold builds
         "spark.rapids.tpu.sql.compile.cacheDir",
-        compile_cache_dir or "").getOrCreate()
+        compile_cache_dir or "").config(
+        # cache prewarm (docs/compile.md §5): bootstrap replays the
+        # hottest fused-stage signatures from the corpus beside the
+        # signature index onto the background compile pool, so a fresh
+        # process serves known queries with zero query-triggered builds
+        "spark.rapids.tpu.sql.compile.prewarm.enabled",
+        "true" if (prewarm and compile_cache_dir) else
+        "false").getOrCreate()
+    prewarm_info = None
+    if prewarm and compile_cache_dir:
+        # wait for the bootstrap-submitted prewarm builds BEFORE the
+        # query loop: cold_s below then measures a genuinely prewarmed
+        # first touch, and the honesty check (zero query-triggered cold
+        # compiles) is meaningful
+        from spark_rapids_tpu.exec import compile_pool
+        compile_pool.drain(timeout_s=120.0)
+        prewarm_info = compile_pool.stats()
     if trace_dir:
         # defensive: --trace-dir may name a nested path that does not
         # exist yet; a failed trace write must never fail the run
@@ -264,6 +281,44 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         report["regression_overall"] = gate["overall"]
     except Exception as e:        # the gate must not kill the report
         report["regression_error"] = str(e)[:200]
+    # cold-path series (ISSUE 17, docs/compile.md §5): with --prewarm
+    # against a warmed cache dir, q6's FIRST iteration in this fresh
+    # process is the cold_q6_s measurement. Stamped only when the
+    # honesty checks pass: rows came back and the query thread paid
+    # ZERO cold compiles (the builds all landed at prewarm time).
+    if prewarm_info is not None:
+        report["prewarm"] = prewarm_info
+        try:
+            from . import history as bh
+            e = report["queries"].get("q6")
+            if e is not None:
+                comp = e.get("compile", {}) or {}
+                honest = (comp.get("coldCompiles", 0) == 0
+                          and e.get("rows", 0) > 0
+                          and report["backend"] != "cpu-degraded")
+                report["cold_path"] = {
+                    "coldQ6S": e["cold_s"],
+                    "queryColdCompiles": comp.get("coldCompiles", 0),
+                    "queryDiskHits": comp.get("diskHits", 0),
+                    "prewarmBuilt": prewarm_info.get("prewarmBuilt", 0),
+                    "honest": honest,
+                }
+                if honest:
+                    bh.stamp(
+                        "cold_path",
+                        {bh.COLD_Q6_S: e["cold_s"]},
+                        backend=report["backend"],
+                        higher_is_better=False,
+                        meta={"rows": e.get("rows", 0),
+                              "prewarmBuilt":
+                                  prewarm_info.get("prewarmBuilt", 0),
+                              "asyncBuilt":
+                                  prewarm_info.get("asyncBuilt", 0),
+                              "queryColdCompiles":
+                                  comp.get("coldCompiles", 0)},
+                        path=history_path)
+        except Exception as e:
+            report["cold_path_error"] = str(e)[:200]
     # process-telemetry registry snapshot rides the artifact (parity
     # with BENCH/MULTICHIP tails): semaphore/lockdep/sync/recompile/
     # spill/shuffle/HBM numbers for this whole run
@@ -337,6 +392,12 @@ def main():
                     help="persistent compile cache directory "
                          "(spark.rapids.tpu.sql.compile.cacheDir): repeat "
                          "runs against the same dir pay zero cold compiles")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="compile the hottest recorded fused-stage "
+                         "signatures on the background pool before the "
+                         "query loop (requires --compile-cache-dir with a "
+                         "prior run's corpus); stamps cold_q6_s when the "
+                         "honesty checks pass")
     args = ap.parse_args()
     report = run_benchmark(args.sf,
                            args.queries.split(",") if args.queries else None,
@@ -346,7 +407,8 @@ def main():
                            trace_dir=args.trace_dir,
                            probe_timeout_s=args.probe_timeout,
                            history_path=args.history,
-                           compile_cache_dir=args.compile_cache_dir)
+                           compile_cache_dir=args.compile_cache_dir,
+                           prewarm=args.prewarm)
     print(json.dumps(report, indent=2))
 
 

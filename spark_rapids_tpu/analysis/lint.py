@@ -74,7 +74,7 @@ from typing import Dict, List, Optional, Tuple
 # hot-path membership by path relative to the spark_rapids_tpu package
 HOT_PATH_PREFIXES = ("ops/", "exec/", "shuffle/")
 HOT_PATH_FILES = ("plan/physical.py", "plan/stage_compiler.py",
-                  "service/server.py")
+                  "service/server.py", "exec/compile_pool.py")
 
 # (relative module, enclosing qualname): sanctioned sync helpers — the
 # batched readback funnels every other site must go through

@@ -380,6 +380,7 @@ class DataFrame:
         # record and session surface carry it after the scope closes
         ctx = qc.QueryContext(qid)
         self.session._last_tenant = ctx.tenant
+        self.session._last_first_row_s = None
         from ..analysis import faults as _faults
         faults0 = _faults.fired_total()
         # AQE pre-execution hook (plan/aqe.py): clear the prior run's
@@ -408,6 +409,10 @@ class DataFrame:
                 dump_on_error(e)
                 raise
         self.session._last_execute_time_s = time.perf_counter() - t0
+        # a materializing collect serves its first row when it serves its
+        # last: firstRowS == executeTimeS, honestly (collect_iter is the
+        # path that beats it; docs/observability.md)
+        self.session._last_first_row_s = self.session._last_execute_time_s
         try:
             # AQE post-execution hook: store observed cardinalities +
             # exchange bytes under this fingerprint for the NEXT
@@ -454,6 +459,133 @@ class DataFrame:
         except Exception:
             pass
         return out
+
+    def collect_iter(self):
+        """Streaming collect: yield host-resident batches as partitions
+        drain (one batch per partition, in partition order) instead of
+        materializing the whole result — the consumer sees first rows in
+        first-partition time (docs/observability.md firstRowS). The
+        concatenated rows of the yielded batches are IDENTICAL to
+        ``collect()``'s, in the same order.
+
+        The generator owns the full query lifecycle: closing it early
+        releases the plan-cache entry, cancels undrained partitions,
+        waits for running drains so staging arenas release, and still
+        writes the query-log record. While the stream is live, cold
+        fused-stage builds route to the background compile pool and
+        batches flow through the per-op eager path until the compiled
+        program swaps in (docs/compile.md §5). Streaming results are
+        never stored in the result cache (an exact-repeat hit is still
+        SERVED, as a single batch)."""
+        from ..plan import plan_cache as pc
+        try:
+            exec_plan = self._execute()
+        except BaseException:
+            pc.release_plan_entry(pc.thread_serving())
+            raise
+        serving = pc.thread_serving() or {}
+        try:
+            hit = pc.serve_result_hit(self.session, serving)
+            if hit is not None:
+                self.session._last_first_row_s = 0.0
+                yield hit
+                return
+            for batch in self._collect_iter_planned(exec_plan, serving):
+                yield batch
+        finally:
+            pc.release_plan_entry(serving)
+
+    def _collect_iter_planned(self, exec_plan, serving):
+        import time
+        from ..exec import query_context as qc
+        from ..exec.tracing import SpanRecorder, SyncCounter
+        listeners = bool(self.session._query_listeners)
+        if listeners:
+            from ..analysis import lockdep, recompile
+            rc0 = recompile.snapshot()
+            lk0 = lockdep.stats()
+        qid = qc.mint_query_id(exec_plan)
+        self.session._last_query_id = qid
+        qc.note_thread_query_id(qid)
+        ctx = qc.QueryContext(qid)
+        # the streaming marker rides the context to every partition-drain
+        # worker thread: cold stage builds route to the compile pool
+        # instead of blocking the first batches (compile_pool.routable)
+        ctx.streaming = True
+        self.session._last_tenant = ctx.tenant
+        from ..analysis import faults as _faults
+        faults0 = _faults.fired_total()
+        try:
+            from ..plan import aqe
+            aqe.begin_query(self.session, exec_plan, serving)
+        except Exception:
+            pass
+        self.session._last_first_row_s = None
+        first_row_s = None
+        sc = spans = None
+        t0 = time.perf_counter()
+        try:
+            with qc.query_scope(ctx):
+                with SyncCounter() as sc, SpanRecorder() as spans:
+                    spans.query_id = qid
+                    try:
+                        for batch in exec_plan.execute_collect_iter():
+                            if first_row_s is None:
+                                first_row_s = time.perf_counter() - t0
+                                self.session._last_first_row_s = \
+                                    first_row_s
+                            yield batch
+                    except BaseException as e:
+                        from ..service.telemetry import dump_on_error
+                        dump_on_error(e)
+                        raise
+        finally:
+            # runs on exhaustion, failure AND early close: the lifecycle
+            # bookkeeping must not depend on the consumer finishing
+            self.session._last_execute_time_s = time.perf_counter() - t0
+            try:
+                from ..plan import aqe
+                aqe.note_execution(self.session, exec_plan, serving)
+            except Exception:
+                pass
+            try:
+                from ..service.telemetry import MetricsRegistry
+                reg = MetricsRegistry.get()
+                reg.histogram(
+                    "tpu_query_execute_seconds",
+                    "collect-action execute wall seconds").observe(
+                    self.session._last_execute_time_s)
+                if first_row_s is not None:
+                    reg.histogram(
+                        "tpu_query_first_row_seconds",
+                        "wall seconds from streaming collect to its "
+                        "first yielded batch").observe(first_row_s)
+            except Exception:
+                pass
+            if spans is not None:
+                self.session._last_sync_report = sc.report()
+                self.session._last_span_report = spans.report()
+                self.session._last_span_recorder = spans
+            if listeners:
+                try:
+                    from .session import QueryExecution
+                    ov = self.session._last_overrides
+                    self.session._notify_query_listeners(QueryExecution(
+                        self.session, exec_plan,
+                        self.session._last_sync_report,
+                        self.session._last_span_report,
+                        recompile.delta(rc0), lockdep.stats_delta(lk0),
+                        violations=getattr(ov, "last_violations", ())
+                        if ov else ()))
+                except Exception:
+                    pass
+            try:
+                from ..service import query_log
+                query_log.maybe_log(self.session, exec_plan, serving,
+                                    qid, faults_before=faults0,
+                                    tenant=ctx.tenant)
+            except Exception:
+                pass
 
     def collect(self) -> List[tuple]:
         return self.collect_batch().rows()

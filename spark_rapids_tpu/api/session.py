@@ -232,6 +232,23 @@ class TpuSession:
         from ..exec import recovery
         recovery.refresh(self.conf)
         faults.refresh(self.conf)
+        # cold-path killers (docs/compile.md §5): reload the AQE
+        # cardinality-feedback checkpoint and prewarm the hottest fused
+        # stages from the corpus beside the signature index. Both are
+        # best-effort — a torn or missing artifact must not fail
+        # bootstrap; prewarm submits to the background pool and returns
+        # without blocking.
+        try:
+            from ..plan import aqe
+            aqe.reload_checkpoint(self.conf)
+        except Exception:
+            pass
+        try:
+            if bool(self.conf.get(cfg.COMPILE_PREWARM)):
+                from ..exec import compile_pool
+                compile_pool.prewarm(self.conf)
+        except Exception:
+            pass
 
     @classmethod
     def active(cls) -> "TpuSession":
@@ -547,6 +564,10 @@ class TpuSession:
             "planTimeS": round(getattr(self, "_last_plan_time_s", 0.0), 4),
             "executeTimeS": round(
                 getattr(self, "_last_execute_time_s", 0.0), 4),
+            # wall seconds to the first batch: == executeTimeS for a
+            # materializing collect, smaller for collect_iter streams
+            "firstRowS": round(
+                getattr(self, "_last_first_row_s", 0.0) or 0.0, 4),
         }
 
     def explain_metrics(self) -> str:
@@ -589,6 +610,7 @@ class TpuSession:
             f"query: {'queryId=' + qid + ' ' if qid else ''}"
             f"planTimeS={rep.get('planTimeS')} "
             f"executeTimeS={rep.get('executeTimeS')} "
+            f"firstRowS={rep.get('firstRowS')} "
             f"hostSyncs={sync.get('hostSyncs', 0)} "
             f"spanWallS={spans.get('wallS', 0.0)} "
             f"concurrency={spans.get('concurrency', 0.0)}")

@@ -635,6 +635,57 @@ COMPILE_DONATE = _conf("spark.rapids.tpu.sql.compile.donate").doc(
     "batches are never donated — their arrays are re-read through the "
     "catalog (docs/compile.md)").boolean_conf.create_with_default(True)
 
+COMPILE_ASYNC = _conf("spark.rapids.tpu.sql.compile.async.enabled").doc(
+    "Background compilation of fused-stage programs (exec/compile_pool.py, "
+    "docs/compile.md §5): a cold stage build requested from a latency-"
+    "sensitive context (a streaming collect_iter, or a service query whose "
+    "deadline cannot absorb the build — see compile.async.deadlineSlackS) "
+    "is submitted to a bounded worker pool and the stage serves batches "
+    "through its per-op eager path until the compiled program is ready, "
+    "swapping in at the next batch boundary. Plain batch collects keep "
+    "the synchronous build path unchanged").boolean_conf.create_with_default(True)
+
+COMPILE_ASYNC_WORKERS = _conf("spark.rapids.tpu.sql.compile.async.workers").doc(
+    "Compile-pool worker threads shared by async stage builds and "
+    "prewarm (query-triggered builds always outrank prewarm in the "
+    "pool's priority queue)").integer_conf.check(
+        lambda v: int(v) >= 1).create_with_default(2)
+
+COMPILE_ASYNC_DEADLINE_SLACK_S = _conf(
+    "spark.rapids.tpu.sql.compile.async.deadlineSlackS").doc(
+    "Deadline-aware compile policy (docs/service.md): a query running "
+    "under a service deadline keeps a cold stage build OFF its own "
+    "thread — routing it to the compile pool and staying on the eager "
+    "path — whenever less than this many seconds remain before the "
+    "deadline. With more slack than this the query compiles "
+    "synchronously (the build amortizes; eager would burn the slack "
+    "anyway)").double_conf.check(
+        lambda v: float(v) >= 0.0).create_with_default(5.0)
+
+COMPILE_PREWARM = _conf("spark.rapids.tpu.sql.compile.prewarm.enabled").doc(
+    "Compile the hottest persisted stage signatures on the compile pool "
+    "at session bootstrap, before traffic arrives (docs/compile.md §5): "
+    "reads the prewarm corpus recorded beside the signature index in "
+    "compile.cacheDir, so a restarted replica serves its first query "
+    "warm. No-op without a cache dir. Off by default — enable per "
+    "replica, via tools/prewarm, or benchmarks.runner --prewarm"
+).boolean_conf.create_with_default(False)
+
+COMPILE_PREWARM_TOP_N = _conf("spark.rapids.tpu.sql.compile.prewarm.topN").doc(
+    "How many of the hottest recorded stage signatures prewarm builds "
+    "(hotness = times a signature was built or rebuilt across recorded "
+    "processes)").integer_conf.check(
+        lambda v: int(v) >= 1).create_with_default(32)
+
+ADAPTIVE_FEEDBACK_CHECKPOINT = _conf(
+    "spark.rapids.tpu.sql.adaptive.feedback.checkpoint").doc(
+    "Persist the AQE cardinality-feedback bank (docs/aqe.md rule 4) as "
+    "JSONL beside the compile-cache signature index and reload it at "
+    "session bootstrap, so plan-cache repeats in a fresh process plan "
+    "from observed actuals instead of re-learning them. No-op without "
+    "compile.cacheDir; torn tail lines are skipped on load"
+).boolean_conf.create_with_default(True)
+
 PLAN_CACHE_ENABLED = _conf("spark.rapids.tpu.sql.planCache.enabled").doc(
     "Parameterized-plan cache (the serving front door, "
     "docs/plan_cache.md): eligible literals in WHERE/SELECT expressions "

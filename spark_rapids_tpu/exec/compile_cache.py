@@ -78,6 +78,13 @@ def configure(conf=None) -> None:
     if conf is None:
         conf = cfg.TpuConf()
     try:
+        # the async compile pool rides the same compile.* conf surface
+        # (and the same RuntimeConf.set re-configure trigger)
+        from . import compile_pool
+        compile_pool.configure(conf)
+    except Exception:
+        log.debug("compile pool configure failed", exc_info=True)
+    try:
         donate = bool(conf.get(cfg.COMPILE_DONATE))
     except Exception:
         donate = True

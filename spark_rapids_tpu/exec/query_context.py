@@ -84,6 +84,15 @@ class QueryContext:
         # attribute to the tenant without any API change at collect sites
         self.tenant = tenant if tenant is not None else \
             getattr(_tls, "tenant", None)
+        # the deadline hint rides the same pre-collect installation path
+        # as the tenant (service/server._worker_loop, deadline_scope);
+        # partition-drain workers then see it through thread_scope, which
+        # is how the compile pool reads a deadline from a task thread
+        self.deadline_at: Optional[float] = getattr(
+            _tls, "deadline_at", None)
+        #: True while this query drains through a streaming collect
+        #: (``DataFrame.collect_iter`` sets it on the minted context)
+        self.streaming: bool = bool(getattr(_tls, "streaming", False))
         self._stage_seq = itertools.count(1)
 
     def next_stage_id(self) -> int:
@@ -163,6 +172,70 @@ class tenant_scope:
     def __exit__(self, *exc) -> bool:
         if self.tenant is not None:
             _tls.tenant = self._prev
+        return False
+
+
+def current_deadline_at() -> Optional[float]:
+    """The ``time.perf_counter`` deadline the CURRENT work must meet, or
+    None when no deadline applies. Installed by the service worker loop
+    (:class:`deadline_scope`) before the admitted ticket's thunk runs;
+    the compile pool reads it to (a) decide whether a cold stage build
+    fits the remaining slack and (b) order query-triggered builds by
+    urgency (docs/service.md). Reads the active query context first —
+    partition-drain worker threads inherit the context, not the
+    submitting thread's TLS."""
+    ctx = current()
+    if ctx is not None and getattr(ctx, "deadline_at", None) is not None:
+        return ctx.deadline_at
+    return getattr(_tls, "deadline_at", None)
+
+
+class deadline_scope:
+    """TLS deadline hint for THIS thread (the :class:`tenant_scope`
+    shape): every compile-pool consult while the scope is open sees
+    ``deadline_at`` via :func:`current_deadline_at`. ``None`` is a no-op
+    (no deadline — direct sessions and deadline-free tickets)."""
+
+    def __init__(self, deadline_at: Optional[float]):
+        self.deadline_at = deadline_at
+
+    def __enter__(self) -> Optional[float]:
+        if self.deadline_at is not None:
+            self._prev = getattr(_tls, "deadline_at", None)  # lint: unguarded-ok worker thread's own TLS field
+            _tls.deadline_at = self.deadline_at
+        return self.deadline_at
+
+    def __exit__(self, *exc) -> bool:
+        if self.deadline_at is not None:
+            _tls.deadline_at = self._prev
+        return False
+
+
+def streaming_active() -> bool:
+    """True while the CURRENT thread drains a streaming collect
+    (``DataFrame.collect_iter``): the latency context in which a cold
+    stage build must not block the first batches — the compile pool
+    takes it instead while the stage serves rows eagerly
+    (docs/compile.md §5). Context first, TLS fallback — same resolution
+    order as :func:`current_deadline_at`."""
+    ctx = current()
+    if ctx is not None and getattr(ctx, "streaming", False):
+        return True
+    return bool(getattr(_tls, "streaming", False))
+
+
+class streaming_scope:
+    """TLS streaming-collect marker for THIS thread (installed by
+    ``collect_iter`` around execution, propagated to partition-drain
+    workers by the task funnel alongside the query context)."""
+
+    def __enter__(self) -> "streaming_scope":
+        self._prev = getattr(_tls, "streaming", False)  # lint: unguarded-ok entering thread's own TLS field
+        _tls.streaming = True
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _tls.streaming = self._prev
         return False
 
 

@@ -221,6 +221,79 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
                 _record_swallowed(name, e)
 
 
+def stream_partition_tasks(parts: Sequence[Any],
+                           fn: Callable[[int, Any], T],
+                           max_workers: int = 0) -> Iterable[T]:
+    """Generator form of :func:`run_partition_tasks`: yield each
+    partition's result IN PARTITION ORDER as soon as it (and every
+    earlier partition) completes, instead of materializing the full
+    result list — the streaming-collect drain (``DataFrame.collect_iter``,
+    docs/observability.md firstRowS). Identical per-task discipline:
+    deferred-finalizer drain at launch, query-context propagation,
+    audited region, semaphore release, dump-on-error.
+
+    Early close (the consumer abandons the stream) cancels unstarted
+    tasks and then waits for RUNNING drains to finish, so every scan's
+    ``_drain`` finally fires and staging arenas / prefetch threads
+    release (io/scan._StagingTracker); exceptions from tasks that
+    completed after the consumer left are logged via the teardown
+    discipline, never silently discarded."""
+    if max_workers <= 0:
+        from .. import config as cfg
+        max_workers = cfg.TpuConf().task_pool_threads
+    from .spill import drain_deferred_finalizers
+    drain_deferred_finalizers()
+    from . import query_context as _qc
+    _query_ctx = _qc.current()
+
+    def task(pid_part):
+        pid, part = pid_part
+        try:
+            from ..analysis.sync_audit import audited_region
+            with _qc.thread_scope(_query_ctx), audited_region():
+                return fn(pid, part)
+        except BaseException as e:
+            from ..service.telemetry import dump_on_error
+            dump_on_error(e)
+            raise
+        finally:
+            _release_semaphore()
+
+    parts = list(parts)
+    if len(parts) <= 1 or max_workers <= 1:
+        for i, p in enumerate(parts):
+            yield task((i, p))
+        return
+    pool = ThreadPoolExecutor(max_workers=min(max_workers, len(parts)),
+                              thread_name_prefix="tpu-task")
+    futures = [pool.submit(task, (i, p)) for i, p in enumerate(parts)]
+    delivered = -1
+    raised = False
+    try:
+        for i, f in enumerate(futures):
+            try:
+                res = f.result()
+            except BaseException:  # the task failure re-raises here
+                raised = True
+                raise
+            delivered = i
+            yield res
+    finally:
+        for f in futures:
+            f.cancel()
+        # wait=True: running drains must complete so their finallys
+        # release staging arenas before the consumer moves on
+        pool.shutdown(wait=True)
+        for i, f in enumerate(futures):
+            if i <= delivered or not f.done() or f.cancelled():
+                continue
+            if raised and i == delivered + 1:
+                continue           # this failure re-raised, not swallowed
+            e = f.exception()
+            if e is not None:
+                _record_swallowed("tpu-stream-task", e)
+
+
 def run_partition_tasks(parts: Sequence[Any],
                         fn: Callable[[int, Any], T],
                         max_workers: int = 0) -> List[T]:

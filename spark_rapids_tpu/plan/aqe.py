@@ -503,8 +503,152 @@ def note_execution(session, exec_plan, serving) -> None:
             _COSTS[key] = cost
             while len(_COSTS) > _COSTS_MAX:
                 _COSTS.popitem(last=False)
+        _maybe_checkpoint(session, key, actuals, cost)
     except Exception:
         log.debug("aqe.note_execution failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# Feedback checkpoint (docs/compile.md §5: the cold-path killer's AQE leg)
+# ---------------------------------------------------------------------------
+#
+# The drift-feedback bank only helps a REPEAT execution — which a fresh
+# process never is. With a compile cache dir configured, each
+# note_execution appends its fingerprint's actuals as one JSONL line
+# beside the fused-program signature index; bootstrap reloads it
+# (reload_checkpoint), so the first execution of a known fingerprint in
+# a new process already plans from observed cardinalities. Appends are
+# single-line (torn-tolerant on read: bad lines skip); the file compacts
+# by atomic rename when it outgrows a few banks' worth of lines, so it
+# stays bounded regardless of process count or uptime.
+
+#: checkpoint filename, beside compile_cache.INDEX_NAME in the cache dir
+CHECKPOINT_NAME = "aqe_feedback.jsonl"
+
+#: compact (rewrite from the live bank) past this many appended lines
+_CHECKPOINT_MAX_LINES = 4 * _FEEDBACK_MAX
+
+# appended-lines estimate for the compaction trigger; None until the
+# first append counts the existing file (GIL-atomic int, advisory only)
+_ckpt_lines: Optional[int] = None
+
+
+def _checkpoint_path() -> Optional[str]:
+    import os
+    from ..exec import compile_cache
+    d = compile_cache.active_dir()
+    if not d:
+        return None
+    return os.path.join(d, CHECKPOINT_NAME)
+
+
+def _checkpoint_enabled(conf) -> bool:
+    try:
+        from .. import config as cfg
+        return bool(conf.get(cfg.ADAPTIVE_FEEDBACK_CHECKPOINT))
+    except Exception:
+        return True
+
+
+def _maybe_checkpoint(session, key: str, actuals: Dict[str, int],
+                      cost: int) -> None:
+    """Append one fingerprint's observation to the checkpoint (no-op
+    without a cache dir or with the conf off). File I/O runs OUTSIDE
+    ``_history_mu``; a failed write only costs the next process its
+    head start."""
+    global _ckpt_lines
+    try:
+        if not _checkpoint_enabled(session.conf):
+            return
+        path = _checkpoint_path()
+        if path is None or not actuals:
+            return
+        import json
+        import os
+        if _ckpt_lines is None:
+            try:
+                with open(path) as f:
+                    _ckpt_lines = sum(1 for _ in f)
+            except OSError:
+                _ckpt_lines = 0
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps({"key": key, "actuals": actuals,
+                                "cost": int(cost)}) + "\n")
+        _ckpt_lines += 1
+        if _ckpt_lines > _CHECKPOINT_MAX_LINES:
+            _compact_checkpoint(path)
+    except Exception:
+        log.debug("aqe feedback checkpoint append failed", exc_info=True)
+
+
+def _compact_checkpoint(path: str) -> None:
+    """Rewrite the checkpoint from the live bank via atomic rename (a
+    reader sees either the old file or the new one, never a torn
+    middle)."""
+    global _ckpt_lines
+    import json
+    import os
+    with _history_mu:
+        entries = [(k, dict(v), int(_COSTS.get(k, 0)))
+                   for k, v in _FEEDBACK.items()]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for k, actuals, cost in entries:
+            f.write(json.dumps({"key": k, "actuals": actuals,
+                                "cost": cost}) + "\n")
+    os.replace(tmp, path)
+    _ckpt_lines = len(entries)
+
+
+def reload_checkpoint(conf) -> int:
+    """Fold the persisted feedback bank back in (session bootstrap).
+    Last line wins per fingerprint; torn/bad lines skip; entries already
+    observed LIVE in this process are not overwritten (live is newer).
+    Returns the number of fingerprints loaded."""
+    try:
+        if not _checkpoint_enabled(conf):
+            return 0
+        path = _checkpoint_path()
+        if path is None:
+            return 0
+        import json
+        entries: Dict[str, Dict[str, Any]] = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ent = json.loads(line)
+                        key = ent["key"]
+                        actuals = {str(p): int(r)
+                                   for p, r in dict(ent["actuals"]).items()}
+                    except Exception:
+                        continue       # torn tail / bad line: skip
+                    entries[key] = {"actuals": actuals,
+                                    "cost": int(ent.get("cost", 0) or 0)}
+        except OSError:
+            return 0
+        loaded = 0
+        with _history_mu:
+            # newest file entries win the bounded slots: iterate in file
+            # order so later (newer) lines land later in the LRU
+            for key, ent in entries.items():
+                if key not in _FEEDBACK and ent["actuals"]:
+                    _FEEDBACK[key] = ent["actuals"]
+                    loaded += 1
+                if key not in _COSTS and ent["cost"]:
+                    _COSTS[key] = ent["cost"]
+            while len(_FEEDBACK) > _FEEDBACK_MAX:
+                _FEEDBACK.popitem(last=False)
+            while len(_COSTS) > _COSTS_MAX:
+                _COSTS.popitem(last=False)
+        return loaded
+    except Exception:
+        log.debug("aqe feedback checkpoint reload failed", exc_info=True)
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -536,6 +680,8 @@ def admission_cost_units(fingerprint_key: Optional[str],
 
 def reset_for_tests() -> None:
     """Drop every cross-execution table (unit-test isolation)."""
+    global _ckpt_lines
+    _ckpt_lines = None
     with _history_mu:
         _STAGE_HISTORY.clear()
         _FEEDBACK.clear()

@@ -158,6 +158,27 @@ class TpuExec:
         finally:
             self.cleanup()
 
+    def execute_collect_iter(self):
+        """Streaming collect: yield ONE host batch per drained partition,
+        in partition order, as each completes — the consumer sees first
+        rows in first-partition time instead of whole-result time
+        (``DataFrame.collect_iter``). Row content and order across the
+        yielded batches are identical to :meth:`execute_collect`'s single
+        concat. Cleanup runs when the stream is exhausted AND when the
+        consumer closes it early (generator finally)."""
+        from ..exec.tasks import stream_partition_tasks
+
+        try:
+            for spillables in stream_partition_tasks(
+                    self.execute(),
+                    lambda pid, part: drain_spillable(part)):
+                if not spillables:
+                    continue
+                with trace_span("collect_concat"):
+                    yield concat_spillable(self.schema, spillables)
+        finally:
+            self.cleanup()
+
     def cleanup(self) -> None:
         """Release query-scoped resources tree-wide after the final drain
         (the reference ties these to task/stage completion listeners)."""
@@ -581,6 +602,14 @@ def _fused_fn(key: tuple, builder):
             _FUSED_CACHE[key] = fn
         _recompile.note_call(_recompile.kernel_of(key))
     return fn
+
+
+def fused_cached(key: tuple) -> bool:
+    """Whether a program for ``key`` is already resident — WITHOUT the
+    LRU touch or audit note_call of a real :func:`_fused_fn` consult.
+    The async compile pool's swap point: once its build lands here, the
+    requesting stage's next batch takes the plain cache-hit path."""
+    return key in _FUSED_CACHE
 
 
 def _donate_argnums(batch: ColumnarBatch, start: int) -> tuple:

@@ -170,6 +170,26 @@ def chain_of_filter(condition: ex.Expression,
     return StageChain([("filter", condition)], schema, schema)
 
 
+def build_stage_program(chain: StageChain, donate: tuple = ()):
+    """The whole-stage jitted program for ``chain`` — module-level (no
+    exec instance in the closure) so the compile pool can rebuild the
+    IDENTICAL program from a pickled chain in a fresh process (prewarm,
+    exec/compile_pool.py) and hit the same ``_fused_fn`` key."""
+    import jax
+    in_schema = chain.in_schema
+    has_filter = any(s[0] == "filter" for s in chain.steps)
+
+    def run(num_rows, *arrays):
+        b = ColumnarBatch.from_flat_arrays(in_schema, arrays, num_rows)
+        out, mask = chain.eval_traced(b)
+        if not has_filter:
+            return tuple(out.flat_arrays())
+        cols, count = K.compact_columns(out.columns, mask)
+        return tuple(a for c in cols for a in c.arrays()) + (count,)
+    # lint: naked-jit-ok only ever invoked as a _fused_fn builder (the exec's _build and the compile pool's prewarm replay both route through the funnel)
+    return jax.jit(run, donate_argnums=donate)
+
+
 # ---------------------------------------------------------------------------
 # The whole-stage exec
 # ---------------------------------------------------------------------------
@@ -203,22 +223,28 @@ class TpuWholeStageExec(TpuExec):
         return [self._map(p) for p in self.children[0].execute()]
 
     def _build(self, donate: tuple = ()):
-        import jax
-        chain = self.chain
-        in_schema = chain.in_schema
-        has_filter = self._has_filter
+        return build_stage_program(self.chain, donate)
 
-        def run(num_rows, *arrays):
-            b = ColumnarBatch.from_flat_arrays(in_schema, arrays, num_rows)
-            out, mask = chain.eval_traced(b)
-            if not has_filter:
-                return tuple(out.flat_arrays())
-            cols, count = K.compact_columns(out.columns, mask)
-            return tuple(a for c in cols for a in c.arrays()) + (count,)
-        return jax.jit(run, donate_argnums=donate)
+    def _stage_args(self, batch: ColumnarBatch) -> tuple:
+        """The fused program's real argument tuple for ``batch`` (the
+        exact avals ``_fused`` calls with)."""
+        return (_dev_count(batch), *batch.flat_arrays(),
+                *ex.param_arg_values(self.chain.params))
+
+    @staticmethod
+    def _warm_args(args: tuple) -> tuple:
+        """Zero-filled stand-ins for a pool warm call: ``zeros_like``
+        preserves shape/dtype/weak-type, so the background compile's jit
+        signature exactly matches the real call — without aliasing this
+        batch's (possibly soon-donated) buffers on another thread."""
+        import jax
+        import jax.numpy as jnp
+        return tuple(jnp.zeros_like(a) if isinstance(a, jax.Array) else a
+                     for a in args)
 
     def _fused(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
         from ..analysis import recompile as _recompile
+        from ..exec import compile_pool as _pool
         try:
             donate = _donate_argnums(batch, 1)
             fn = self._fns.get(bool(donate))
@@ -229,6 +255,31 @@ class TpuWholeStageExec(TpuExec):
                 key = ("stage", _schema_sig(self.chain.in_schema),
                        self._ckey, ("donate", bool(donate)))
                 self._kernel = _recompile.kernel_of(key)
+                st = _pool.status(key)
+                if st is None and not ph.fused_cached(key) and \
+                        _pool.routable(key):
+                    # latency-sensitive cold build: hand it to the pool
+                    # and serve this batch eagerly (docs/compile.md §5)
+                    args = self._stage_args(batch)
+                    _pool.note_stage_signature(key, self._kernel,
+                                               self.chain, donate, args)
+                    st = _pool.consult(key, lambda: self._build(donate),
+                                       self._warm_args(args),
+                                       kernel=self._kernel)
+                if st == "pending":
+                    return None    # eager until the background build lands
+                if st == "failed":
+                    err = _pool.failure(key)
+                    if err is not None:
+                        # replicate the synchronous failure semantics:
+                        # the except arms below decide broken vs raise
+                        raise err
+                if not ph.fused_cached(key):
+                    # record the rebuild recipe for prewarm BEFORE the
+                    # build (sync path; the async path recorded above)
+                    _pool.note_stage_signature(key, self._kernel,
+                                               self.chain, donate,
+                                               self._stage_args(batch))
                 fn = _fused_fn(key, lambda: self._build(donate))
                 self._fns[bool(donate)] = fn
             else:

@@ -29,6 +29,7 @@ QUERY_LOG_FIELDS: Tuple[str, ...] = (
     "stageStats", "stageWallS", "stageRetries", "fetchRetries",
     "faultsFired", "shufflePlanes", "hbmPeakBytes", "hbmPeakOperator",
     "drift", "operators", "hostSyncs", "recompiles", "aqe",
+    "firstRowS", "compileS",
 )
 
 
@@ -100,6 +101,19 @@ def _metric_total(exec_plan, key: str) -> int:
     def walk(node) -> None:
         nonlocal total
         total += int(node.metrics.get(key, 0) or 0)
+        for c in getattr(node, "children", ()):
+            walk(c)
+
+    walk(exec_plan)
+    return total
+
+
+def _metric_total_f(exec_plan, key: str) -> float:
+    total = 0.0
+
+    def walk(node) -> None:
+        nonlocal total
+        total += float(node.metrics.get(key, 0.0) or 0.0)
         for c in getattr(node, "children", ()):
             walk(c)
 
@@ -179,6 +193,17 @@ def build_record(session, exec_plan, serving: Dict[str, Any],
         "hostSyncs": int(sync.get("hostSyncs", 0) or 0),
         "recompiles": _metric_total(exec_plan, "recompiles"),
         "aqe": aqe_summary(exec_plan),
+        # wall seconds until the first batch reached the caller — equals
+        # wallS for a materializing collect, strictly smaller when the
+        # query streamed via collect_iter (docs/observability.md)
+        "firstRowS": round(
+            getattr(session, "_last_first_row_s", 0.0) or 0.0, 4),
+        # seconds this query spent blocked on synchronous stage builds
+        # (async pool builds land on pool threads and are NOT attributed
+        # here — the gap between cold wallS and compileS is the async
+        # win; tools/query_report renders the breakdown)
+        "compileS": round(
+            float(_metric_total_f(exec_plan, "compileSeconds")), 4),
     }
     return rec
 

@@ -39,6 +39,7 @@ multi-process queries (docs/shuffle.md).
 from __future__ import annotations
 
 import itertools
+import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -78,6 +79,20 @@ class ServiceClosed(RuntimeError):
     """The service shut down before this query could run."""
 
 
+#: end-of-stream sentinel on a streaming ticket's batch queue
+_STREAM_END = object()
+
+
+class _StreamFailure:
+    """A producer-side failure riding the stream queue so the consumer
+    re-raises it in-order (after every batch that preceded it)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class QueryTicket:
     """One submitted query's handle: wait on :meth:`result`. Carries the
     admission timeline (submitted/started/finished) the replay bench's
@@ -103,6 +118,9 @@ class QueryTicket:
         self._done = threading.Event()
         self._result: Any = None
         self._exc: Optional[BaseException] = None
+        # streaming submissions only (QueryService.submit_stream)
+        self._stream_q: Optional[queue.Queue] = None
+        self._stream_closed: Optional[threading.Event] = None
 
     @property
     def sort_key(self):
@@ -134,6 +152,46 @@ class QueryTicket:
         if self._exc is not None:
             raise self._exc
         return self._result
+
+    def stream(self):
+        """Iterate the query's batches as partitions drain (tickets from
+        :meth:`QueryService.submit_stream` only). Yields in partition
+        order; a producer-side failure re-raises here after every batch
+        that preceded it. Closing the iterator early tells the producer
+        to stop — the underlying ``collect_iter`` generator's cleanup
+        runs, so staging arenas and prefetch threads release."""
+        if self._stream_q is None:
+            raise TypeError(
+                f"query {self.label!r} was not submitted via "
+                f"submit_stream; use result()")
+        q = self._stream_q
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._done.is_set() and q.empty():
+                        # shed / service-closed before the thunk ran (or
+                        # the producer died sentinel-less): surface the
+                        # ticket's typed failure instead of hanging
+                        if self._exc is not None:
+                            raise self._exc
+                        return
+                    continue
+                if item is _STREAM_END:
+                    return
+                if isinstance(item, _StreamFailure):
+                    raise item.exc
+                yield item
+        finally:
+            if self._stream_closed is not None:
+                self._stream_closed.set()
+            # unblock a producer parked on a full queue
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
 
     def _finish(self, result=None, exc: Optional[BaseException] = None
                 ) -> None:
@@ -331,6 +389,75 @@ class QueryService:
                            "cost": ticket.cost})
         return ticket
 
+    def submit_stream(self, tenant: str, query, *,
+                      priority: Optional[int] = None,
+                      deadline_s: Optional[float] = None,
+                      label: str = "",
+                      buffer_batches: int = 4) -> QueryTicket:
+        """Queue one query whose result STREAMS: iterate the returned
+        ticket's :meth:`QueryTicket.stream` to receive batches as
+        partitions drain (``DataFrame.collect_iter`` under the hood), so
+        first rows arrive before the query finishes. Admission, priority
+        and deadline shedding are exactly :meth:`submit`'s; the producer
+        runs under the ticket's deadline scope, so the async compile
+        pool sees the deadline when routing cold stage builds
+        (docs/service.md, docs/compile.md §5). ``buffer_batches`` bounds
+        the producer->consumer queue — a slow consumer back-pressures
+        the drain instead of buffering the whole result.
+        ``ticket.result()`` returns the total row count after the
+        stream completes."""
+        from ..api.dataframe import DataFrame
+        if isinstance(query, str):
+            text = query
+            label = label or text[:80]
+
+            def df_for():
+                return self.session.sql(text)
+        elif isinstance(query, DataFrame):
+            label = label or type(query).__name__
+
+            def df_for():
+                return query
+        else:
+            raise TypeError(
+                f"submit_stream takes SQL text or a DataFrame, got "
+                f"{type(query).__name__}")
+        q: queue.Queue = queue.Queue(maxsize=max(1, int(buffer_batches)))
+        closed = threading.Event()
+
+        def deliver(item) -> bool:
+            # bounded put that aborts when the consumer closed the
+            # stream (drains on close, so this converges quickly)
+            while not closed.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def run():
+            rows = 0
+            it = df_for().collect_iter()
+            try:
+                for batch in it:
+                    rows += int(getattr(batch, "num_rows", 0) or 0)
+                    if not deliver(batch):
+                        break      # consumer closed early
+            except BaseException as e:
+                deliver(_StreamFailure(e))
+                raise              # the ticket's result() fails too
+            finally:
+                it.close()         # collect_iter cleanup: arenas release
+            deliver(_STREAM_END)
+            return rows
+
+        ticket = self.submit(tenant, run, priority=priority,
+                             deadline_s=deadline_s, label=label)
+        ticket._stream_q = q
+        ticket._stream_closed = closed
+        return ticket
+
     def _admission_cost(self, label: str) -> int:
         """Queue-depth units this submission charges: 1, or more when
         its label's last execution was OBSERVED expensive
@@ -422,7 +549,12 @@ class QueryService:
                 # session._last_query_id is last-writer-wins and must
                 # not be joined to a ticket
                 qc.note_thread_query_id(None)
-                with tenant_scope(ticket.tenant):
+                # the deadline rides the worker's TLS into the minted
+                # QueryContext, so the async compile pool can route cold
+                # stage builds off the query thread when the remaining
+                # slack cannot absorb a build (exec/compile_pool.py)
+                with tenant_scope(ticket.tenant), \
+                        qc.deadline_scope(ticket.deadline_at):
                     out = ticket.thunk()
                 ticket.query_id = qc.thread_last_query_id()
                 try:

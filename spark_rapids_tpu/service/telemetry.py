@@ -140,6 +140,10 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_aqe_decisions_total",          # counter, label rule=<AQE_RULES>
     "tpu_admission_cost_debits_total",  # extra queue slots charged, label
                                         # tenant=<name>
+    # cold-path killers (exec/compile_pool.py, docs/compile.md §5)
+    "tpu_compile_queue_depth",          # gauge, pending+running pool jobs
+    "tpu_prewarm_compiles_total",       # programs built by prewarm jobs
+    "tpu_query_first_row_seconds",      # histogram, wall to first batch
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,

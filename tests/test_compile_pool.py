@@ -1,0 +1,101 @@
+"""Background compile pool (ISSUE 17, docs/compile.md §5): the
+deadline-aware routing policy, the eager->compiled mid-stream swap with
+lockdep in enforce mode, and pool-build failure fallback."""
+
+import time
+
+import pytest
+
+
+def _session(extra=None):
+    from spark_rapids_tpu.api.session import TpuSession
+    conf = {"spark.rapids.tpu.sql.explain": "NONE"}
+    conf.update(extra or {})
+    return TpuSession.builder.config(conf).getOrCreate()
+
+
+@pytest.fixture
+def pool():
+    """A configured pool; restores the delay seam, drains in-flight
+    builds and clears failure memory afterwards so later tests see a
+    quiet pool (the fused cache keeps whatever landed — harmless)."""
+    from spark_rapids_tpu.exec import compile_pool as cp
+    _session()
+    yield cp
+    cp.set_test_build_delay(0.0)
+    cp.drain(timeout_s=60.0)
+    cp.reset_for_tests()
+    _session()
+
+
+def test_routable_policy(pool):
+    """Routing is latency-gated: a plain collect with no deadline keeps
+    the synchronous build path byte-identical (the recompile-gate
+    invariant); streaming or a tight deadline routes to the pool; a
+    deadline with slack to absorb a cold build stays synchronous."""
+    from spark_rapids_tpu.exec import query_context as qc
+    key = ("stage", ("routable-policy-test-17",), 1024)
+    assert not pool.routable(key)
+    with qc.streaming_scope():
+        assert pool.routable(key)
+    with qc.deadline_scope(time.perf_counter() + 0.5):
+        assert pool.routable(key)          # < deadlineSlackS remaining
+    with qc.deadline_scope(time.perf_counter() + 3600.0):
+        assert not pool.routable(key)      # cold build fits the budget
+    # pool off: never routable, whatever the context
+    _session({"spark.rapids.tpu.sql.compile.async.enabled": "false"})
+    try:
+        with qc.streaming_scope():
+            assert not pool.routable(key)
+    finally:
+        _session()
+
+
+def test_async_swap_no_dropped_or_duplicated_rows(pool):
+    """The race the pool must win: a streaming query whose fused-stage
+    build is held in flight serves its first batches eagerly, swaps to
+    the compiled program once the build lands, and the union of
+    eager-and-compiled batches is EXACTLY the query result — no row
+    dropped at the seam, none produced twice. Lockdep runs in enforce
+    mode so an ordering violation in the pool handshake fails loudly."""
+    session = _session({
+        "spark.rapids.tpu.sql.analysis.lockdep": "enforce"})
+    session.range(0, 200_000, 1, numPartitions=8) \
+           .createOrReplaceTempView("pool_race_r17")
+    # literals unique to this test: the process-global fused cache must
+    # not already hold the chain (else nothing routes to the pool)
+    sql = ("SELECT id * 7.515625 + 3.25 AS w, id - 17 AS u "
+           "FROM pool_race_r17 WHERE id > 1234 AND id < 190123")
+    pool.set_test_build_delay(0.4)
+    try:
+        got = []
+        for b in session.sql(sql).collect_iter():
+            got.extend(b.rows())
+    finally:
+        pool.set_test_build_delay(0.0)
+    assert pool.drain(timeout_s=60.0)
+    st = pool.stats()
+    assert st["asyncBuilt"] >= 1, st       # the build really went async
+    assert st["failed"] == 0, st
+    oracle = session.sql(sql).collect()    # fused-cache hit by now
+    assert len(got) == len(oracle)
+    assert sorted(got) == sorted(oracle)
+
+
+def test_pool_build_failure_surfaces_and_is_remembered(pool):
+    """A pool build that raises parks the key as 'failed' (so the stage
+    raises the real error instead of resubmitting the doomed build every
+    batch) and hands the original exception back through failure()."""
+    key = ("stage", ("pool-failure-test-17",), 7)
+
+    def boom():
+        raise RuntimeError("deliberate pool-build failure")
+
+    st = pool.consult(key, boom, (), "stage")
+    assert st == "pending"
+    assert pool.drain(timeout_s=60.0)
+    assert pool.status(key) == "failed"
+    exc = pool.failure(key)
+    assert isinstance(exc, RuntimeError)
+    assert "deliberate" in str(exc)
+    assert pool.stats()["failed"] >= 1

@@ -584,16 +584,21 @@ def test_replay_bench_stamps_accepted_gate_entry(tmp_path):
     rounds = bh.load(hist)
     assert len(rounds) == 1 and rounds[0]["kind"] == "replay"
     assert set(rounds[0]["queries"]) == {
-        bh.REPLAY_QPS, bh.REPLAY_P50_S, bh.REPLAY_P99_S}
-    # p50/p99 are recorded direction-inverted (lower is better)
+        bh.REPLAY_QPS, bh.REPLAY_P50_S, bh.REPLAY_P99_S,
+        bh.FIRST_ROW_P99_S}
+    # latency percentiles are recorded direction-inverted (lower is
+    # better) — including the streamed-leg first-row p99 (ISSUE 17)
     assert set(rounds[0]["invertedQueries"]) == {
-        bh.REPLAY_P50_S, bh.REPLAY_P99_S}
+        bh.REPLAY_P50_S, bh.REPLAY_P99_S, bh.FIRST_ROW_P99_S}
+    assert line["streaming_queries"] == 2      # one streamed per stream
+    assert 0 < line["first_row_p50_s"] <= line["first_row_p99_s"]
     # a second round is judged against the first (accepted by the gate)
     line2 = run_replay(sf=0.0005, streams=2, queries_per_stream=2,
                        stamp=True, history_path=hist)
     assert line2["replay_ok"]
     assert set(line2["regression"]) == {
-        bh.REPLAY_QPS, bh.REPLAY_P50_S, bh.REPLAY_P99_S}
+        bh.REPLAY_QPS, bh.REPLAY_P50_S, bh.REPLAY_P99_S,
+        bh.FIRST_ROW_P99_S}
     assert all(v in ("ok", "warn", "fail", "improvement")
                for v in line2["regression"].values())
 

@@ -1,0 +1,75 @@
+"""Prewarm a compile-cache directory from the CLI.
+
+Boots a session against ``--cache-dir``, replays the hottest fused-stage
+signatures recorded in the prewarm corpus (``prewarm_corpus.jsonl``,
+written beside the signature index by every cold stage build —
+exec/compile_pool.py) onto the background compile pool, waits for the
+builds, and prints the pool stats. Run it before traffic arrives — a
+following process (``benchmarks/runner.py --prewarm``, a service boot
+with ``compile.prewarm.enabled``) then serves first queries with zero
+query-triggered cold compiles (docs/compile.md §5)::
+
+    python -m tools.prewarm --cache-dir /var/cache/tpu-compile
+    python -m tools.prewarm --cache-dir ./cache --top-n 8 --timeout 60
+
+Exit code 0 when every submitted prewarm build landed, 1 otherwise
+(a failed build, a drain timeout, or no corpus to replay).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def run_prewarm(cache_dir: str, top_n: int = 32,
+                timeout_s: float = 120.0) -> dict:
+    """Boot, prewarm, drain; return the summary dict the CLI prints."""
+    from spark_rapids_tpu.api.session import TpuSession
+    from spark_rapids_tpu.exec import compile_pool
+
+    session = TpuSession.builder.config(
+        "spark.rapids.tpu.sql.explain", "NONE").config(
+        "spark.rapids.tpu.sql.compile.cacheDir", cache_dir).config(
+        "spark.rapids.tpu.sql.compile.prewarm.topN",
+        str(top_n)).getOrCreate()
+    submitted = compile_pool.prewarm(session.conf)
+    drained = compile_pool.drain(timeout_s=timeout_s)
+    stats = compile_pool.stats()
+    out = {
+        "cacheDir": cache_dir,
+        "submitted": submitted,
+        "drained": bool(drained),
+        "prewarmBuilt": stats.get("prewarmBuilt", 0),
+        "failed": stats.get("failed", 0),
+        "ok": bool(drained) and submitted >= 0 and
+              stats.get("failed", 0) == 0 and
+              stats.get("prewarmBuilt", 0) >= submitted > 0,
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compile the hottest recorded fused-stage "
+                    "signatures into a compile-cache dir before "
+                    "traffic arrives")
+    ap.add_argument("--cache-dir", required=True,
+                    help="persistent compile cache directory "
+                         "(spark.rapids.tpu.sql.compile.cacheDir) "
+                         "holding a prior run's prewarm corpus")
+    ap.add_argument("--top-n", type=int, default=32,
+                    help="hottest signatures to compile "
+                         "(compile.prewarm.topN)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="seconds to wait for the background builds")
+    args = ap.parse_args(argv)
+    out = run_prewarm(args.cache_dir, top_n=args.top_n,
+                      timeout_s=args.timeout)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,18 @@ def digest(query_id: str, records: List[dict], top: int = 5) -> str:
         f"planCache={head.get('planCache')} "
         f"resultCache={head.get('resultCache')} "
         f"params={head.get('params', 0)}")
+    # cold/warm breakdown (docs/compile.md §5): compileS is the wall the
+    # query thread spent blocked on synchronous stage builds; executeS
+    # is the rest. A prewarmed/async-served query shows compileS=0 —
+    # the rollup's prewarm hit rate counts exactly those. firstRowS <
+    # wallS marks a streaming (collect_iter) execution.
+    compile_s = max(float(r.get("compileS", 0) or 0) for r in records)
+    first_row = max(float(r.get("firstRowS", 0) or 0) for r in records)
+    if compile_s or first_row:
+        lines.append(
+            f"  compileS={round(compile_s, 4)} "
+            f"executeS={round(max(0.0, wall - compile_s), 4)} "
+            f"firstRowS={round(first_row, 4)}")
     if retries or faults:
         lines.append(f"  retries: stage={retries} "
                      f"fetch={sum(int(r.get('fetchRetries', 0) or 0) for r in records)} "
@@ -169,19 +181,29 @@ def tenant_rollup(records: List[dict]) -> str:
     by_tenant: Dict[str, dict] = {}
     for (t, _qid), recs in by_query.items():
         e = by_tenant.setdefault(t, {"queries": 0, "wallS": 0.0,
-                                     "rows": 0, "retries": 0})
+                                     "rows": 0, "retries": 0,
+                                     "compileS": 0.0, "warm": 0})
         e["queries"] += 1
         e["wallS"] += max(float(r.get("wallS", 0) or 0) for r in recs)
         e["rows"] += sum(int(r.get("rows", 0) or 0) for r in recs)
         e["retries"] += sum(int(r.get("stageRetries", 0) or 0)
                             for r in recs)
+        comp = max(float(r.get("compileS", 0) or 0) for r in recs)
+        e["compileS"] += comp
+        if comp == 0.0:
+            # served with zero synchronous build wall: a prewarm/async/
+            # cache hit — the fraction of these is the prewarm hit rate
+            e["warm"] += 1
     if not by_tenant:
         return ""
     lines = ["per-tenant summary:"]
     for t, e in sorted(by_tenant.items()):
+        hit = e["warm"] / e["queries"] if e["queries"] else 0.0
         lines.append(
             f"  {t}: queries={e['queries']} "
-            f"wallS={round(e['wallS'], 4)} rows={e['rows']}"
+            f"wallS={round(e['wallS'], 4)} rows={e['rows']} "
+            f"compileS={round(e['compileS'], 4)} "
+            f"prewarmHitRate={round(hit, 3)}"
             + (f" stageRetries={e['retries']}" if e["retries"] else ""))
     return "\n".join(lines)
 
