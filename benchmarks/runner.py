@@ -327,6 +327,27 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         report["telemetry"] = compact_snapshot()
     except Exception:
         pass
+    # run-level determinism summary (docs/analysis.md §6): static lint
+    # verdict over the shipped tree plus the divergence-audit counters
+    # for this run — a bench round that tripped the nondeterminism
+    # analyzer or desynced mid-run says so in its own artifact
+    try:
+        import os as _os
+        from spark_rapids_tpu.analysis import divergence as _div
+        from spark_rapids_tpu.analysis import lint as _lint
+        _pkg = _os.path.dirname(_os.path.abspath(_lint.__file__))
+        _pkg = _os.path.dirname(_pkg)          # spark_rapids_tpu/
+        _viol = _lint.run(_pkg)
+        report["analysis"] = {
+            "lintViolations": len(_viol),
+            "divergence": _div.stats(),
+        }
+        _dv = report["analysis"]["divergence"]
+        print(f"ANALYSIS lint_violations={len(_viol)} "
+              f"divergence_mode={_dv['mode']} "
+              f"divergence_checks={_dv['checks']} desyncs={_dv['desyncs']}")
+    except Exception as e:        # the summary must not kill the report
+        report["analysis_error"] = str(e)[:200]
     if output:
         with open(output, "w") as f:
             json.dump(report, f, indent=2)

@@ -1,0 +1,428 @@
+"""Static nondeterminism linter: the lexical half of the engine's
+lockstep-determinism discipline (``analysis/divergence.py`` is the
+runtime half).
+
+The standalone distributed mode has no driver: every worker executes the
+same query sequence and independently mints identical shuffle ids, stage
+ids and plan decisions (the lockstep contract, shuffle/manager.py). Any
+nondeterminism on that path — wall-clock values feeding ids, unseeded
+random, set-iteration order feeding an ordered decision, an unsorted
+directory scan — silently diverges the workers' streams, and divergence
+pairs WRONG shuffles before the fingerprint handshake can catch every
+case. These rules make those sources loud at lint time.
+
+Scope — the lockstep-reachable modules: ``shuffle/``, ``parallel/``,
+``plan/`` and ``exec/query_context.py`` (the query/stage id mint).
+Pure AST + text; no engine import.
+
+Rules (all wired into ``python -m tools.lint``, tier-1-enforced):
+
+``nondet-clock``
+    A wall-clock read (``time.time/time_ns/perf_counter/monotonic/...``)
+    whose value feeds an id-ish sink: an assignment target or a callee
+    whose name matches id/seq/seed/key/fingerprint/digest. Clocks are
+    fine for deadlines and timings — they must never mint identity or
+    drive a plan decision both workers replay.
+
+``nondet-random``
+    A module-global ``random.*`` call (unseeded process RNG). Lockstep
+    code that needs randomness must derive it from shared state via
+    ``random.Random(seed)``.
+
+``nondet-set-order``
+    Direct iteration over a ``set``/``frozenset`` expression (``for``
+    loop, or ``list/tuple/enumerate`` over one) — set order varies per
+    process (hash seeding), so an ordered decision built from it
+    diverges. Wrap in ``sorted(...)``.
+
+``nondet-scan``
+    An ``os.listdir``/``os.scandir``/``glob.glob``/``glob.iglob`` call
+    not directly wrapped in ``sorted(...)`` — directory order is
+    filesystem-dependent, so replaying workers see different orders.
+
+``lockstep-id``
+    A monotonic id source (an ``itertools.count(...)`` binding, or a
+    manual ``_next*``/``*_seq``/``*_counter`` increment) in a scoped
+    module whose canonical name is NOT declared in :data:`LOCKSTEP_IDS`.
+    Every process-global id stream the lockstep contract leans on must
+    be declared here and minted through its one audited funnel; the
+    cross-module registry check also flags declared entries that no
+    longer exist in the tree (stale registry).
+
+Suppression mirrors the concurrency linter — ONE pragma tag for the
+whole family, reason mandatory, on the flagged line or the line above::
+
+    seq = self._conn_seq        # lint: nondeterminism-ok <why lockstep-safe>
+
+Reason-less pragmas are themselves flagged (``pragma-reason``) and do
+not suppress.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .lint import LintViolation
+
+SCOPE_PREFIXES = ("shuffle/", "parallel/", "plan/")
+SCOPE_FILES = ("exec/query_context.py",)
+
+#: Every process-global monotonic id stream the lockstep contract relies
+#: on, by canonical name (``<module>.<Class>.<attr>`` with ``/`` -> ``.``
+#: and the class omitted for module-level bindings). A mint site in a
+#: scoped module that is not declared here fails lint (``lockstep-id``);
+#: a declared entry with no mint site in the tree fails too. Keep each
+#: stream behind ONE audited funnel:
+#:
+#: * ``_QUERY_SEQ`` — the query-id counter (``mint_query_id``): workers
+#:   running the same query sequence draw the same values, and every
+#:   other id below namespaces on it.
+#: * ``QueryContext._stage_seq`` — per-query exchange-boundary stage ids
+#:   (``next_stage_id``), deterministic on the driving thread.
+#: * ``WorkerContext._next_by_ns`` — per-query-NAMESPACE shuffle-id
+#:   counters (``next_shuffle_id``): ids are ``(query seq << NS_SHIFT) +
+#:   n``, so two concurrent distributed queries mint disjoint streams
+#:   (docs/shuffle.md).
+LOCKSTEP_IDS: Tuple[str, ...] = (
+    "exec.query_context._QUERY_SEQ",
+    "exec.query_context.QueryContext._stage_seq",
+    "shuffle.manager.WorkerContext._next_by_ns",
+)
+
+PRAGMA_RE = re.compile(r"#\s*lint:\s*(nondeterminism)-ok(.*)$")
+
+#: assignment targets / callees a clock value must not feed
+ID_SINK_RE = re.compile(r"(?i)(?:^|_)(id|ids|seq|seed|key|keys|"
+                        r"fingerprint|digest)s?$|mint")
+
+CLOCK_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+             "monotonic", "monotonic_ns"}
+RANDOM_FNS = {"random", "randint", "randrange", "choice", "choices",
+              "shuffle", "sample", "uniform", "getrandbits", "randbytes"}
+SCAN_FNS = {("os", "listdir"), ("os", "scandir"),
+            ("glob", "glob"), ("glob", "iglob")}
+
+#: manual monotonic-counter naming convention (rule ``lockstep-id``)
+COUNTER_NAME_RE = re.compile(r"^_?next(_|$)|_next$|_seq$|_counter$")
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in SCOPE_FILES
+
+
+def _pragmas(source: str) -> Dict[int, str]:
+    """line -> reason (possibly empty) for nondeterminism-ok pragmas."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            out[i] = m.group(2).strip()
+    return out
+
+
+def _terminal_name(target: ast.AST) -> Optional[str]:
+    """The terminal bound name, unwrapping subscripts: ``a``, ``x.a``
+    and ``x.a[k]`` all yield ``a`` (a keyed counter dict is still one
+    counter stream)."""
+    if isinstance(target, ast.Subscript):
+        return _terminal_name(target.value)
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def _dotted(func: ast.AST) -> Optional[Tuple[str, str]]:
+    """('base', 'attr') for a one-level dotted callee like time.time."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (func.value.id, func.attr)
+    return None
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    # accept the `import time as _time` alias convention too
+    return d is not None and d[1] in CLOCK_FNS and \
+        d[0].lstrip("_") == "time"
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and \
+            node.func.id in ("set", "frozenset"):
+        return True
+    return False
+
+
+def _is_count_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if d == ("itertools", "count"):
+        return True
+    return isinstance(node.func, ast.Name) and node.func.id == "count"
+
+
+@dataclass
+class IdSite:
+    """One monotonic-id mint site (the LOCKSTEP_IDS registry entry)."""
+    path: str
+    rel: str
+    line: int
+    kind: str             # 'itertools.count' or 'counter'
+    canonical: str        # module-qualified declared name
+
+
+def _module_of(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else \
+        rel.replace("/", ".")
+
+
+def _class_ctx(tree: ast.Module) -> Dict[ast.AST, Optional[str]]:
+    """node -> innermost enclosing class name (None at module level)."""
+    ctx: Dict[ast.AST, Optional[str]] = {}
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            c = child.name if isinstance(child, ast.ClassDef) else cls
+            ctx[child] = c
+            walk(child, c)
+    walk(tree, None)
+    return ctx
+
+
+def _id_sites(tree: ast.Module, rel: str, path: str) -> List[IdSite]:
+    """Every monotonic-id mint site in one module: itertools.count
+    bindings plus manual counter increments (``x += n`` or
+    ``x = x + n``-shaped rebinding of a ``_next*``/``*_seq``/
+    ``*_counter`` name)."""
+    mod = _module_of(rel)
+    ctx = _class_ctx(tree)
+    sites: List[IdSite] = []
+    seen: Set[str] = set()
+
+    def canonical(node: ast.AST, name: str) -> str:
+        cls = ctx.get(node)
+        return f"{mod}.{cls}.{name}" if cls else f"{mod}.{name}"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if _is_count_call(node.value):
+                for t in node.targets:
+                    name = _terminal_name(t)
+                    if name is None:
+                        continue
+                    sites.append(IdSite(path, rel, node.lineno,
+                                        "itertools.count",
+                                        canonical(node, name)))
+            else:
+                # manual counter advance: `self._next_x[...] = v + 1`
+                for t in node.targets:
+                    name = _terminal_name(t)
+                    if name is None or not COUNTER_NAME_RE.search(name):
+                        continue
+                    if isinstance(node.value, ast.BinOp) and \
+                            isinstance(node.value.op, ast.Add):
+                        can = canonical(node, name)
+                        if can not in seen:
+                            seen.add(can)
+                            sites.append(IdSite(path, rel, node.lineno,
+                                                "counter", can))
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.op, ast.Add):
+            name = _terminal_name(node.target)
+            if name is not None and COUNTER_NAME_RE.search(name):
+                can = canonical(node, name)
+                if can not in seen:
+                    seen.add(can)
+                    sites.append(IdSite(path, rel, node.lineno,
+                                        "counter", can))
+    return sites
+
+
+def _nondet_hits(tree: ast.Module) -> List[Tuple[int, str, str]]:
+    """(line, rule, message) hits for the per-module value rules."""
+    hits: List[Tuple[int, str, str]] = []
+
+    # nondet-scan: collect scan calls, exempt the ones directly under
+    # sorted(...)
+    scan_calls: Dict[ast.AST, Tuple[int, str]] = {}
+    exempt: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and (d[0].lstrip("_"), d[1]) in SCAN_FNS:
+                scan_calls[node] = (node.lineno, f"{d[0]}.{d[1]}")
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "sorted" and node.args:
+                exempt.add(node.args[0])
+    for call, (line, name) in scan_calls.items():
+        if call not in exempt:
+            hits.append((
+                line, "nondet-scan",
+                f"{name}() order is filesystem-dependent — lockstep "
+                "workers replaying this scan see different orders; wrap "
+                "in sorted(...) (or pragma `# lint: nondeterminism-ok "
+                "<reason>`)"))
+
+    for node in ast.walk(tree):
+        # nondet-random: module-global RNG
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and d[0] == "random" and d[1] in RANDOM_FNS:
+                hits.append((
+                    node.lineno, "nondet-random",
+                    f"random.{d[1]}() draws from the unseeded process "
+                    "RNG — lockstep workers diverge; derive a "
+                    "random.Random(seed) from shared state (or pragma "
+                    "`# lint: nondeterminism-ok <reason>`)"))
+            # clock value as argument to an id-ish callee
+            if _dotted(node.func) is not None or \
+                    isinstance(node.func, ast.Name):
+                callee = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) \
+                    else node.func.id
+                if ID_SINK_RE.search(callee):
+                    for arg in list(node.args) + \
+                            [k.value for k in node.keywords]:
+                        for sub in ast.walk(arg):
+                            if _is_clock_call(sub):
+                                hits.append((
+                                    sub.lineno, "nondet-clock",
+                                    "wall-clock value feeds "
+                                    f"{callee}(...) — clocks must never "
+                                    "mint lockstep identity (or pragma "
+                                    "`# lint: nondeterminism-ok "
+                                    "<reason>`)"))
+
+        # nondet-clock: clock value assigned to an id-ish name
+        if isinstance(node, ast.Assign):
+            sink = None
+            for t in node.targets:
+                name = _terminal_name(t)
+                if name is not None and ID_SINK_RE.search(name):
+                    sink = name
+                    break
+            if sink is not None:
+                for sub in ast.walk(node.value):
+                    if _is_clock_call(sub):
+                        hits.append((
+                            sub.lineno, "nondet-clock",
+                            f"wall-clock value assigned to {sink!r} — "
+                            "clocks must never mint lockstep identity "
+                            "(or pragma `# lint: nondeterminism-ok "
+                            "<reason>`)"))
+                        break
+
+        # nondet-set-order
+        set_iter = None
+        if isinstance(node, ast.For) and _is_set_expr(node.iter):
+            set_iter = node.iter
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("list", "tuple", "enumerate") and \
+                node.args and _is_set_expr(node.args[0]):
+            set_iter = node.args[0]
+        if set_iter is not None:
+            hits.append((
+                set_iter.lineno, "nondet-set-order",
+                "set/frozenset iteration order varies per process (hash "
+                "seeding) — an ordered lockstep decision built from it "
+                "diverges; wrap in sorted(...) (or pragma "
+                "`# lint: nondeterminism-ok <reason>`)"))
+    return hits
+
+
+def lint_source(source: str, rel: str, path: Optional[str] = None
+                ) -> List[LintViolation]:
+    """Determinism rules over one module (``rel`` relative to the
+    package root). Returns [] for out-of-scope modules."""
+    path = path or rel
+    if not in_scope(rel):
+        return []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []                      # lint.py already reports parse errors
+    pragmas = _pragmas(source)
+    out: List[LintViolation] = []
+
+    for line, reason in pragmas.items():
+        if not reason:
+            out.append(LintViolation(
+                path, line, "pragma-reason",
+                "nondeterminism-ok pragma missing its justification "
+                "(format: `# lint: nondeterminism-ok <reason>`)"))
+
+    hits = _nondet_hits(tree)
+    for site in _id_sites(tree, rel, path):
+        if site.canonical not in LOCKSTEP_IDS:
+            hits.append((
+                site.line, "lockstep-id",
+                f"monotonic id source {site.canonical!r} ({site.kind}) "
+                "is not declared in analysis/determinism.LOCKSTEP_IDS — "
+                "every process-global id stream must be declared and "
+                "minted through one audited funnel (or pragma "
+                "`# lint: nondeterminism-ok <reason>`)"))
+
+    for line, rule, msg in sorted(hits):
+        suppressed = any(
+            ln in pragmas and pragmas[ln]
+            for ln in (line, line - 1))
+        if not suppressed:
+            out.append(LintViolation(path, line, rule, msg))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cross-module registry
+# ---------------------------------------------------------------------------
+
+def id_registry(package_dir: str) -> List[IdSite]:
+    """Every monotonic-id mint site in the scoped modules (the
+    LOCKSTEP_IDS registry's ground truth)."""
+    sites: List[IdSite] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, package_dir).replace(os.sep, "/")
+            if not in_scope(rel):
+                continue
+            with open(full, "r") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            sites.extend(_id_sites(tree, rel, full))
+    return sites
+
+
+def check_registry(sites: List[IdSite],
+                   declared: Tuple[str, ...] = LOCKSTEP_IDS
+                   ) -> List[LintViolation]:
+    """Registry drift, the direction per-module linting cannot see: a
+    LOCKSTEP_IDS entry whose mint site no longer exists in the tree.
+    (Undeclared sites are flagged per-module by ``lint_source``.)"""
+    out: List[LintViolation] = []
+    found = {s.canonical for s in sites}
+    for name in declared:
+        if name not in found:
+            out.append(LintViolation(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "determinism.py"),
+                0, "lockstep-id",
+                f"LOCKSTEP_IDS declares {name!r} but no mint site for it "
+                "exists in the scoped modules — stale registry entry"))
+    return out

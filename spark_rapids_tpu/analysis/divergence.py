@@ -1,0 +1,292 @@
+"""Runtime cross-worker divergence audit: the runtime half of the
+lockstep-determinism discipline (``analysis/determinism.py`` is the
+static half).
+
+The standalone distributed mode has no driver: every worker executes the
+same query sequence and independently mints identical shuffle ids, stage
+ids and plan decisions (the lockstep contract, shuffle/manager.py). When
+that contract silently breaks, workers pair WRONG shuffles — wrong rows,
+no error. The per-exchange fingerprint handshake catches id-stream
+skew at fetch time; this audit catches the divergence itself, names the
+FIRST divergent event, and turns the failure mode loud.
+
+Mechanism: each worker folds its lockstep-relevant event stream —
+shuffle-id mints, exchange fingerprint registrations, stage-id draws,
+AQE decision records — into a per-query rolling SHA-1 digest, keeping a
+bounded ring of ``(index, prefix-digest, label)`` entries as the
+diagnostic window. The digest snapshot rides the existing shuffle META
+round trip (transport.py): every metadata reply carries the serving
+worker's snapshot for the fetching query, and the fetching worker
+compares rings entry-by-entry. Because each ring entry carries the
+PREFIX digest after folding event ``i``, the first index where the two
+rings disagree IS the first divergent event.
+
+Modes (conf ``spark.rapids.tpu.sql.analysis.divergence``):
+
+* ``off`` — no folding, no checks (the default; zero hot-path cost
+  beyond one module-flag read).
+* ``record`` — divergences are logged, flight-recorded (kind
+  ``desync``) and counted in ``tpu_desync_total``; execution continues
+  (the fingerprint handshake still fails hard where streams pair
+  wrongly).
+* ``enforce`` — a divergence raises :class:`DesyncError` naming the
+  first divergent event; ``exec/recovery.classify`` maps it to
+  FAIL_QUERY — a desync is never retried, retrying cannot un-diverge
+  the streams.
+
+Every comparison bumps ``tpu_divergence_checks_total``. The chaos
+harness point ``desync.inject`` (analysis/faults.py) folds one poisoned
+event into THIS worker's stream, driving the full detection path
+deterministically in tests.
+
+A worker being BEHIND is not divergence: rings are compared only on the
+indexes both sides retain, and a clean shared prefix with unequal counts
+just means one side has not folded the later events yet.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional
+
+from .lockdep import named_lock
+
+log = logging.getLogger("spark_rapids_tpu.divergence")
+
+MODES = ("off", "record", "enforce")
+
+#: diagnostic window per query stream (events beyond it fold into the
+#: rolling digest but lose their per-event diagnosis)
+RING_CAPACITY = 64
+
+#: bounded per-process query-stream table (oldest query evicted)
+_MAX_QUERIES = 32
+
+
+class DesyncError(RuntimeError):
+    """Lockstep divergence between this worker and a peer, detected by
+    the per-query digest audit. Deliberately NOT a ShuffleFetchError:
+    every transport/stage retry ladder lets it propagate un-retried, and
+    ``exec/recovery.classify`` maps it to FAIL_QUERY.
+
+    Attributes carry the diagnosis the flight-recorder dump scopes on:
+    ``query_id``, ``first_divergent_index`` (-1 when the streams
+    diverged before the diagnostic window), and ``mine``/``theirs`` —
+    each the ``(prefix_digest, label)`` pair at that index."""
+
+    def __init__(self, message: str, *, query_id: Optional[str] = None,
+                 index: Optional[int] = None,
+                 mine: Optional[Any] = None,
+                 theirs: Optional[Any] = None):
+        super().__init__(message)
+        self.query_id = query_id
+        self.first_divergent_index = index
+        self.mine = mine
+        self.theirs = theirs
+
+
+class _QueryStream:
+    """One query's rolling digest + bounded diagnostic ring."""
+
+    __slots__ = ("count", "sha", "ring")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sha = hashlib.sha1()
+        self.ring: deque = deque(maxlen=RING_CAPACITY)
+
+    def fold(self, label: str) -> None:
+        self.count += 1
+        self.sha.update(label.encode("utf-8", "replace"))
+        self.sha.update(b"\x00")
+        # the PREFIX digest after event `count`: comparing ring entries
+        # at the same index compares whole prefixes, so the first
+        # disagreeing index is the first divergent event
+        self.ring.append((self.count, self.sha.hexdigest()[:8], label))
+
+    @property
+    def digest(self) -> str:
+        return self.sha.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Process-global mode + per-query streams
+# ---------------------------------------------------------------------------
+
+_mu = named_lock("analysis.divergence._mu")
+_mode = "off"
+_streams: "OrderedDict[str, _QueryStream]" = OrderedDict()
+_checks_total = 0
+_desyncs_total = 0
+#: lock-free fast-path flag (the faults.ARMED pattern): read per mint on
+#: hot paths, written under ``_mu`` only; a stale read costs one no-op
+ARMED = False
+
+
+def install(mode: str) -> None:
+    """Set the audit mode directly (tests; sessions prime via
+    :func:`refresh`)."""
+    global _mode, ARMED
+    m = str(mode or "off").lower()
+    if m not in MODES:
+        raise ValueError(f"unknown divergence mode {m!r} (want {MODES})")
+    with _mu:
+        _mode = m
+        ARMED = m != "off"
+
+
+def mode() -> str:
+    return _mode
+
+
+def armed() -> bool:
+    return ARMED
+
+
+def refresh(conf=None) -> None:
+    """Prime the mode from a session conf (session bootstrap calls this
+    eagerly, the faults/telemetry pattern)."""
+    from .. import config as cfg
+    conf = conf or cfg.TpuConf()
+    install(str(conf.get(cfg.ANALYSIS_DIVERGENCE)))
+
+
+def reset() -> None:
+    """Disarm and drop every query stream + counter (test isolation)."""
+    global _mode, ARMED, _checks_total, _desyncs_total
+    with _mu:
+        _mode = "off"
+        ARMED = False
+        _streams.clear()
+        _checks_total = 0
+        _desyncs_total = 0
+
+
+def stats() -> Dict[str, Any]:
+    """Per-process audit counters (the bench runner's summary line)."""
+    with _mu:
+        return {"mode": _mode, "checks": _checks_total,
+                "desyncs": _desyncs_total, "queries": len(_streams)}
+
+
+# ---------------------------------------------------------------------------
+# Folding (the mint-site hooks call this)
+# ---------------------------------------------------------------------------
+
+def note_event(label: str, query_id: Optional[str] = None) -> None:
+    """Fold one lockstep-relevant event into the ambient (or named)
+    query's stream. No-op when the audit is off or no query is active —
+    the call sites stay unconditional."""
+    if not ARMED:
+        return
+    if query_id is None:
+        from ..exec.query_context import current_query_id
+        query_id = current_query_id()
+    if query_id is None:
+        return
+    # chaos hook: fold ONE poisoned event into THIS worker's stream
+    # before the real one — the peers' digests now disagree at exactly
+    # this index, driving the full detection path deterministically
+    from . import faults
+    inject = faults.armed() and faults.fire("desync.inject")
+    with _mu:
+        st = _streams.get(query_id)
+        if st is None:
+            st = _streams[query_id] = _QueryStream()
+            while len(_streams) > _MAX_QUERIES:
+                _streams.popitem(last=False)
+        if inject:
+            st.fold("fault:desync.inject")
+        st.fold(label)
+
+
+def snapshot(query_id: Optional[str]) -> Optional[Dict[str, Any]]:
+    """This worker's digest snapshot for ``query_id`` — what a metadata
+    reply carries back to the fetching peer. A query this worker has not
+    folded yet snapshots as the empty stream (the peer sees no common
+    window and treats it as lag, not divergence)."""
+    if not ARMED or not query_id:
+        return None
+    with _mu:
+        st = _streams.get(query_id)
+        if st is None:
+            return {"count": 0, "digest": "", "ring": []}
+        return {"count": st.count, "digest": st.digest,
+                "ring": [list(e) for e in st.ring]}
+
+
+# ---------------------------------------------------------------------------
+# Comparison (the fetching client calls this on every metadata reply)
+# ---------------------------------------------------------------------------
+
+def check(query_id: Optional[str], peer: Optional[Dict[str, Any]],
+          peer_label: str = "peer") -> None:
+    """Compare this worker's stream for ``query_id`` against a peer
+    snapshot. Divergence: ``record`` logs/counts, ``enforce`` raises
+    :class:`DesyncError` naming the first divergent event. Lag (a clean
+    shared prefix with unequal counts) passes."""
+    global _checks_total, _desyncs_total
+    if not ARMED or not query_id or not peer:
+        return
+    with _mu:
+        _checks_total += 1
+        st = _streams.get(query_id)
+        mine_count = st.count if st is not None else 0
+        mine_digest = st.digest if st is not None else ""
+        mine_ring = list(st.ring) if st is not None else []
+    try:
+        from ..service.telemetry import MetricsRegistry
+        MetricsRegistry.get().counter(
+            "tpu_divergence_checks_total",
+            "lockstep divergence digest comparisons").inc()
+    except Exception:
+        pass                     # telemetry must never change the audit
+    if st is None:
+        return                   # nothing folded locally yet: pure lag
+    ours = {int(i): (d, l) for i, d, l in mine_ring}
+    theirs = {int(i): (d, l) for i, d, l in (peer.get("ring") or ())}
+    first = None
+    for i in sorted(set(ours) & set(theirs)):
+        if ours[i][0] != theirs[i][0]:
+            first = i
+            break
+    if first is None:
+        peer_count = int(peer.get("count") or 0)
+        peer_digest = str(peer.get("digest") or "")
+        if peer_count == mine_count and peer_digest and \
+                mine_digest != peer_digest:
+            # same length, same retained window, different digests: the
+            # divergence predates the diagnostic ring
+            first = -1
+        else:
+            return               # in sync, or one side merely behind
+    mine_at = ours.get(first)
+    theirs_at = theirs.get(first)
+    if first >= 0:
+        msg = (f"lockstep streams diverged on query {query_id} at event "
+               f"#{first}: this worker folded {mine_at[1]!r}, "
+               f"{peer_label} folded {theirs_at[1]!r}")
+    else:
+        msg = (f"lockstep streams diverged on query {query_id} before "
+               f"the {RING_CAPACITY}-event diagnostic window (digest "
+               f"{mine_digest} vs {peer.get('digest')}); re-run with "
+               "the audit armed from query start for the first event")
+    with _mu:
+        _desyncs_total += 1
+    try:
+        from ..service.telemetry import MetricsRegistry, flight_record
+        flight_record("desync", query_id, {
+            "index": first, "peer": peer_label,
+            "mine": list(mine_at) if mine_at else None,
+            "theirs": list(theirs_at) if theirs_at else None})
+        MetricsRegistry.get().counter(
+            "tpu_desync_total",
+            "lockstep divergences detected by the digest audit").inc()
+    except Exception:
+        pass
+    if _mode == "enforce":
+        raise DesyncError(msg, query_id=query_id, index=first,
+                          mine=mine_at, theirs=theirs_at)
+    log.warning("%s (divergence=record: continuing)", msg)

@@ -14,7 +14,7 @@ Spec grammar (see docs/resilience.md)::
     spec     := clause (';' clause)*
     clause   := point [':' count] ['@' selector]
     point    := fetch.fail | conn.kill | task.poison | worker.die
-              | mesh.drop
+              | mesh.drop | desync.inject
     count    := positive int, default 1 — firings before the clause
                 disarms
     selector := 'p<pid>' ['b<batch>'] | 'b<batch>'   (task.poison)
@@ -39,6 +39,11 @@ Points and where they fire:
 * ``mesh.drop`` — the next exchange plane resolution sees the ICI mesh
   as having lost a participant (``exec/recovery.note_mesh_lost``) and
   declines gracefully to DCN.
+* ``desync.inject`` — the divergence audit (analysis/divergence.py)
+  folds one poisoned event into THIS worker's lockstep stream before
+  its next real event: the peers' per-query digests now disagree at
+  exactly that index, driving the full desync detection path
+  (DesyncError with first-divergent-event diagnosis) deterministically.
 
 Every firing lands in the flight recorder (kind ``fault``) and bumps
 ``tpu_faults_injected_total``, so a recovery post-mortem shows the
@@ -53,7 +58,7 @@ from typing import Callable, Dict, List, Optional
 from .lockdep import named_lock
 
 POINTS = ("fetch.fail", "conn.kill", "task.poison", "worker.die",
-          "mesh.drop")
+          "mesh.drop", "desync.inject")
 
 _CLAUSE_RE = re.compile(
     r"^(?P<point>[a-z.]+)(?::(?P<count>\d+))?(?:@(?P<sel>[a-z0-9]+))?$")

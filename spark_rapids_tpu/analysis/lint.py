@@ -277,6 +277,11 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # concurrency.py imports LintViolation from here
     from . import concurrency
     out.extend(concurrency.lint_source(source, rel, path=path))
+    # determinism rules (nondet-clock / nondet-random / nondet-set-order /
+    # nondet-scan / lockstep-id) over the lockstep-reachable modules —
+    # same lazy-import shape
+    from . import determinism
+    out.extend(determinism.lint_source(source, rel, path=path))
     return out
 
 
@@ -867,6 +872,40 @@ def run(package_dir: str, docs_dir: Optional[str] = None
     from . import concurrency
     out.extend(concurrency.check_registry(
         concurrency.lock_registry(package_dir)))
+    # cross-module determinism check: a LOCKSTEP_IDS entry whose mint
+    # site vanished is a stale registry (the other direction — an
+    # undeclared mint site — is flagged per module)
+    from . import determinism
+    out.extend(determinism.check_registry(
+        determinism.id_registry(package_dir)))
+    return out
+
+
+_ANY_PRAGMA_RE = re.compile(r"#\s*lint:\s*([a-z-]+)-ok(.*)$")
+
+
+def collect_pragmas(package_dir: str) -> List[Dict[str, object]]:
+    """Every ``# lint: <rule>-ok`` suppression pragma in the package,
+    with its rule tag, reason, and validity (reason-less pragmas do not
+    suppress) — the machine-readable half of ``--json`` output, so CI
+    can audit what the tree suppresses and why."""
+    out: List[Dict[str, object]] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, package_dir).replace(os.sep, "/")
+            with open(full, "r") as f:
+                for i, line in enumerate(f, start=1):
+                    m = _ANY_PRAGMA_RE.search(line)
+                    if m:
+                        reason = m.group(2).strip()
+                        out.append({"path": rel, "line": i,
+                                    "rule": m.group(1),
+                                    "reason": reason,
+                                    "suppresses": bool(reason)})
     return out
 
 
@@ -886,7 +925,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     violations = run(package_dir)
     if as_json:
-        print(json.dumps([vars(v) for v in violations], indent=2))
+        # machine-readable findings + pragma status: what fired, and
+        # what the tree suppresses (with each suppression's reason)
+        print(json.dumps({
+            "violations": [vars(v) for v in violations],
+            "pragmas": collect_pragmas(package_dir)}, indent=2))
     else:
         for v in violations:
             print(v)

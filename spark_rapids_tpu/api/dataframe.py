@@ -372,13 +372,23 @@ class DataFrame:
         # spans, flight events, shuffle protocol traffic and exchange
         # stage ids all attribute to this query — lockstep-deterministic,
         # so distributed workers running the same query mint the same id
-        qid = qc.mint_query_id(exec_plan)
+        # a pre-minted reservation (qc.reserve_query) wins over a fresh
+        # mint: concurrent distributed drivers mint their contexts in
+        # lockstep program order on the main thread, then collect on
+        # worker threads — the racy collect order must not draw from
+        # the query-id counter
+        ctx = qc.take_reserved()
+        if ctx is not None:
+            qid = ctx.query_id
+        else:
+            qid = qc.mint_query_id(exec_plan)
+            # the context picks up the ambient tenant hint (the
+            # service's tenant_scope on this thread); captured here so
+            # the query-log record and session surface carry it after
+            # the scope closes
+            ctx = qc.QueryContext(qid)
         self.session._last_query_id = qid
         qc.note_thread_query_id(qid)
-        # the context picks up the ambient tenant hint (the service's
-        # tenant_scope on this thread); captured here so the query-log
-        # record and session surface carry it after the scope closes
-        ctx = qc.QueryContext(qid)
         self.session._last_tenant = ctx.tenant
         self.session._last_first_row_s = None
         from ..analysis import faults as _faults
@@ -504,10 +514,16 @@ class DataFrame:
             from ..analysis import lockdep, recompile
             rc0 = recompile.snapshot()
             lk0 = lockdep.stats()
-        qid = qc.mint_query_id(exec_plan)
+        # reserved contexts win here too (the materializing collect's
+        # adoption rule, above)
+        ctx = qc.take_reserved()
+        if ctx is not None:
+            qid = ctx.query_id
+        else:
+            qid = qc.mint_query_id(exec_plan)
+            ctx = qc.QueryContext(qid)
         self.session._last_query_id = qid
         qc.note_thread_query_id(qid)
-        ctx = qc.QueryContext(qid)
         # the streaming marker rides the context to every partition-drain
         # worker thread: cold stage builds route to the compile pool
         # instead of blocking the first batches (compile_pool.routable)

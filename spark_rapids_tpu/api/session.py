@@ -78,6 +78,9 @@ class RuntimeConf:
         if ".faults." in key:
             from ..analysis import faults
             faults.refresh(self._session.conf)
+        if ".analysis.divergence" in key:
+            from ..analysis import divergence
+            divergence.refresh(self._session.conf)
         # ANY conf change drops the session's serving caches: cached
         # plans were analyzed/optimized/validated under the old conf, and
         # a stored result may have been produced by it (the parse cache
@@ -232,6 +235,10 @@ class TpuSession:
         from ..exec import recovery
         recovery.refresh(self.conf)
         faults.refresh(self.conf)
+        # lockstep divergence audit mode (analysis/divergence.py): primed
+        # eagerly like faults — the mint-site hooks read a lock-free flag
+        from ..analysis import divergence
+        divergence.refresh(self.conf)
         # cold-path killers (docs/compile.md §5): reload the AQE
         # cardinality-feedback checkpoint and prewarm the hottest fused
         # stages from the corpus beside the signature index. Both are

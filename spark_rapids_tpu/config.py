@@ -329,8 +329,8 @@ FAULTS_SPEC = _conf("spark.rapids.tpu.sql.faults.spec").doc(
     "Deterministic fault-injection spec for the chaos harness "
     "(analysis/faults.py, docs/resilience.md): semicolon-separated "
     "point[:count][@selector] clauses over fetch.fail, conn.kill, "
-    "task.poison, worker.die, mesh.drop — each fires a bounded number "
-    "of times, flight-recorded and counted in "
+    "task.poison, worker.die, mesh.drop, desync.inject — each fires a "
+    "bounded number of times, flight-recorded and counted in "
     "tpu_faults_injected_total. Empty disables injection"
 ).string_conf.create_with_default("")
 
@@ -605,6 +605,19 @@ ANALYSIS_SYNC_AUDIT = _conf("spark.rapids.tpu.sql.analysis.syncAudit").doc(
     "real accelerators; explicit batched resolves (jax.device_get) stay "
     "legal (analysis/sync_audit.py)").string_conf.check(
         lambda v: str(v).lower() in ("off", "log", "disallow")
+).create_with_default("off")
+
+ANALYSIS_DIVERGENCE = _conf("spark.rapids.tpu.sql.analysis.divergence").doc(
+    "Cross-worker lockstep divergence audit: off, record, enforce. Each "
+    "worker folds its lockstep-relevant event stream (shuffle-id mints, "
+    "exchange fingerprints, stage-id draws, AQE decisions) into a "
+    "per-query rolling digest carried on the shuffle metadata round "
+    "trip; a mismatch names the FIRST divergent event. record logs, "
+    "flight-records and counts (tpu_desync_total); enforce raises a "
+    "typed DesyncError the recovery ladder maps to fail-query — a "
+    "desync is never retried (analysis/divergence.py, docs/analysis.md "
+    "§6)").string_conf.check(
+        lambda v: str(v).lower() in ("off", "record", "enforce")
 ).create_with_default("off")
 
 ANALYSIS_RECOMPILE_AUDIT = _conf(

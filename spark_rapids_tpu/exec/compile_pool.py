@@ -424,10 +424,13 @@ def note_stage_signature(key, kernel: str, chain, donate: tuple,
         log.debug("prewarm corpus record skipped for %s: %s", kernel, e)
 
 
-def _load_corpus(path: str) -> List[Tuple[int, int, dict]]:
-    """Corpus entries ranked hottest-first: (build count, last line no,
+def _load_corpus(path: str) -> List[Tuple[int, str, dict]]:
+    """Corpus entries ranked hottest-first: (build count, signature,
     latest entry) per signature. Torn tail lines are skipped, exactly
-    like the signature index load."""
+    like the signature index load. Ties break on the stable signature
+    hash, NOT file position — two corpora with the same content in a
+    different append order replay identically (the prewarm order is
+    lockstep-observable through compile timing)."""
     import json
     counts: Dict[str, int] = {}
     latest: Dict[str, Tuple[int, dict]] = {}
@@ -448,8 +451,8 @@ def _load_corpus(path: str) -> List[Tuple[int, int, dict]]:
                 latest[sig] = (i, ent)
     except OSError:
         return []
-    ranked = [(counts[sig], i, ent) for sig, (i, ent) in latest.items()]
-    ranked.sort(key=lambda t: (-t[0], -t[1]))
+    ranked = [(counts[sig], sig, ent) for sig, (_i, ent) in latest.items()]
+    ranked.sort(key=lambda t: (-t[0], t[1]))
     return ranked
 
 

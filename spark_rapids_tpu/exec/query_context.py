@@ -101,6 +101,28 @@ class QueryContext:
         return next(self._stage_seq)
 
 
+def reserve_query(ctx: QueryContext) -> QueryContext:
+    """Pre-mint a query identity for the NEXT collect on THIS thread:
+    the collect adopts ``ctx`` instead of minting a fresh id (one-shot —
+    the reservation clears when taken). This is how a driver runs two
+    distributed queries CONCURRENTLY while keeping the mint order
+    lockstep: mint both contexts on the main thread in program order
+    (every worker draws the same ``q<seq>`` values), then collect each
+    on its own thread under its reserved context — the racy per-thread
+    collect order no longer touches the query-id counter, and shuffle
+    ids stay namespaced consistently across workers (docs/shuffle.md)."""
+    _tls.reserved = ctx  # lint: unguarded-ok reserving thread's own TLS field
+    return ctx
+
+
+def take_reserved() -> Optional[QueryContext]:
+    """Adopt-and-clear this thread's reserved context (collect paths)."""
+    ctx = getattr(_tls, "reserved", None)
+    if ctx is not None:
+        _tls.reserved = None  # lint: unguarded-ok collecting thread's own TLS field
+    return ctx
+
+
 _tls = threading.local()
 _default_stack: List[QueryContext] = []
 # guards _default_stack (the SyncCounter._default_stack discipline):

@@ -81,10 +81,16 @@ def recoverable_types() -> Tuple[type, ...]:
 
 def classify(exc: BaseException) -> RecoveryAction:
     """Map one failure to its recovery action (the table above)."""
+    from ..analysis.divergence import DesyncError
     from ..shuffle.transport import (ShuffleDesyncError, ShuffleFetchError,
                                      ShuffleProtocolError,
                                      ShuffleWorkerLostError)
     from .spill import BufferLostError
+    if isinstance(exc, DesyncError):
+        # the digest audit's typed divergence: retrying cannot un-diverge
+        # lockstep streams, and the exception already carries the
+        # first-divergent-event diagnosis the post-mortem needs
+        return RecoveryAction.FAIL_QUERY
     if isinstance(exc, ShuffleDesyncError):
         return RecoveryAction.FAIL_QUERY
     if isinstance(exc, ShuffleProtocolError):

@@ -124,6 +124,14 @@ def record_decision(node, rule: str, *, applied: bool = True,
             "tpu_aqe_decisions_total",
             "adaptive-execution decisions (applied and declined)",
             rule=rule).inc()
+        # an adaptive decision is a lockstep-relevant event: fold the
+        # decision (not its per-worker before/after numbers — those are
+        # mesh-consistent only after the allreduce) into the per-query
+        # divergence digest (analysis/divergence.py)
+        from ..analysis import divergence
+        divergence.note_event(
+            f"aqe:{rule}:{'applied' if applied else 'declined'}:"
+            f"{stage_id}:{type(node).__name__}")
     except Exception:
         pass               # observability must never fail the decision
     return d
@@ -614,9 +622,10 @@ def reload_checkpoint(conf) -> int:
             return 0
         import json
         entries: Dict[str, Dict[str, Any]] = {}
+        last_pos: Dict[str, int] = {}
         try:
             with open(path) as f:
-                for line in f:
+                for pos, line in enumerate(f):
                     line = line.strip()
                     if not line:
                         continue
@@ -629,13 +638,19 @@ def reload_checkpoint(conf) -> int:
                         continue       # torn tail / bad line: skip
                     entries[key] = {"actuals": actuals,
                                     "cost": int(ent.get("cost", 0) or 0)}
+                    last_pos[key] = pos
         except OSError:
             return 0
         loaded = 0
         with _history_mu:
-            # newest file entries win the bounded slots: iterate in file
-            # order so later (newer) lines land later in the LRU
-            for key, ent in entries.items():
+            # newest file entries win the bounded slots: insert in
+            # LAST-OCCURRENCE order keyed by fingerprint, NOT dict
+            # (first-seen) order — a compacted vs an appended file with
+            # the same final content must produce the same bank, so
+            # later (newer) lines land later in the LRU regardless of
+            # where a key first appeared
+            for key in sorted(entries, key=last_pos.__getitem__):
+                ent = entries[key]
                 if key not in _FEEDBACK and ent["actuals"]:
                     _FEEDBACK[key] = ent["actuals"]
                     loaded += 1

@@ -80,7 +80,7 @@ PARAM_DTYPES = (dt.BOOL, dt.INT8, dt.INT16, dt.INT32, dt.INT64,
 
 _tok_lock = threading.Lock()  # lint: raw-lock-ok leaf token-registry lock; never taken with another engine lock held
 _TOKENS: Dict[int, int] = {}          # id(obj) -> stable token
-_token_counter = itertools.count(1)
+_token_counter = itertools.count(1)  # lint: nondeterminism-ok process-local cache-identity token, never compared across workers
 #: live result caches, purged when a token's owner is collected
 _RESULT_CACHES: "weakref.WeakSet" = weakref.WeakSet()
 

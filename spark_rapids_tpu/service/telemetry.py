@@ -122,6 +122,10 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_worker_rejoin_total",
     "tpu_recovery_seconds",             # histogram, failure -> recovered
     "tpu_faults_injected_total",        # deterministic chaos firings
+    # lockstep divergence audit (analysis/divergence.py,
+    # docs/analysis.md §6)
+    "tpu_divergence_checks_total",      # digest comparisons on META replies
+    "tpu_desync_total",                 # divergences detected
     # query-lifecycle observability (docs/observability.md §8)
     "tpu_exchange_partition_bytes",     # histogram, label plane=ici|dcn
     "tpu_exchange_skew_factor",         # gauge, last exchange, label plane
@@ -700,15 +704,19 @@ def dump_on_error(exc: BaseException) -> Optional[str]:
         existing = getattr(exc, "_tpu_flight_dump", None)
         if existing is not None:
             return existing
-        # scope the artifact to the FAILING query: the dump runs on the
-        # failing task/collect thread, so the ambient query context IS
-        # the query that died — its id lands in the filename and other
-        # concurrent queries' attributed events are filtered out
-        try:
-            from ..exec.query_context import current_query_id
-            qid = current_query_id()
-        except Exception:
-            qid = None
+        # scope the artifact to the FAILING query: an exception that
+        # names its query (DesyncError carries query_id) wins — a
+        # desync post-mortem must filter to the DESYNCED query even
+        # when the dump runs on a thread whose ambient context moved
+        # on; otherwise the ambient context on the failing task/collect
+        # thread IS the query that died
+        qid = getattr(exc, "query_id", None)
+        if qid is None:
+            try:
+                from ..exec.query_context import current_query_id
+                qid = current_query_id()
+            except Exception:
+                qid = None
         path = FlightRecorder.get().dump(
             reason=f"{type(exc).__name__}: {exc}", query_id=qid)
         try:

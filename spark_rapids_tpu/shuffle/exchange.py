@@ -157,6 +157,13 @@ def assign_stage(node) -> None:
     node.stage_id = ctx.next_stage_id() if ctx is not None else None
     node.stage_stats = None            # fresh per execution
     node._aqe_decisions = []           # fresh per execution (plan/aqe.py)
+    if node.stage_id is not None:
+        # a stage-id draw is a lockstep-relevant event: fold it into the
+        # per-query divergence digest (analysis/divergence.py)
+        from ..analysis import divergence
+        divergence.note_event(
+            f"stage-id:{node.stage_id}:{type(node).__name__}",
+            query_id=node.query_id)
 
 
 def record_local_shuffle_stats(node, shuffle) -> None:

@@ -31,6 +31,7 @@ from Spark's stage scheduler; the flag replaces it standalone).
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -43,6 +44,30 @@ from ..columnar.batch import ColumnarBatch
 from .transport import (ShuffleClient, ShuffleDesyncError, ShuffleFetchError,
                         ShuffleServer, ShuffleStore, ShuffleWorkerLostError,
                         _rebuild_batch)
+
+#: shuffle-id namespace width: ids are ``(query seq << NS_SHIFT) + n``,
+#: giving each query its own 2**NS_SHIFT-wide id range (docs/shuffle.md).
+#: Query ids are lockstep-deterministic (exec/query_context.py), so every
+#: worker derives the SAME namespace for the same query — which is what
+#: lets two distributed queries be in flight CONCURRENTLY without
+#: desyncing the id stream (the old single global counter interleaved
+#: nondeterministically under concurrency).
+NS_SHIFT = 20
+
+_QSEQ_RE = re.compile(r"^q(\d+)")
+
+
+def _query_namespace() -> int:
+    """The shuffle-id namespace of the AMBIENT query: its lockstep query
+    sequence number (the ``q<seq>`` prefix every worker mints identically
+    for the same query), or namespace 0 when no query context is active
+    (direct shuffle-layer callers, tests)."""
+    from ..exec.query_context import current_query_id
+    qid = current_query_id()
+    if not qid:
+        return 0
+    m = _QSEQ_RE.match(qid)
+    return int(m.group(1)) if m else 0
 
 
 class WorkerContext:
@@ -92,12 +117,15 @@ class WorkerContext:
         self.codec = codec
         self.peers: Dict[int, Tuple[str, int]] = {}
         self.fetch_timeout_s = fetch_timeout_s
-        # the lockstep counter resumes PAST any durable-reloaded ids:
-        # reusing a previous incarnation's shuffle id would merge its
-        # rows into a new query and answer peers' completion polls from
-        # the stale mark (an id colliding with a peer's LATER exchange
-        # fails the fingerprint handshake loudly instead)
-        self._next_shuffle = self.store.durable_max_shuffle_id() + 1
+        # per-query-NAMESPACE lockstep counters (LOCKSTEP_IDS registry,
+        # analysis/determinism.py), resumed lazily on first mint: each
+        # namespace's counter starts PAST any durable-reloaded ids in
+        # that namespace — reusing a previous incarnation's shuffle id
+        # would merge its rows into a new query and answer peers'
+        # completion polls from the stale mark (an id colliding with a
+        # peer's LATER exchange fails the fingerprint handshake loudly
+        # instead)
+        self._next_by_ns: Dict[int, int] = {}
         self._peer_complete: set = set()    # (worker_id, shuffle_id)
         self._lost: set = set()             # failed-send-detected peers
         self._mu = named_lock("shuffle.manager.WorkerContext._mu")
@@ -109,11 +137,29 @@ class WorkerContext:
 
     def next_shuffle_id(self) -> int:
         """Deterministic across workers running the same query sequence
-        (the standalone replacement for driver-issued shuffle ids)."""
+        (the standalone replacement for driver-issued shuffle ids),
+        NAMESPACED by the ambient query: ``(query seq << NS_SHIFT) + n``.
+        Two concurrent distributed queries draw from disjoint counters,
+        so their interleaving cannot desync the id stream — the gating
+        contract for concurrent distributed serving (docs/shuffle.md)."""
+        ns = _query_namespace()
+        base = ns << NS_SHIFT
         with self._mu:
-            sid = self._next_shuffle
-            self._next_shuffle += 1
-            return sid
+            nxt = self._next_by_ns.get(ns)
+            if nxt is None:
+                # first mint in this namespace: resume past the durable
+                # tier's ids WITHIN the namespace (a rejoining worker
+                # re-serving old outputs must not re-mint their ids)
+                nxt = max(base, self.store.durable_max_shuffle_id_in(
+                    base, base + (1 << NS_SHIFT))) + 1
+            sid = nxt
+            self._next_by_ns[ns] = sid + 1
+        # the mint is a lockstep-relevant event: fold it into the
+        # per-query divergence digest (outside the mutex — the audit
+        # takes its own leaf lock and may flight-record)
+        from ..analysis import divergence
+        divergence.note_event(f"shuffle-id:{sid}")
+        return sid
 
     def owns_reduce(self, p: int) -> bool:
         return p % self.n_workers == self.worker_id
@@ -350,6 +396,9 @@ class DistributedShuffle:
             # bind BEFORE any write: peers polling completion already get
             # fingerprint validation on their first metadata round trip
             ctx.store.set_fingerprint(self.shuffle_id, fingerprint)
+            from ..analysis import divergence
+            divergence.note_event(
+                f"fingerprint:{self.shuffle_id}:{fingerprint[:16]}")
         self._wrote = False
 
     # -- map side ------------------------------------------------------------

@@ -168,7 +168,7 @@ class ShuffleStore:
         descs = [ArrayDesc(str(a.dtype), a.shape, a.nbytes) for a in arrays]
         with self._mu:
             bid = self._next_id
-            self._next_id += 1
+            self._next_id += 1  # lint: nondeterminism-ok store-local buffer id, exchanged via metadata — never minted in lockstep
             desc = BufferDesc(
                 bid, shuffle_id, reduce_id, batch.num_rows,
                 [f.name for f in batch.schema],
@@ -302,8 +302,12 @@ class ShuffleStore:
         with self._mu:
             self._durable_complete_order.sort()
         self._enforce_durable_budget()
-        for fp_path in glob.glob(
-                os.path.join(self.durable_dir, "fp-*")):
+        # sorted like the buf-*/complete-* scans above: directory order
+        # is filesystem-dependent, and a lockstep worker replaying the
+        # reload must observe the same sequence every time
+        # (nondet-scan, analysis/determinism.py)
+        for fp_path in sorted(glob.glob(
+                os.path.join(self.durable_dir, "fp-*"))):
             try:
                 sid = int(os.path.basename(fp_path).split("-", 1)[1])
                 with open(fp_path) as f:
@@ -319,6 +323,17 @@ class ShuffleStore:
         """Highest shuffle id the durable reload saw (0 when none)."""
         with self._mu:
             return self._durable_max_sid
+
+    def durable_max_shuffle_id_in(self, lo: int, hi: int) -> int:
+        """Highest durable shuffle id in ``[lo, hi)``, or ``lo`` when
+        none — the per-NAMESPACE counter resume (shuffle/manager.py
+        mints ids namespaced by query, so a rejoining worker advances
+        each namespace's counter past only ITS OWN durable ids)."""
+        with self._mu:
+            sids = [s for s in (set(self._durable_sid_bytes) |
+                                set(self._durable_complete_order))
+                    if lo <= s < hi]
+        return max(sids) if sids else lo
 
     def _unlink_durable(self, bids: List[int],
                         shuffle_id: Optional[int] = None) -> None:
@@ -556,7 +571,7 @@ class ShuffleServer:
                                   {"error": f"{type(e).__name__}: {e}"})
                 return
             with self._threads_mu:
-                self._conn_seq += 1
+                self._conn_seq += 1  # lint: nondeterminism-ok connection-thread naming only; never crosses workers
                 seq = self._conn_seq
             t = threading.Thread(target=self.handle_connection,
                                  args=(SocketConnection(sock),),
@@ -623,9 +638,19 @@ class ShuffleServer:
                                        "released by the full worker quorum"}))
                         continue
                     metas = self.store.metas(sid, header["reduce_ids"])
-                    conn.send(encode_frame(META_RESP, {
-                        "buffers": [m.to_json() for m in metas],
-                        "complete": self.store.is_complete(sid)}))
+                    resp = {"buffers": [m.to_json() for m in metas],
+                            "complete": self.store.is_complete(sid)}
+                    if peer_q:
+                        # divergence audit (analysis/divergence.py):
+                        # THIS worker's per-query digest snapshot rides
+                        # the metadata reply, so the fetching peer
+                        # compares lockstep streams on every round trip
+                        # it already pays for
+                        from ..analysis import divergence
+                        div = divergence.snapshot(peer_q)
+                        if div is not None:
+                            resp["divergence"] = div
+                    conn.send(encode_frame(META_RESP, resp))
                 elif msg_type == XFER_REQ:
                     self._send_buffers(conn, header["buffer_ids"])
                 elif msg_type == RELEASE:
@@ -800,6 +825,18 @@ class ShuffleClient:
                 if msg_type == ERROR and header.get("code") in (
                         "desync", "released"):
                     self._raise_protocol_error(shuffle_id, header)
+                if msg_type == META_RESP and \
+                        header.get("divergence") is not None:
+                    # digest audit on the completion poll too: a desync
+                    # surfaces on the FIRST round trip after divergence,
+                    # not after a full straggler wait (enforce raises
+                    # DesyncError here — typed RuntimeError, so the
+                    # poll's transient-failure handling never eats it)
+                    from ..analysis import divergence
+                    divergence.check(_current_query_id(),
+                                     header["divergence"],
+                                     peer_label=f"peer serving shuffle "
+                                                f"{shuffle_id}")
                 complete = msg_type == META_RESP and header.get("complete")
                 last_conn_err = None
             except (ConnectionError, OSError) as e:
@@ -891,6 +928,12 @@ class ShuffleClient:
             if msg_type == ERROR:
                 self._raise_protocol_error(shuffle_id, header)
             assert msg_type == META_RESP, msg_type
+            if header.get("divergence") is not None:
+                from ..analysis import divergence
+                divergence.check(_current_query_id(),
+                                 header["divergence"],
+                                 peer_label=f"peer serving shuffle "
+                                            f"{shuffle_id}")
             metas = [BufferDesc.from_json(d) for d in header["buffers"]]
 
             # pending transfer queue with inflight-byte throttling
