@@ -53,6 +53,10 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         # lock-order graph + per-lock wait/hold attribution on for bench
         # runs (the documented tests/bench default for analysis.lockdep)
         "spark.rapids.tpu.sql.analysis.lockdep", "record").config(
+        # buffer-lifecycle ledger in record mode (analysis/ledger.py):
+        # every bench round reports leaks/use-after-free without ever
+        # failing a measurement — the lockdep discipline for HBM
+        "spark.rapids.tpu.sql.analysis.bufferLedger", "record").config(
         # persistent compile cache: repeated runner invocations against
         # the same dir pay disk hits instead of cold builds
         "spark.rapids.tpu.sql.compile.cacheDir",
@@ -119,6 +123,8 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             sem0 = TpuSemaphore.get().stats()
             rc0 = recompile.snapshot()
             lk0 = lockdep.stats()
+            from spark_rapids_tpu.analysis import ledger as _ledger
+            led0 = _ledger.stats()
             for it in range(iterations):
                 if it == 1:
                     # capture (listener snapshots + QueryExecution build)
@@ -172,6 +178,27 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
             locks = _lock_delta(lk0, lockdep.stats())
             if locks:
                 entry["locks"] = locks
+            # buffer-lifecycle verdict for this query: the end-of-query
+            # audit of the LAST iteration (leaks, peak device bytes)
+            # plus the run-counter deltas across all iterations — a
+            # query whose iterations leak or touch dead buffers says so
+            # in its own report entry
+            led1 = _ledger.stats()
+            led = {k: led1[k] - led0[k]
+                   for k in ("leaks", "use_after_free",
+                             "use_after_donate", "double_free")
+                   if led1[k] - led0[k]}
+            last_audit = getattr(session, "_last_ledger", None)
+            if last_audit:
+                entry["ledger"] = {
+                    "leakedBuffers": last_audit.get("leakedBuffers", 0),
+                    "leakedBytes": last_audit.get("leakedBytes", 0),
+                    "peakDeviceBytes":
+                        last_audit.get("peakDeviceBytes", 0),
+                    **({"deltas": led} if led else {}),
+                }
+            elif led:
+                entry["ledger"] = {"deltas": led}
             try:
                 # per-exchange shuffle accounting (docs/shuffle.md): which
                 # data plane each exchange took (ici collectives vs the
@@ -338,14 +365,20 @@ def run_benchmark(sf: float = 0.01, query_names: Optional[List[str]] = None,
         _pkg = _os.path.dirname(_os.path.abspath(_lint.__file__))
         _pkg = _os.path.dirname(_pkg)          # spark_rapids_tpu/
         _viol = _lint.run(_pkg)
+        from spark_rapids_tpu.analysis import ledger as _led
         report["analysis"] = {
             "lintViolations": len(_viol),
             "divergence": _div.stats(),
+            "ledger": _led.stats(),
         }
         _dv = report["analysis"]["divergence"]
+        _lg = report["analysis"]["ledger"]
         print(f"ANALYSIS lint_violations={len(_viol)} "
               f"divergence_mode={_dv['mode']} "
-              f"divergence_checks={_dv['checks']} desyncs={_dv['desyncs']}")
+              f"divergence_checks={_dv['checks']} desyncs={_dv['desyncs']} "
+              f"ledger_mode={_lg['mode']} audits={_lg['audits']} "
+              f"leaks={_lg['leaks']} "
+              f"use_after_free={_lg['use_after_free']}")
     except Exception as e:        # the summary must not kill the report
         report["analysis_error"] = str(e)[:200]
     if output:

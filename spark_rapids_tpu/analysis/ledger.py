@@ -1,0 +1,491 @@
+"""Runtime buffer-lifecycle ledger: the runtime half of the
+device-memory ownership discipline (``analysis/ownership.py`` is the
+static half).
+
+Four subsystems transfer buffer ownership without a common audit trail:
+fused-program donation (the consumed batch's arrays are dead after the
+call), the 3-tier spill store (register/acquire/tier-move/remove), the
+durable-shuffle disk pin, and the staging arena. When a hand-off goes
+wrong the failure is silent — a leaked device buffer just narrows the
+HBM budget until some innocent query pays the spill cascade, and a
+freed buffer read back through jax surfaces as a bare "Array has been
+deleted" with no owner, no site, no query. This ledger tags every
+lifecycle event with the ambient query id and a compact allocation
+site, so the failure modes become typed, attributed diagnoses — the
+ASAN discipline applied to HBM residency.
+
+Mechanism: ``exec/spill.py`` calls :func:`note_register` /
+:func:`note_access` / :func:`note_tier` / :func:`note_free` at its
+register/acquire/tier-flip/remove boundaries; donated fused calls mark
+the consumed batch via :func:`mark_donated` and the batch's array
+funnels call :func:`check_batch_access`. At collect end the driver
+calls :func:`end_of_query`: buffers minted by that query and still
+DEVICE-resident — excluding cache-priority registrations and
+disk-pinned durable outputs, the two deliberate ownership transfers —
+are leaks.
+
+Modes (conf ``spark.rapids.tpu.sql.analysis.bufferLedger``):
+
+* ``off`` — no tracking (the default; one module-flag read per hook).
+* ``record`` — leaks and dead-buffer accesses are logged,
+  flight-recorded and counted (``tpu_buffer_leaks_total``,
+  ``tpu_use_after_free_total``); execution continues. The test suite
+  and the bench runner run here (the lockdep precedent).
+* ``enforce`` — a leak raises :class:`BufferLeakError` at collect end;
+  an access to a freed/donated buffer raises
+  :class:`UseAfterFreeError` / :class:`UseAfterDonateError` at the
+  access site, with the mint/free sites in the message.
+
+The ledger lock is a LEAF: no hook calls the catalog, telemetry or the
+flight recorder while holding it (``end_of_query`` snapshots catalog
+residency FIRST — the catalog's admission lock may itself be held
+around ``note_tier``, so the reverse order would deadlock under
+lockdep enforce).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from .lockdep import named_lock
+
+log = logging.getLogger("spark_rapids_tpu.ledger")
+
+MODES = ("off", "record", "enforce")
+
+#: bounded per-process tables (oldest evicted)
+_MAX_QUERIES = 32
+_MAX_TOMBSTONES = 4096
+
+#: frames never named in an allocation site (the hook plumbing itself)
+_SITE_SKIP = ("analysis/ledger.py", "exec/spill.py")
+
+
+class BufferLifecycleError(RuntimeError):
+    """Base of the ledger's typed diagnoses. Attributes carry what the
+    flight-recorder dump scopes on: ``buffer_id``, ``query_id`` (the
+    minting query), and ``site`` (the mint site)."""
+
+    def __init__(self, message: str, *, buffer_id: Optional[int] = None,
+                 query_id: Optional[str] = None,
+                 site: Optional[str] = None):
+        super().__init__(message)
+        self.buffer_id = buffer_id
+        self.query_id = query_id
+        self.site = site
+
+
+class BufferLeakError(BufferLifecycleError):
+    """End-of-query residency audit: buffers minted by the finished
+    query are still device-resident and not cache/durable-owned."""
+
+
+class UseAfterFreeError(BufferLifecycleError):
+    """A freed (tombstoned) buffer was accessed again."""
+
+
+class UseAfterDonateError(BufferLifecycleError):
+    """A batch whose arrays were donated to a fused program was read
+    again — jax would surface this as a bare 'Array has been deleted'
+    with no owner attribution."""
+
+
+class DoubleFreeError(BufferLifecycleError):
+    """An already-freed buffer was freed again."""
+
+
+class _Entry:
+    """One tracked buffer's provenance."""
+
+    __slots__ = ("buffer_id", "query_id", "tenant", "site", "nbytes",
+                 "priority", "tier", "free_site")
+
+    def __init__(self, buffer_id: int, query_id: Optional[str],
+                 tenant: Optional[str], site: str, nbytes: int,
+                 priority: float, tier: str):
+        self.buffer_id = buffer_id
+        self.query_id = query_id
+        self.tenant = tenant
+        self.site = site
+        self.nbytes = nbytes
+        self.priority = priority
+        self.tier = tier
+        self.free_site: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Process-global mode + tables
+# ---------------------------------------------------------------------------
+
+_mu = named_lock("analysis.ledger._mu")
+_mode = "off"
+#: live tracked buffers: buffer id -> entry (bounded by catalog size —
+#: note_free moves entries to the tombstone ring)
+_entries: Dict[int, _Entry] = {}
+#: freed buffers kept for use-after-free attribution (bounded ring)
+_tombstones: "OrderedDict[int, _Entry]" = OrderedDict()
+#: per-query device-residency bookkeeping (bounded)
+_queries: "OrderedDict[str, Dict[str, int]]" = OrderedDict()
+_audits_total = 0
+_leaks_total = 0
+_uaf_total = 0
+_uad_total = 0
+_double_free_total = 0
+_donations_total = 0
+#: lock-free fast-path flag (the faults.ARMED pattern): read per hook on
+#: hot paths, written under ``_mu`` only; a stale read costs one no-op
+ARMED = False
+
+
+def install(mode: str) -> None:
+    """Set the ledger mode directly (tests; sessions prime via
+    :func:`refresh`)."""
+    global _mode, ARMED
+    m = str(mode or "off").lower()
+    if m not in MODES:
+        raise ValueError(f"unknown bufferLedger mode {m!r} (want {MODES})")
+    with _mu:
+        _mode = m
+        ARMED = m != "off"
+
+
+def mode() -> str:
+    return _mode
+
+
+def armed() -> bool:
+    return ARMED
+
+
+def refresh(conf=None) -> None:
+    """Prime the mode from a session conf (session bootstrap calls this
+    eagerly, the divergence/faults pattern)."""
+    from .. import config as cfg
+    conf = conf or cfg.TpuConf()
+    install(str(conf.get(cfg.ANALYSIS_BUFFER_LEDGER)))
+
+
+def reset() -> None:
+    """Disarm and drop every table + counter (test isolation)."""
+    global _mode, ARMED, _audits_total, _leaks_total, _uaf_total
+    global _uad_total, _double_free_total, _donations_total
+    with _mu:
+        _mode = "off"
+        ARMED = False
+        _entries.clear()
+        _tombstones.clear()
+        _queries.clear()
+        _audits_total = 0
+        _leaks_total = 0
+        _uaf_total = 0
+        _uad_total = 0
+        _double_free_total = 0
+        _donations_total = 0
+
+
+def forget_all() -> None:
+    """Drop the buffer tables but keep mode + counters: catalog reset is
+    test teardown, not a free — tombstoning torn-down buffers would turn
+    the next test's stale-handle probe into a false use-after-free."""
+    with _mu:
+        _entries.clear()
+        _tombstones.clear()
+        _queries.clear()
+
+
+def stats() -> Dict[str, Any]:
+    """Per-process ledger counters (the bench runner's summary line)."""
+    with _mu:
+        return {"mode": _mode, "tracked": len(_entries),
+                "audits": _audits_total, "leaks": _leaks_total,
+                "use_after_free": _uaf_total,
+                "use_after_donate": _uad_total,
+                "double_free": _double_free_total,
+                "donations": _donations_total}
+
+
+# ---------------------------------------------------------------------------
+# Site capture
+# ---------------------------------------------------------------------------
+
+def _site(limit: int = 3) -> str:
+    """Compact allocation site: the nearest ``limit`` package frames
+    outside the hook plumbing, innermost first (``a.py:12 < b.py:88``).
+    Cheap frame walk, no traceback object."""
+    try:
+        f = sys._getframe(2)
+    except Exception:
+        return ""
+    parts: List[str] = []
+    marker = "spark_rapids_tpu"
+    while f is not None and len(parts) < limit:
+        fn = f.f_code.co_filename
+        i = fn.rfind(marker)
+        if i >= 0:
+            rel = fn[i + len(marker) + 1:].replace(os.sep, "/")
+            if rel not in _SITE_SKIP:
+                parts.append(f"{rel}:{f.f_lineno}")
+        f = f.f_back
+    return " < ".join(parts)
+
+
+def _tier_name(tier: Any) -> str:
+    return getattr(tier, "name", None) or str(tier)
+
+
+def _q_locked(query_id: Optional[str]) -> Optional[Dict[str, int]]:
+    """This query's bookkeeping row (caller holds ``_mu``)."""
+    if not query_id:
+        return None
+    q = _queries.get(query_id)
+    if q is None:
+        q = _queries[query_id] = {"minted": 0, "freed": 0,
+                                  "live_dev": 0, "peak_dev": 0}
+        while len(_queries) > _MAX_QUERIES:
+            _queries.popitem(last=False)
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle hooks (exec/spill.py calls these; all no-ops when disarmed)
+# ---------------------------------------------------------------------------
+
+def note_register(buffer_id: int, nbytes: int, priority: float,
+                  tenant: Optional[str], tier: Any = "DEVICE") -> None:
+    """A buffer entered the catalog: tag it with the ambient query id
+    and the registering call site."""
+    if not ARMED:
+        return
+    from ..exec.query_context import current_query_id
+    qid = current_query_id()
+    site = _site()
+    t = _tier_name(tier)
+    with _mu:
+        _entries[buffer_id] = _Entry(buffer_id, qid, tenant, site,
+                                     int(nbytes), priority, t)
+        q = _q_locked(qid)
+        if q is not None:
+            q["minted"] += 1
+            if t == "DEVICE":
+                q["live_dev"] += int(nbytes)
+                q["peak_dev"] = max(q["peak_dev"], q["live_dev"])
+
+
+def note_tier(buffer_id: int, tier: Any) -> None:
+    """A tracked buffer changed storage tier (spill/promote/pin): keep
+    the minting query's live/peak device bytes current."""
+    if not ARMED:
+        return
+    t = _tier_name(tier)
+    with _mu:
+        e = _entries.get(buffer_id)
+        if e is None:
+            return
+        prev, e.tier = e.tier, t
+        if prev == t:
+            return
+        q = _queries.get(e.query_id) if e.query_id else None
+        if q is not None:
+            if prev == "DEVICE":
+                q["live_dev"] -= e.nbytes
+            if t == "DEVICE":
+                q["live_dev"] += e.nbytes
+                q["peak_dev"] = max(q["peak_dev"], q["live_dev"])
+
+
+def note_access(buffer_id: int) -> None:
+    """A buffer is being acquired: a tombstoned id is a use-after-free
+    (typed + site-attributed, where jax would raise a bare deleted-array
+    error or the catalog a plain KeyError)."""
+    global _uaf_total
+    if not ARMED:
+        return
+    with _mu:
+        if buffer_id in _entries:
+            return
+        e = _tombstones.get(buffer_id)
+        if e is None:
+            return                  # pre-arming registration: unknown id
+        _uaf_total += 1
+        msg = (f"use-after-free: buffer {buffer_id} "
+               f"({e.nbytes} bytes, minted by {e.query_id or '<no query>'} "
+               f"at {e.site or '<unknown>'}) was freed at "
+               f"{e.free_site or '<unknown>'} and accessed again at "
+               f"{_site()}")
+        qid, site = e.query_id, e.site
+    _observe("use-after-free", f"buffer-{buffer_id}", msg,
+             "tpu_use_after_free_total")
+    if _mode == "enforce":
+        raise UseAfterFreeError(msg, buffer_id=buffer_id, query_id=qid,
+                                site=site)
+    log.warning("%s (bufferLedger=record: continuing)", msg)
+
+
+def note_free(buffer_id: int) -> None:
+    """A buffer left the catalog: tombstone it so later accesses (and a
+    second free) diagnose instead of reading garbage."""
+    global _double_free_total
+    if not ARMED:
+        return
+    with _mu:
+        e = _entries.pop(buffer_id, None)
+        if e is not None:
+            if e.tier == "DEVICE":
+                q = _queries.get(e.query_id) if e.query_id else None
+                if q is not None:
+                    q["live_dev"] -= e.nbytes
+            if e.query_id:
+                q = _queries.get(e.query_id)
+                if q is not None:
+                    q["freed"] += 1
+            e.free_site = _site()
+            e.tier = "FREED"
+            _tombstones[buffer_id] = e
+            while len(_tombstones) > _MAX_TOMBSTONES:
+                _tombstones.popitem(last=False)
+            return
+        e = _tombstones.get(buffer_id)
+        if e is None:
+            return
+        _double_free_total += 1
+        msg = (f"double-free: buffer {buffer_id} (minted by "
+               f"{e.query_id or '<no query>'} at {e.site or '<unknown>'}) "
+               f"was freed at {e.free_site or '<unknown>'} and freed "
+               f"again at {_site()}")
+        qid, site = e.query_id, e.site
+    _observe("double-free", f"buffer-{buffer_id}", msg,
+             "tpu_use_after_free_total")
+    if _mode == "enforce":
+        raise DoubleFreeError(msg, buffer_id=buffer_id, query_id=qid,
+                              site=site)
+    log.warning("%s (bufferLedger=record: continuing)", msg)
+
+
+# ---------------------------------------------------------------------------
+# Donation tombstones (plan/physical + plan/stage_compiler call these)
+# ---------------------------------------------------------------------------
+
+def mark_donated(batch) -> None:
+    """A fused program consumed ``batch``'s arrays at donated positions:
+    tombstone the batch object so later reads through its array funnels
+    diagnose as use-after-donate. Called only after a SUCCESSFUL donated
+    invocation — the failure path's ``_donation_consumed`` probe must
+    stay silent."""
+    global _donations_total
+    if not ARMED:
+        return
+    try:
+        batch.donated = _site()
+    except Exception:
+        return                       # slots-less stand-ins: nothing to mark
+    with _mu:
+        _donations_total += 1
+
+
+def check_batch_access(batch) -> None:
+    """Array-funnel guard (``ColumnarBatch.flat_arrays``): reading a
+    donated batch is a use-after-donate."""
+    global _uad_total
+    donated = getattr(batch, "donated", None)
+    if donated is None or not ARMED:
+        return
+    with _mu:
+        _uad_total += 1
+    msg = (f"use-after-donate: batch donated to a fused program at "
+           f"{donated} was read again at {_site()} — its device arrays "
+           "are dead (donate_argnums)")
+    _observe("use-after-donate", "batch", msg, "tpu_use_after_free_total")
+    if _mode == "enforce":
+        raise UseAfterDonateError(msg, site=donated)
+    log.warning("%s (bufferLedger=record: continuing)", msg)
+
+
+# ---------------------------------------------------------------------------
+# End-of-query residency audit
+# ---------------------------------------------------------------------------
+
+def end_of_query(query_id: Optional[str],
+                 had_error: bool = False) -> Optional[Dict[str, Any]]:
+    """Audit the finished query's device residency: buffers it minted
+    that are still DEVICE-resident and not deliberately transferred —
+    cache-priority registrations (df.cache(), the scan device cache) and
+    disk-pinned durable shuffle outputs — are leaks. Returns the
+    per-query ledger summary (query log / EXPLAIN ANALYZE / bench
+    report), or None when disarmed.
+
+    ``had_error`` downgrades enforce to record for THIS audit: a
+    leak report must not mask the exception already propagating."""
+    global _audits_total, _leaks_total
+    if not ARMED or not query_id:
+        return None
+    # catalog state first, ledger lock second: note_tier runs under the
+    # catalog's admission lock, so the reverse order is a lock cycle
+    from ..exec import spill
+    try:
+        spill.drain_deferred_finalizers()    # pending frees are not leaks
+    except Exception:
+        pass
+    cat = spill.BufferCatalog.peek()
+    snap = cat.residency_snapshot() if cat is not None else []
+    cache_priority = spill.CACHE_PRIORITY
+    with _mu:
+        _audits_total += 1
+        q = _queries.pop(query_id, None)
+        leaks: List[_Entry] = []
+        for bid, tier, priority, pinned in snap:
+            e = _entries.get(bid)
+            if e is None or e.query_id != query_id:
+                continue
+            e.tier = _tier_name(tier)        # refresh from the catalog
+            if e.tier != "DEVICE" or pinned or priority == cache_priority:
+                continue
+            leaks.append(e)
+        result: Dict[str, Any] = {
+            "queryId": query_id,
+            "leakedBuffers": len(leaks),
+            "leakedBytes": sum(e.nbytes for e in leaks),
+            "peakDeviceBytes": int(q["peak_dev"]) if q else 0,
+            "mintedBuffers": int(q["minted"]) if q else 0,
+            "sites": [f"buffer {e.buffer_id} ({e.nbytes} bytes) minted "
+                      f"at {e.site or '<unknown>'}" for e in leaks[:8]],
+        }
+        if leaks:
+            _leaks_total += len(leaks)
+            # disown: the leak is reported once, not re-flagged against
+            # every later query sharing the process
+            for e in leaks:
+                e.query_id = None
+    if not leaks:
+        return result
+    msg = (f"query {query_id} leaked {result['leakedBuffers']} "
+           f"device-resident buffer(s) ({result['leakedBytes']} bytes) "
+           "past collect end: " + "; ".join(result["sites"]))
+    _observe("buffer-leak", query_id, msg, "tpu_buffer_leaks_total",
+             count=len(leaks), data=result)
+    if _mode == "enforce" and not had_error:
+        raise BufferLeakError(msg, query_id=query_id,
+                              site=result["sites"][0] if result["sites"]
+                              else None)
+    log.warning("%s (bufferLedger=%s: continuing)", msg, _mode)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Observability (never under _mu, never fails the query)
+# ---------------------------------------------------------------------------
+
+def _observe(kind: str, name: str, msg: str, counter: str,
+             count: int = 1, data: Optional[Dict[str, Any]] = None
+             ) -> None:
+    try:
+        from ..service.telemetry import MetricsRegistry, flight_record
+        flight_record(kind, name, data if data is not None else
+                      {"message": msg})
+        MetricsRegistry.get().counter(
+            counter, "buffer-lifecycle ledger diagnoses").inc(count)
+    except Exception:
+        pass                         # observability must never fail a query

@@ -50,6 +50,11 @@ log's ``build_record`` emits (``service/query_log.py``) is declared in
 its ``QUERY_LOG_FIELDS`` tuple — the metric-key discipline applied to
 the artifact surface ``tools/query_report`` reads.
 
+``use-after-donate`` / ``unreleased-acquire`` / ``double-free`` /
+``untracked-residency``: the device-memory ownership rules over the
+buffer-handling modules (``analysis/ownership.py``, docs/analysis.md
+§7) — deliberate exceptions carry ``# lint: ownership-ok <reason>``.
+
 ``bare-recover``: an ``except`` clause naming a recoverable-taxonomy
 type (ShuffleFetchError and subclasses, BufferLostError,
 InjectedTaskFault — the exec/recovery.py domain) outside
@@ -282,6 +287,11 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # same lazy-import shape
     from . import determinism
     out.extend(determinism.lint_source(source, rel, path=path))
+    # ownership rules (use-after-donate / unreleased-acquire /
+    # double-free / untracked-residency) over the buffer-handling
+    # modules — same lazy-import shape
+    from . import ownership
+    out.extend(ownership.lint_source(source, rel, path=path))
     return out
 
 
@@ -878,6 +888,12 @@ def run(package_dir: str, docs_dir: Optional[str] = None
     from . import determinism
     out.extend(determinism.check_registry(
         determinism.id_registry(package_dir)))
+    # cross-module ownership check: an OWNERSHIP_SINKS entry whose def
+    # site vanished is a stale registry (the rules themselves flag the
+    # per-module direction)
+    from . import ownership
+    out.extend(ownership.check_registry(
+        ownership.sink_registry(package_dir)))
     return out
 
 

@@ -1,0 +1,573 @@
+"""Static ownership/escape analyzer: the lexical half of the engine's
+device-memory ownership discipline (``analysis/ledger.py`` is the
+runtime half).
+
+Device buffers change owners at a handful of declared boundaries —
+fused-program donation (``_donate_argnums``), the spill catalog's
+register/acquire/remove, the spillable-handle ``close``, the staging
+arena's acquire/release, tier flips and the deferred-finalizer queue.
+Every one of those is an *ownership sink*: after the call, somebody
+else (or nobody) owns the bytes. The bugs this analyzer targets are the
+lexical shapes of getting that wrong: reading a batch after its arrays
+were donated, acquiring a spillable handle and forgetting to close it,
+freeing the same handle twice, and parking device values in a
+module-global container the :class:`~..exec.spill.BufferCatalog` never
+sees. The runtime ledger catches the survivors per query; these rules
+catch the pattern at lint time, before it ships.
+
+Scope — the buffer-handling modules: ``exec/``, ``io/``, ``shuffle/``,
+``columnar/``, plus ``plan/physical.py`` and ``plan/stage_compiler.py``
+(where donation lives). Pure AST + text; no engine import.
+
+Rules (wired into ``python -m tools.lint``, tier-1-enforced):
+
+``use-after-donate``
+    A function computes ``donate = _donate_argnums(batch, ...)``,
+    invokes a ``_fused_fn(...)(...)`` program over ``batch``'s arrays,
+    and then reads ``batch`` again on the straight-line path. The
+    donated invocation consumed the arrays — a later read is jax's bare
+    "Array has been deleted", with no owner attribution. Reads inside
+    ``except`` handlers are exempt (the documented failure-path idiom
+    probes ``_donation_consumed`` and re-reads only when the program
+    never ran), as are the probe/mark calls themselves.
+
+``unreleased-acquire``
+    A function binds an owning acquire (``SpillableColumnarBatch(...)``,
+    ``_staging_acquire(...)``, ``_StagingTracker(...)``) to a local name
+    and neither releases it (``.close()`` / ``.free()`` /
+    ``.release_all()`` / ``_staging_release(x)``), escapes it (returns /
+    yields / stores / passes it on — ownership moved with it), nor binds
+    it in a ``with`` statement. The handle's device bytes stay
+    registered forever: the static shape of a leak.
+
+``double-free``
+    Two straight-line free calls (``.close()`` / ``.free()``) on the
+    same acquire-bound local with no rebinding between them, or two
+    catalog ``.remove(id)`` calls with the same argument. Frees inside
+    ``except``/``finally`` bodies are exempt (cleanup paths legitimately
+    re-close; the handles are idempotent there by contract).
+
+``untracked-residency``
+    A module-level container receives a device-ish value (a ``jnp.*``
+    call, ``jax.device_put``, a ``ColumnarBatch``/``from_flat_arrays``
+    construction, or ``.flat_arrays()`` output) via subscript-assign /
+    ``append`` / ``add`` / ``setdefault``. Process-global device
+    residency outside the BufferCatalog is invisible to the spill
+    cascade, the budget, and the ledger's audit.
+
+Suppression mirrors the other family linters — ONE pragma tag, reason
+mandatory, on the flagged line or the line above::
+
+    _IDX_CACHE[key] = idx   # lint: ownership-ok bounded per-shape cache
+
+Reason-less pragmas are themselves flagged (``pragma-reason``) and do
+not suppress.
+
+The declared sink surface is :data:`OWNERSHIP_SINKS`; the cross-module
+registry check (``ownership-registry``) fails when a declared sink's
+definition vanishes from the tree — the registry must describe the code
+that exists.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .lint import LintViolation
+
+SCOPE_PREFIXES = ("exec/", "io/", "shuffle/", "columnar/")
+SCOPE_FILES = ("plan/physical.py", "plan/stage_compiler.py")
+
+#: Every ownership-consuming/transferring call boundary, as
+#: ``(kind, canonical)`` — canonical is ``<module>.<Class>.<def>`` with
+#: ``/`` -> ``.`` and the class omitted for module-level defs. The
+#: rules below key off the terminal names; the registry check verifies
+#: each canonical still has a definition in the tree.
+OWNERSHIP_SINKS: Tuple[Tuple[str, str], ...] = (
+    # fused-program donation (docs/analysis.md §7): the argnums builder,
+    # the failure-path consumption probe, and the success-path marker
+    ("donate", "plan.physical._donate_argnums"),
+    ("donate-probe", "plan.physical._donation_consumed"),
+    ("donate-mark", "plan.physical._note_donated"),
+    # owning acquires: the caller holds device bytes until release
+    ("acquire", "exec.spill.SpillableColumnarBatch"),
+    ("acquire", "io.scan._staging_acquire"),
+    ("acquire", "io.scan._StagingTracker"),
+    # borrow: the catalog keeps ownership; no release obligation
+    ("borrow", "exec.spill.BufferCatalog.acquire_batch"),
+    # frees: after the call the bytes are gone (or tombstoned)
+    ("free", "exec.spill.BufferCatalog.remove"),
+    ("free", "exec.spill.SpillableColumnarBatch.close"),
+    ("free", "exec.spill.SpillableBuffer.free"),
+    ("release", "io.scan._staging_release"),
+    ("release", "io.scan._StagingTracker.release_all"),
+    # tier flips: ownership stays put, residency moves (the ledger's
+    # note_tier hooks live inside these)
+    ("tier", "exec.spill.SpillableBuffer.spill_to_host"),
+    ("tier", "exec.spill.SpillableBuffer.spill_to_disk"),
+    ("tier", "exec.spill.SpillableBuffer.promote_to_device"),
+    ("tier", "exec.spill.SpillableBuffer.demote_to_pinned_disk"),
+    ("tier", "exec.spill.BufferCatalog.pin_to_disk"),
+    # deferred free: ownership parks on the finalizer queue until the
+    # next drain (end_of_query drains before auditing)
+    ("defer", "exec.spill.defer_finalizer"),
+)
+
+#: terminal names of the OWNING acquire sinks (unreleased-acquire /
+#: double-free track locals bound from these)
+OWNING_ACQUIRES = {c.rsplit(".", 1)[-1] for k, c in OWNERSHIP_SINKS
+                   if k == "acquire"}
+#: method names that release an owning acquire
+RELEASE_METHODS = {"close", "free", "release_all"}
+#: module-level functions that release when passed the handle
+RELEASE_FUNCS = {"_staging_release"}
+#: calls a donated batch may still legally flow into
+DONATE_EXEMPT_CALLS = {"_donation_consumed", "_note_donated",
+                       "mark_donated", "check_batch_access"}
+#: batch attributes that touch the (donated, hence dead) device arrays —
+#: metadata reads (.num_rows/.schema/.capacity) survive donation
+ARRAY_ATTRS = {"flat_arrays", "columns", "fetch_to_host", "rows",
+               "to_arrow", "to_pandas", "arrays", "select"}
+
+PRAGMA_RE = re.compile(r"#\s*lint:\s*(ownership)-ok(.*)$")
+
+#: container factory callables recognized at module level
+_CONTAINER_FACTORIES = {"dict", "list", "set", "OrderedDict",
+                        "defaultdict", "WeakValueDictionary"}
+#: mutators that insert a value into a container
+_INSERT_METHODS = {"append", "add", "setdefault"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES) or rel in SCOPE_FILES
+
+
+def _pragmas(source: str) -> Dict[int, str]:
+    """line -> reason (possibly empty) for ownership-ok pragmas."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(line)
+        if m:
+            out[i] = m.group(2).strip()
+    return out
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _deviceish(node: ast.AST) -> bool:
+    """The expression syntactically produces device memory: a jnp call,
+    jax.device_put, a ColumnarBatch construction (incl. from_flat_arrays)
+    or a .flat_arrays() read — the conservative subset the AST proves."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "jnp":
+                return True
+            if isinstance(base, ast.Name) and base.id == "jax" and \
+                    f.attr == "device_put":
+                return True
+            if f.attr in ("from_flat_arrays", "flat_arrays"):
+                return True
+        elif isinstance(f, ast.Name) and f.id == "ColumnarBatch":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Per-function ownership walk (use-after-donate / unreleased-acquire /
+# double-free share one traversal)
+# ---------------------------------------------------------------------------
+
+class _CleanupTagger(ast.NodeVisitor):
+    """Tags every node reachable inside an ``except`` handler or a
+    ``finally`` body — the cleanup paths the straight-line rules
+    exempt."""
+
+    def __init__(self) -> None:
+        self.cleanup: Set[ast.AST] = set()
+
+    def _mark(self, stmts) -> None:
+        for st in stmts:
+            for sub in ast.walk(st):
+                self.cleanup.add(sub)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for h in node.handlers:
+            self._mark(h.body)
+        self._mark(node.finalbody)
+        self.generic_visit(node)
+
+
+def _function_findings(fn: ast.AST, pragmas: Dict[int, str], path: str
+                       ) -> List[LintViolation]:
+    out: List[LintViolation] = []
+    tagger = _CleanupTagger()
+    tagger.visit(fn)
+    cleanup = tagger.cleanup
+
+    def suppressed(line: int) -> bool:
+        return any(l in pragmas and pragmas[l] for l in (line, line - 1))
+
+    # ---- collect per-function facts --------------------------------------
+    donated: Dict[str, int] = {}        # batch name -> _donate_argnums line
+    invocation: Dict[str, int] = {}     # batch name -> fused-invocation line
+    # pre-pass: locals bound to a _fused_fn(...) result — `fn = _fused_fn
+    # (sig, build)` then `fn(...)` is the dominant invocation idiom
+    fused_names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                _callee_name(node.value.func) == "_fused_fn":
+            fused_names.add(node.targets[0].id)
+    acquires: Dict[str, int] = {}       # local name -> owning-acquire line
+    released: Set[str] = set()
+    escaped: Set[str] = set()
+    rebinds: Dict[str, List[int]] = {}  # name -> later assignment lines
+    frees: Dict[str, List[int]] = {}    # name -> straight-line free lines
+    removes: Dict[str, List[int]] = {}  # remove-arg repr -> call lines
+    with_bound: Set[str] = set()
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) and \
+                        _callee_name(ce.func) in OWNING_ACQUIRES:
+                    if isinstance(item.optional_vars, ast.Name):
+                        with_bound.add(item.optional_vars.id)
+        elif isinstance(node, ast.Assign):
+            tgt = node.targets[0] if len(node.targets) == 1 else None
+            # _donate_argnums(X, ...) bound anywhere in the value (the
+            # `if owned else ()` conditional form included)
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call) and \
+                        _callee_name(sub.func) == "_donate_argnums" and \
+                        sub.args and isinstance(sub.args[0], ast.Name):
+                    donated.setdefault(sub.args[0].id, node.lineno)
+            if isinstance(tgt, ast.Name):
+                name = tgt.id
+                if name in acquires or name in donated:
+                    rebinds.setdefault(name, []).append(node.lineno)
+                if isinstance(node.value, ast.Call) and \
+                        _callee_name(node.value.func) in OWNING_ACQUIRES:
+                    acquires.setdefault(name, node.lineno)
+            else:
+                # tuple / attribute / subscript targets: the acquire (if
+                # any) is stored somewhere longer-lived — an escape
+                if isinstance(node.value, ast.Call) and \
+                        _callee_name(node.value.func) in OWNING_ACQUIRES:
+                    pass                     # never tracked, never flagged
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = getattr(node, "value", None)
+            if v is not None:
+                escaped |= _names_in(v)
+        elif isinstance(node, ast.Call):
+            callee = _callee_name(node.func)
+            # fused invocation over a donated batch:
+            # _fused_fn(sig, build)(..., *batch.flat_arrays()) or the
+            # bound form fn = _fused_fn(...); fn(..., *batch...)
+            if (isinstance(node.func, ast.Call) and
+                _callee_name(node.func.func) == "_fused_fn") or \
+                    (isinstance(node.func, ast.Name) and
+                     node.func.id in fused_names):
+                for name in donated:
+                    arg_names: Set[str] = set()
+                    for a in list(node.args) + [kw.value
+                                                for kw in node.keywords]:
+                        arg_names |= _names_in(a)
+                    if name in arg_names:
+                        invocation.setdefault(
+                            name,
+                            getattr(node, "end_lineno", None)
+                            or node.lineno)
+            # releases: x.close() / x.free() / x.release_all()
+            if callee in RELEASE_METHODS and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name):
+                recv = node.func.value.id
+                released.add(recv)
+                if node not in cleanup:
+                    frees.setdefault(recv, []).append(node.lineno)
+            elif callee in RELEASE_FUNCS:
+                for a in node.args:
+                    released |= _names_in(a)
+            # catalog remove: two straight-line calls with the same arg
+            if callee == "remove" and node.args and \
+                    isinstance(node.func, ast.Attribute) and \
+                    "catalog" in ast.dump(node.func.value).lower() and \
+                    node not in cleanup:
+                key = ast.dump(node.args[0])
+                removes.setdefault(key, []).append(node.lineno)
+            # an acquire handed to any other call escapes (ownership
+            # moved with it — the callee's problem now)
+            if callee not in RELEASE_METHODS and \
+                    callee not in RELEASE_FUNCS:
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    escaped |= _names_in(a)
+        elif isinstance(node, (ast.Dict, ast.List, ast.Set, ast.Tuple)):
+            escaped |= _names_in(node)
+
+    # ---- use-after-donate ------------------------------------------------
+    # donation kills the batch's FLAT ARRAYS, not its metadata: reading
+    # .num_rows/.schema/.capacity after the invocation is fine (only the
+    # donated argnums are consumed). Flag array-touching uses — an
+    # ARRAY_ATTRS access, or the bare batch handed to another call — on
+    # the straight-line path between the invocation and the branch's
+    # first return/raise (code past that barrier belongs to a sibling
+    # branch the donated invocation never reaches).
+    returns = sorted(
+        (n.lineno, getattr(n, "end_lineno", None) or n.lineno)
+        for n in ast.walk(fn)
+        if isinstance(n, (ast.Return, ast.Raise)) and n not in cleanup)
+    for name, inv_end in invocation.items():
+        barrier = next((e for l, e in returns if l > inv_end), 10 ** 9)
+        for sub in ast.walk(fn):
+            ln = getattr(sub, "lineno", None)
+            if ln is None or not (inv_end < ln <= barrier) or \
+                    sub in cleanup:
+                continue
+            use = None
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == name and sub.attr in ARRAY_ATTRS:
+                use = f".{sub.attr} read"
+            elif isinstance(sub, ast.Call):
+                callee = _callee_name(sub.func)
+                if callee in DONATE_EXEMPT_CALLS:
+                    continue
+                if (isinstance(sub.func, ast.Call) and
+                    _callee_name(sub.func.func) == "_fused_fn") or \
+                        (isinstance(sub.func, ast.Name) and
+                         sub.func.id in fused_names):
+                    continue             # the invocation itself
+                for a in list(sub.args) + [kw.value
+                                           for kw in sub.keywords]:
+                    if isinstance(a, ast.Starred):
+                        a = a.value
+                    if isinstance(a, ast.Name) and a.id == name:
+                        use = f"handed to {callee}()"
+                        break
+            if use is None or suppressed(ln):
+                continue
+            out.append(LintViolation(
+                path, ln, "use-after-donate",
+                f"{name!r} was donated to a fused program (donate_argnums"
+                f" from line {donated[name]}, invoked by line {inv_end}) "
+                f"and its arrays are {use} again on the straight-line "
+                "path — the donated arrays are dead; restructure, or "
+                "pragma with `# lint: ownership-ok <reason>`"))
+            break                        # one diagnosis per name
+
+    # ---- unreleased-acquire ----------------------------------------------
+    for name, line in acquires.items():
+        if name in with_bound or name in released or name in escaped:
+            continue
+        if suppressed(line):
+            continue
+        out.append(LintViolation(
+            path, line, "unreleased-acquire",
+            f"{name!r} binds an owning acquire that is never released "
+            "(close/free/release_all), never escapes, and is not a "
+            "`with` binding — its device bytes stay registered forever; "
+            "release it in a finally, or pragma with "
+            "`# lint: ownership-ok <reason>`"))
+
+    # ---- double-free -----------------------------------------------------
+    for name, lines in frees.items():
+        if name not in acquires and name not in with_bound:
+            continue                     # only tracked handles (no noise
+            #                              from file.close() etc.)
+        lines = sorted(lines)
+        rb = sorted(rebinds.get(name, ()))
+        for a, b in zip(lines, lines[1:]):
+            if any(a < r <= b for r in rb):
+                continue                 # rebound between frees: fine
+            if suppressed(b):
+                continue
+            out.append(LintViolation(
+                path, b, "double-free",
+                f"{name!r} is freed here and was already freed at line "
+                f"{a} with no rebinding between — the second free "
+                "tombstones an id someone else may now own; drop it, "
+                "or pragma with `# lint: ownership-ok <reason>`"))
+    for key, lines in removes.items():
+        lines = sorted(lines)
+        for a, b in zip(lines, lines[1:]):
+            if suppressed(b):
+                continue
+            out.append(LintViolation(
+                path, b, "double-free",
+                f"catalog .remove() of the same buffer id here and at "
+                f"line {a} — the second remove is a double-free; drop "
+                "it, or pragma with `# lint: ownership-ok <reason>`"))
+    return out
+
+
+def _in_exempt_call(fn: ast.AST, name_node: ast.Name) -> bool:
+    """``name_node`` is an argument of a donate-probe/mark call — the
+    calls a donated batch may still legally flow into."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                _callee_name(node.func) in DONATE_EXEMPT_CALLS:
+            for a in node.args:
+                if name_node in ast.walk(a):
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# untracked-residency (module-level containers holding device values)
+# ---------------------------------------------------------------------------
+
+def _module_containers(tree: ast.Module) -> Set[str]:
+    """Names bound at module level to a mutable-container literal or
+    factory call."""
+    out: Set[str] = set()
+    for st in tree.body:
+        tgt = None
+        val = None
+        if isinstance(st, ast.Assign) and len(st.targets) == 1:
+            tgt, val = st.targets[0], st.value
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            tgt, val = st.target, st.value
+        if not isinstance(tgt, ast.Name) or val is None:
+            continue
+        if isinstance(val, (ast.Dict, ast.List, ast.Set)):
+            out.add(tgt.id)
+        elif isinstance(val, ast.Call) and \
+                _callee_name(val.func) in _CONTAINER_FACTORIES:
+            out.add(tgt.id)
+    return out
+
+
+def _residency_hits(tree: ast.Module) -> List[Tuple[int, str, str]]:
+    """(line, container, how) for every device-ish value inserted into a
+    module-level container."""
+    containers = _module_containers(tree)
+    if not containers:
+        return []
+    hits: List[Tuple[int, str, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in containers and \
+                        _deviceish(node.value):
+                    hits.append((node.lineno, t.value.id,
+                                 "subscript assignment"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _INSERT_METHODS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in containers:
+            vals = node.args[1:] if node.func.attr == "setdefault" \
+                else node.args
+            if any(_deviceish(a) for a in vals):
+                hits.append((node.lineno, node.func.value.id,
+                             f".{node.func.attr}()"))
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# Entry points (lint.py wires these)
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, rel: str, path: Optional[str] = None
+                ) -> List[LintViolation]:
+    """Run the ownership rules over one module's source. ``rel`` decides
+    scope membership; pragma-reason findings are emitted for any module
+    carrying the tag."""
+    path = path or rel
+    out: List[LintViolation] = []
+    pragmas = _pragmas(source)
+    for line, reason in pragmas.items():
+        if not reason:
+            out.append(LintViolation(
+                path, line, "pragma-reason",
+                "ownership-ok pragma missing its justification "
+                "(format: `# lint: ownership-ok <reason>`)"))
+    if not in_scope(rel):
+        return out
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return out                       # the parse rule reports it
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.extend(_function_findings(node, pragmas, path))
+    for line, container, how in _residency_hits(tree):
+        if any(l in pragmas and pragmas[l] for l in (line, line - 1)):
+            continue
+        out.append(LintViolation(
+            path, line, "untracked-residency",
+            f"module-level container {container!r} receives a device-ish "
+            f"value via {how} — residency outside the BufferCatalog is "
+            "invisible to the spill cascade and the ledger audit; "
+            "register it, or pragma with `# lint: ownership-ok <reason>`"))
+    return out
+
+
+def sink_registry(package_dir: str) -> Set[str]:
+    """Every canonical def/class name the tree defines, in the
+    OWNERSHIP_SINKS naming scheme (``module.path.Class.def``) — the
+    ground truth the registry check compares against."""
+    defined: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, package_dir).replace(os.sep, "/")
+            mod = rel[:-3].replace("/", ".")
+            try:
+                with open(full, "r") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+
+            def walk(node, prefix):
+                for st in getattr(node, "body", ()):
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef)):
+                        q = f"{prefix}.{st.name}"
+                        defined.add(q)
+                        walk(st, q)
+
+            walk(tree, mod)
+    return defined
+
+
+def check_registry(defined: Set[str]) -> List[LintViolation]:
+    """``ownership-registry``: a declared sink whose definition no
+    longer exists in the tree — the registry must describe the code
+    that exists (the LOCKSTEP_IDS stale-entry discipline)."""
+    out: List[LintViolation] = []
+    for kind, canonical in OWNERSHIP_SINKS:
+        if canonical not in defined:
+            out.append(LintViolation(
+                "analysis/ownership.py", 0, "ownership-registry",
+                f"OWNERSHIP_SINKS declares {kind} sink {canonical!r} "
+                "but no such definition exists in the tree — update the "
+                "registry to match the code"))
+    return out

@@ -459,6 +459,12 @@ class DataFrame:
             # store AFTER the sync/span windows closed: the caching
             # fetch must not perturb this query's reported sync counts
             out = pc.store_result(self.session, rkey, out)
+        # end-of-query buffer-lifecycle audit (analysis/ledger.py): runs
+        # AFTER store_result so a cached result's pinned buffers are
+        # owned by the cache, not leaked by this query. BufferLeakError
+        # propagates in enforce mode — leak discipline is the point.
+        from ..analysis import ledger as _ledger
+        self.session._last_ledger = _ledger.end_of_query(qid)
         try:
             # opt-in structured query log (service/query_log.py, conf
             # telemetry.queryLog.dir): one JSONL record per execution.
@@ -595,6 +601,13 @@ class DataFrame:
                         if ov else ()))
                 except Exception:
                     pass
+            # end-of-query audit for the streaming path: had_error keeps
+            # enforce mode from masking a propagating failure with a
+            # leak report (the audit downgrades itself to record)
+            import sys as _sys
+            from ..analysis import ledger as _ledger
+            self.session._last_ledger = _ledger.end_of_query(
+                qid, had_error=_sys.exc_info()[0] is not None)
             try:
                 from ..service import query_log
                 query_log.maybe_log(self.session, exec_plan, serving,

@@ -81,6 +81,9 @@ class RuntimeConf:
         if ".analysis.divergence" in key:
             from ..analysis import divergence
             divergence.refresh(self._session.conf)
+        if ".analysis.bufferledger" in key.lower():
+            from ..analysis import ledger
+            ledger.refresh(self._session.conf)
         # ANY conf change drops the session's serving caches: cached
         # plans were analyzed/optimized/validated under the old conf, and
         # a stored result may have been produced by it (the parse cache
@@ -239,6 +242,10 @@ class TpuSession:
         # eagerly like faults — the mint-site hooks read a lock-free flag
         from ..analysis import divergence
         divergence.refresh(self.conf)
+        # buffer-lifecycle ledger mode (analysis/ledger.py): same eager
+        # priming — the spill-store hooks read a lock-free flag
+        from ..analysis import ledger
+        ledger.refresh(self.conf)
         # cold-path killers (docs/compile.md §5): reload the AQE
         # cardinality-feedback checkpoint and prewarm the hottest fused
         # stages from the corpus beside the signature index. Both are
@@ -626,6 +633,15 @@ class TpuSession:
         sl = serving_line(getattr(self, "_last_serving", None))
         if sl:
             lines.append(sl)
+        # buffer-lifecycle verdict (analysis/ledger.py end_of_query):
+        # present whenever the ledger audited this query
+        led = getattr(self, "_last_ledger", None)
+        if led:
+            lines.append(
+                f"ledger: leakedBuffers={led.get('leakedBuffers', 0)} "
+                f"leakedBytes={led.get('leakedBytes', 0)} "
+                f"peakDeviceBytes={led.get('peakDeviceBytes', 0)} "
+                f"mintedBuffers={led.get('mintedBuffers', 0)}")
         return "\n".join(lines)
 
     # -- query-execution listeners (ExecutionPlanCaptureCallback analog,

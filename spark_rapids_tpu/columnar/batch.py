@@ -32,8 +32,13 @@ class ColumnarBatch:
     # ``from_flat_arrays`` attaches any arguments beyond the schema's
     # arity here, and ``ops.expressions.Parameter`` reads them by its
     # stamped trace position. Host-side batches always carry ().
+    # ``donated``: non-None once a fused program consumed this batch's
+    # arrays at donated positions (analysis/ledger.mark_donated stamps
+    # the donation site) — the arrays are DEAD and any further read
+    # through the funnels below diagnoses as use-after-donate instead of
+    # surfacing jax's bare "Array has been deleted"
     __slots__ = ("schema", "columns", "_num_rows", "origin", "shared",
-                 "params")
+                 "params", "donated")
 
     def __init__(self, schema: dt.Schema, columns: List[Column], num_rows: int):
         assert len(schema) == len(columns), "schema/column arity mismatch"
@@ -44,6 +49,7 @@ class ColumnarBatch:
         self.origin = None
         self.shared = False
         self.params = ()
+        self.donated = None
         if isinstance(num_rows, (int, np.integer)):
             self._num_rows = int(num_rows)
         else:
@@ -234,6 +240,9 @@ class ColumnarBatch:
     def flat_arrays(self) -> List[jnp.ndarray]:
         """All underlying arrays in schema order: [data, validity(, lengths)]
         per column — the jit-boundary form of a batch."""
+        if self.donated is not None:
+            from ..analysis import ledger
+            ledger.check_batch_access(self)
         out: List[jnp.ndarray] = []
         for c in self.columns:
             out.extend(c.arrays())
@@ -267,6 +276,9 @@ class ColumnarBatch:
         Returns a batch whose columns are numpy-backed, sliced to
         ``num_rows``."""
         import jax
+        if self.donated is not None:
+            from ..analysis import ledger
+            ledger.check_batch_access(self)
         if not self.columns:
             self.num_rows                     # resolve the count
             return self

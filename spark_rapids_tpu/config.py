@@ -620,6 +620,21 @@ ANALYSIS_DIVERGENCE = _conf("spark.rapids.tpu.sql.analysis.divergence").doc(
         lambda v: str(v).lower() in ("off", "record", "enforce")
 ).create_with_default("off")
 
+ANALYSIS_BUFFER_LEDGER = _conf(
+    "spark.rapids.tpu.sql.analysis.bufferLedger").doc(
+    "Runtime buffer-lifecycle ledger: off, record, enforce. Tags every "
+    "catalog register/acquire/tier-move/donate/free with the ambient "
+    "query id + allocation site; an end-of-query residency audit flags "
+    "buffers the query minted that are still device-resident and not "
+    "cache/durable-owned as leaks, and freed/donated buffers are "
+    "tombstoned so later access diagnoses instead of reading garbage. "
+    "record logs, flight-records and counts (tpu_buffer_leaks_total, "
+    "tpu_use_after_free_total); enforce raises typed BufferLeakError / "
+    "UseAfterFreeError / UseAfterDonateError with mint/free sites "
+    "(analysis/ledger.py, docs/analysis.md §7)").string_conf.check(
+        lambda v: str(v).lower() in ("off", "record", "enforce")
+).create_with_default("off")
+
 ANALYSIS_RECOMPILE_AUDIT = _conf(
     "spark.rapids.tpu.sql.analysis.recompileAudit").doc(
     "Track distinct compiled signatures per fused kernel and flag "

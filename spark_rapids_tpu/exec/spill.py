@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis import ledger as _ledger
 from ..analysis import lockdep
 from ..analysis.lockdep import named_lock, named_rlock
 from ..columnar import dtypes as dt
@@ -171,6 +172,8 @@ class SpillableBuffer:
             self._host_arrays = host
             self._device_arrays = None
             self.tier = StorageTier.HOST
+        # ledger AFTER the buffer lock releases (its lock is a leaf)
+        _ledger.note_tier(self.id, StorageTier.HOST)
         # charge the innermost open exec (exec/metrics attribution): the
         # operator whose pressure pushed this buffer off the device shows
         # spillBytes on its EXPLAIN ANALYZE node
@@ -221,6 +224,7 @@ class SpillableBuffer:
             except OSError:
                 pass
             return 0
+        _ledger.note_tier(self.id, StorageTier.DISK)
         from ..service.telemetry import flight_record
         flight_record("spill", f"buffer-{self.id}",
                       {"bytes": self.size_bytes, "to": "disk"})
@@ -284,6 +288,7 @@ class SpillableBuffer:
                     os.unlink(self._disk_path)
             self._disk_path = None
             self.tier = StorageTier.DEVICE
+        _ledger.note_tier(self.id, StorageTier.DEVICE)
 
     def demote_to_pinned_disk(self, only_from: Optional["StorageTier"]
                               = None) -> Optional["StorageTier"]:
@@ -308,6 +313,7 @@ class SpillableBuffer:
             self._disk_path = self._pinned_path
             self._pinned_path = None
             self.tier = StorageTier.DISK
+        _ledger.note_tier(self.id, StorageTier.DISK)
         from ..service.telemetry import flight_record
         flight_record("spill", f"buffer-{self.id}",
                       {"bytes": self.size_bytes, "to": "disk",
@@ -394,10 +400,22 @@ class BufferCatalog:
                 for b in list(cls._instance.buffers.values()):
                     b.free()
             cls._instance = None
+        # catalog reset is test teardown, not a free: drop the ledger's
+        # buffer tables instead of tombstoning every torn-down id
+        _ledger.forget_all()
 
     def buffer_count(self) -> int:
         with self._mu:
             return len(self.buffers)
+
+    def residency_snapshot(self) -> List[Tuple[int, "StorageTier",
+                                               float, bool]]:
+        """(id, tier, priority, disk_pinned) per registered buffer — the
+        ledger's end-of-query audit input, taken BEFORE the ledger lock
+        (its lock is a leaf under this one)."""
+        with self._mu:
+            return [(b.id, b.tier, b.priority, b.disk_pinned)
+                    for b in self.buffers.values()]
 
     # -- per-tenant residency (service multi-tenancy, docs/service.md) ------
     def _tenant_device_delta_locked(self, buf: "SpillableBuffer",
@@ -469,6 +487,10 @@ class BufferCatalog:
             # victim; it becomes eligible at the next tenant's pressure)
             self._enforce_tenant_budget_locked(tenant, exclude_id=buf.id)
             self._note_residency()
+        # ledger AFTER the admission lock releases; the registration
+        # cascade may already have spilled this buffer, so pass its tier
+        _ledger.note_register(buf.id, buf.size_bytes, priority, tenant,
+                              tier=buf.tier)
         return buf.id
 
     def acquire_batch(self, buffer_id: int) -> ColumnarBatch:
@@ -477,6 +499,7 @@ class BufferCatalog:
         (possibly spilling lower-priority buffers), then the promotion is
         charged against the device budget, so concurrent acquires cannot
         silently exceed it (RapidsBufferStore.scala:275-301)."""
+        _ledger.note_access(buffer_id)
         with self._mu:
             buf = self.buffers[buffer_id]
             if buf.tier != StorageTier.DEVICE:
@@ -556,15 +579,18 @@ class BufferCatalog:
     def remove(self, buffer_id: int) -> None:
         with self._mu:
             buf = self.buffers.pop(buffer_id, None)
-            if buf is None:
-                return
-            if buf.tier == StorageTier.DEVICE:
-                self.device_bytes -= buf.size_bytes
-                self._tenant_device_delta_locked(buf, -buf.size_bytes)
-            elif buf.tier == StorageTier.HOST:
-                self.host_bytes -= buf.size_bytes
-            buf.free()
-            self._note_residency()
+            if buf is not None:
+                if buf.tier == StorageTier.DEVICE:
+                    self.device_bytes -= buf.size_bytes
+                    self._tenant_device_delta_locked(buf, -buf.size_bytes)
+                elif buf.tier == StorageTier.HOST:
+                    self.host_bytes -= buf.size_bytes
+                buf.free()
+                self._note_residency()
+        # unconditional (outside the admission lock): a remove of an
+        # already-removed id is exactly the double-free the ledger exists
+        # to diagnose
+        _ledger.note_free(buffer_id)
 
     # -- spill logic ---------------------------------------------------------
     def reserve(self, nbytes: int) -> None:

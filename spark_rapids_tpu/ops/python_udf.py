@@ -139,6 +139,8 @@ def _split_head_rest(merged: ColumnarBatch, take: int,
 
     outs = _fused_fn(sig, build)(_dev_count(merged),
                                  *merged.flat_arrays())
+    from ..plan.physical import _note_donated
+    _note_donated(merged, donate)
     nh = len(outs) // 2
     head = ColumnarBatch.from_flat_arrays(schema, list(outs[:nh]), take)
     if rest <= 0:

@@ -648,6 +648,17 @@ def _donation_consumed(batch: ColumnarBatch) -> bool:
         return True
 
 
+def _note_donated(batch: ColumnarBatch, donate: tuple) -> None:
+    """After a SUCCESSFUL donated fused invocation: tombstone ``batch``
+    in the buffer-lifecycle ledger (analysis/ledger.py) — its arrays are
+    dead, and a later read should diagnose as use-after-donate instead
+    of surfacing jax's bare deleted-array error. No-op for the plain
+    (un-donated) variant and when the ledger is off."""
+    if donate:
+        from ..analysis import ledger
+        ledger.mark_donated(batch)
+
+
 def _schema_sig(schema: dt.Schema) -> tuple:
     return tuple(f.dtype.name for f in schema)
 
@@ -800,6 +811,7 @@ class FusedStage:
                 outs = fn(_dev_count(batch),
                           *batch.flat_arrays(),
                           *ex.param_arg_values(self._params))
+            _note_donated(batch, donate)
         except _ScalarPredicate:
             self.broken = True
             return None
@@ -1713,6 +1725,7 @@ class TpuHashAggregateExec(TpuExec):
                 with _trace_exec(self):
                     outs = fn(_dev_count(batch), *batch.flat_arrays(),
                               *pargs)
+                _note_donated(batch, donate)
                 return ("done", ColumnarBatch.from_flat_arrays(
                     pschema, list(outs), 1))
 
@@ -1837,6 +1850,7 @@ class TpuHashAggregateExec(TpuExec):
                        build_sort)
         with _trace_exec(self):
             outs = fn(_dev_count(batch), *batch.flat_arrays(), *pargs)
+        _note_donated(batch, donate)
         pb = ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                             outs[-1])
         return ("done", pb)
@@ -1914,6 +1928,7 @@ class TpuHashAggregateExec(TpuExec):
         with _trace_exec(self):
             outs = fn(_dev_count(batch), rmin, *batch.flat_arrays(),
                       *pargs)
+        _note_donated(batch, donate)
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                               outs[-1])
 
@@ -1979,6 +1994,7 @@ class TpuHashAggregateExec(TpuExec):
         with _trace_exec(self):
             outs = fn(_dev_count(batch), order, starts,
                       n_eff_dev, *batch.flat_arrays(), *pargs)
+        _note_donated(batch, donate)
         # group count came back with the probe stats — no second readback
         return ColumnarBatch.from_flat_arrays(pschema, list(outs[:-1]),
                                               n_groups)
@@ -2089,6 +2105,7 @@ class TpuHashAggregateExec(TpuExec):
                                   ("donate", bool(donate))), build)
             with _trace_exec(self):
                 outs = fn(_dev_count(batch), *batch.flat_arrays())
+            _note_donated(batch, donate)
             return ColumnarBatch.from_flat_arrays(
                 self._out_schema, list(outs[:-1]), outs[-1])
         except Exception as e:

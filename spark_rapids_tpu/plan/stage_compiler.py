@@ -288,6 +288,7 @@ class TpuWholeStageExec(TpuExec):
             with trace_span("fused_stage"):
                 outs = fn(_dev_count(batch), *batch.flat_arrays(),
                           *ex.param_arg_values(self.chain.params))
+            ph._note_donated(batch, donate)
         except _ScalarPredicate:
             self.broken = True
             return None

@@ -29,7 +29,7 @@ QUERY_LOG_FIELDS: Tuple[str, ...] = (
     "stageStats", "stageWallS", "stageRetries", "fetchRetries",
     "faultsFired", "shufflePlanes", "hbmPeakBytes", "hbmPeakOperator",
     "drift", "operators", "hostSyncs", "recompiles", "aqe",
-    "firstRowS", "compileS",
+    "firstRowS", "compileS", "leakedBuffers", "peakDeviceBytes",
 )
 
 
@@ -205,6 +205,12 @@ def build_record(session, exec_plan, serving: Dict[str, Any],
         "compileS": round(
             float(_metric_total_f(exec_plan, "compileSeconds")), 4),
     }
+    # buffer-lifecycle ledger verdict for this query (analysis/ledger.py
+    # end_of_query, stashed by the collect paths; zeros when the ledger
+    # is off so the record shape stays stable)
+    ledger = getattr(session, "_last_ledger", None) or {}
+    rec["leakedBuffers"] = int(ledger.get("leakedBuffers", 0) or 0)
+    rec["peakDeviceBytes"] = int(ledger.get("peakDeviceBytes", 0) or 0)
     return rec
 
 

@@ -148,6 +148,9 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     "tpu_compile_queue_depth",          # gauge, pending+running pool jobs
     "tpu_prewarm_compiles_total",       # programs built by prewarm jobs
     "tpu_query_first_row_seconds",      # histogram, wall to first batch
+    # buffer-lifecycle ledger (analysis/ledger.py, docs/analysis.md §7)
+    "tpu_buffer_leaks_total",           # end-of-query residency leaks
+    "tpu_use_after_free_total",         # UAF + use-after-donate + dbl-free
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,

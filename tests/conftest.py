@@ -31,6 +31,15 @@ os.environ.setdefault(
     "SPARK_RAPIDS_TPU_CONF__SPARK__RAPIDS__TPU__SQL__ANALYSIS__LOCKDEP",
     "record")
 
+# buffer-lifecycle ledger rides the suite in `record` mode (same
+# discipline as lockdep above): leaks and dead-buffer accesses are
+# counted + flight-recorded, never raised. Tests that exercise
+# `enforce` install it explicitly and reset after.
+os.environ.setdefault(
+    "SPARK_RAPIDS_TPU_CONF__SPARK__RAPIDS__TPU__SQL__ANALYSIS"
+    "__BUFFERLEDGER",
+    "record")
+
 # tests drive bench/dryrun code paths (test_partitioning runs the full
 # multichip dryrun): their regression-gate stamps must land in a scratch
 # history file, never in the committed benchmarks/reports JSONL

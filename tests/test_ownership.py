@@ -1,0 +1,314 @@
+"""Buffer-lifecycle ledger tests (ISSUE 19): the runtime half of the
+device-memory ownership discipline — record/enforce modes, tombstoned
+frees raising typed use-after-free, donation tombstones, the
+end-of-query residency audit, and the q3-shaped acceptance run under
+``bufferLedger=enforce`` + ``lockdep=enforce`` with watermarks back at
+zero. The static half lives in tests/test_static_analysis.py.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.analysis import ledger
+from spark_rapids_tpu.analysis.ledger import (BufferLeakError,
+                                              DoubleFreeError,
+                                              UseAfterDonateError,
+                                              UseAfterFreeError)
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import query_context as qc
+from spark_rapids_tpu.exec.spill import (CACHE_PRIORITY, BufferCatalog,
+                                         SpillableColumnarBatch,
+                                         StorageTier)
+
+
+@pytest.fixture
+def armed_ledger():
+    """Zero the process-global ledger (tables AND counters) around a
+    test that asserts absolute counter values, then restore the suite's
+    `record` default (primed by conftest's env conf)."""
+    prior = ledger.mode()
+    ledger.reset()
+    yield ledger
+    ledger.reset()
+    ledger.install(prior)
+
+
+def _batch(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_pydict({
+        "a": rng.integers(0, 1000, n),
+        "b": rng.normal(size=n),
+    })
+
+
+# ---------------------------------------------------------------------------
+# Modes
+# ---------------------------------------------------------------------------
+
+def test_install_modes_and_armed(armed_ledger):
+    for m in ledger.MODES:
+        ledger.install(m)
+        assert ledger.mode() == m
+        assert ledger.armed() == (m != "off")
+    with pytest.raises(ValueError):
+        ledger.install("banana")
+
+
+def test_off_mode_tracks_nothing(armed_ledger):
+    ledger.install("off")
+    ledger.note_register(999001, 1024, 100.0, None)
+    assert ledger.stats()["tracked"] == 0
+
+
+def test_conf_refresh_primes_mode(armed_ledger):
+    from spark_rapids_tpu import config as cfg
+    conf = cfg.TpuConf()
+    conf.set(cfg.ANALYSIS_BUFFER_LEDGER.key, "enforce")
+    ledger.refresh(conf)
+    assert ledger.mode() == "enforce"
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle hooks: register / free / tombstones
+# ---------------------------------------------------------------------------
+
+def test_register_free_roundtrip_record(armed_ledger):
+    ledger.install("record")
+    cat = BufferCatalog.get()
+    bid = cat.register_batch(_batch())
+    assert ledger.stats()["tracked"] >= 1
+    cat.remove(bid)
+    # freed: tombstoned, not tracked; access in record mode only counts
+    before = ledger.stats()["use_after_free"]
+    ledger.note_access(bid)
+    s = ledger.stats()
+    assert s["use_after_free"] == before + 1
+
+
+def test_use_after_free_raises_in_enforce(armed_ledger):
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    bid = cat.register_batch(_batch(seed=1))
+    cat.remove(bid)
+    with pytest.raises(UseAfterFreeError) as ei:
+        cat.acquire_batch(bid)
+    assert ei.value.buffer_id == bid
+    assert "use-after-free" in str(ei.value)
+
+
+def test_double_free_raises_in_enforce(armed_ledger):
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    bid = cat.register_batch(_batch(seed=2))
+    cat.remove(bid)
+    with pytest.raises(DoubleFreeError):
+        cat.remove(bid)
+
+
+def test_catalog_reset_is_not_a_free(armed_ledger):
+    # test-teardown reset drops the tables WITHOUT tombstoning: a stale
+    # handle probed by the next test must not diagnose use-after-free
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    bid = cat.register_batch(_batch(seed=3))
+    BufferCatalog.reset()
+    ledger.note_access(bid)              # unknown id now: silent
+    assert ledger.stats()["use_after_free"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Donation tombstones
+# ---------------------------------------------------------------------------
+
+def test_donated_batch_read_raises_in_enforce(armed_ledger):
+    ledger.install("enforce")
+    b = _batch(seed=4)
+    b.flat_arrays()                      # pre-donation reads are fine
+    ledger.mark_donated(b)
+    assert ledger.stats()["donations"] == 1
+    with pytest.raises(UseAfterDonateError) as ei:
+        b.flat_arrays()
+    assert "use-after-donate" in str(ei.value)
+    with pytest.raises(UseAfterDonateError):
+        b.fetch_to_host()
+
+
+def test_donated_batch_read_counts_in_record(armed_ledger):
+    ledger.install("record")
+    b = _batch(seed=5)
+    ledger.mark_donated(b)
+    b.flat_arrays()                      # continues (arrays still live
+    #                                      on the CPU test backend)
+    assert ledger.stats()["use_after_donate"] == 1
+
+
+def test_mark_donated_noop_when_disarmed(armed_ledger):
+    ledger.install("off")
+    b = _batch(seed=6)
+    ledger.mark_donated(b)
+    assert b.donated is None
+    b.flat_arrays()
+
+
+# ---------------------------------------------------------------------------
+# End-of-query residency audit
+# ---------------------------------------------------------------------------
+
+def test_end_of_query_flags_leak_and_enforce_raises(armed_ledger):
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    qid = "qtest-leak-1"
+    with qc.query_scope(qc.QueryContext(qid)):
+        bid = cat.register_batch(_batch(seed=7))
+    try:
+        with pytest.raises(BufferLeakError) as ei:
+            ledger.end_of_query(qid)
+        assert ei.value.query_id == qid
+        assert "leaked" in str(ei.value)
+        assert ledger.stats()["leaks"] == 1
+        # the leak is disowned after one report: a second audit is clean
+        assert ledger.end_of_query(qid) is None or \
+            ledger.end_of_query(qid)["leakedBuffers"] == 0
+    finally:
+        cat.remove(bid)
+
+
+def test_end_of_query_record_reports_without_raising(armed_ledger):
+    ledger.install("record")
+    cat = BufferCatalog.get()
+    qid = "qtest-leak-2"
+    with qc.query_scope(qc.QueryContext(qid)):
+        bid = cat.register_batch(_batch(seed=8))
+    try:
+        rep = ledger.end_of_query(qid)
+        assert rep["leakedBuffers"] == 1
+        assert rep["leakedBytes"] > 0
+        assert rep["sites"]
+    finally:
+        cat.remove(bid)
+
+
+def test_end_of_query_clean_when_freed(armed_ledger):
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    qid = "qtest-clean"
+    with qc.query_scope(qc.QueryContext(qid)):
+        bid = cat.register_batch(_batch(seed=9))
+        cat.remove(bid)
+    rep = ledger.end_of_query(qid)
+    assert rep["leakedBuffers"] == 0
+    assert rep["mintedBuffers"] == 1
+    assert rep["peakDeviceBytes"] > 0
+
+
+def test_end_of_query_cache_and_spilled_exempt(armed_ledger):
+    # deliberate ownership transfers are not leaks: cache-priority
+    # registrations (df.cache(), scan cache) and buffers no longer
+    # device-resident
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    qid = "qtest-exempt"
+    with qc.query_scope(qc.QueryContext(qid)):
+        cached = cat.register_batch(_batch(seed=10),
+                                    priority=CACHE_PRIORITY)
+        spilled = cat.register_batch(_batch(seed=11))
+        cat.buffers[spilled].spill_to_host()
+    try:
+        rep = ledger.end_of_query(qid)
+        assert rep["leakedBuffers"] == 0
+    finally:
+        cat.remove(cached)
+        cat.remove(spilled)
+
+
+def test_end_of_query_had_error_downgrades_enforce(armed_ledger):
+    ledger.install("enforce")
+    cat = BufferCatalog.get()
+    qid = "qtest-had-error"
+    with qc.query_scope(qc.QueryContext(qid)):
+        bid = cat.register_batch(_batch(seed=12))
+    try:
+        rep = ledger.end_of_query(qid, had_error=True)   # must not raise
+        assert rep["leakedBuffers"] == 1
+    finally:
+        cat.remove(bid)
+
+
+def test_tier_moves_update_peak_device_bytes(armed_ledger):
+    ledger.install("record")
+    cat = BufferCatalog.get()
+    qid = "qtest-tier"
+    with qc.query_scope(qc.QueryContext(qid)):
+        bid = cat.register_batch(_batch(seed=13))
+        buf = cat.buffers[bid]
+        nbytes = buf.size_bytes
+        buf.spill_to_host()
+        assert buf.tier == StorageTier.HOST
+    try:
+        rep = ledger.end_of_query(qid)
+        assert rep["peakDeviceBytes"] >= nbytes
+        assert rep["leakedBuffers"] == 0   # host-resident: not a leak
+    finally:
+        cat.remove(bid)
+
+
+def test_spillable_handle_close_is_a_clean_free(armed_ledger):
+    ledger.install("enforce")
+    qid = "qtest-handle"
+    with qc.query_scope(qc.QueryContext(qid)):
+        handle = SpillableColumnarBatch(_batch(seed=14))
+        handle.close()
+        handle.close()                   # idempotent by contract: the
+        #                                  _closed guard never reaches
+        #                                  remove twice
+    rep = ledger.end_of_query(qid)
+    assert rep["leakedBuffers"] == 0
+    assert ledger.stats()["double_free"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: q3-shaped 3-way join under enforce + lockdep enforce
+# ---------------------------------------------------------------------------
+
+def test_q3_three_way_join_under_enforce_watermarks_zero():
+    from benchmarks import datagen, queries as Q
+    from spark_rapids_tpu.analysis import lockdep
+    from spark_rapids_tpu.api.session import TpuSession
+    # session bootstrap primes both audits from its conf (the
+    # test_service / test_compile_pool pattern for enforce runs)
+    session = TpuSession.builder.config({
+        "spark.rapids.tpu.sql.explain": "NONE",
+        "spark.rapids.tpu.sql.analysis.bufferLedger": "enforce",
+        "spark.rapids.tpu.sql.analysis.lockdep": "enforce",
+    }).getOrCreate()
+    assert ledger.mode() == "enforce"
+    try:
+        tables = datagen.register_tables(session, 0.002)
+        rows = Q.QUERIES["q3"](tables).collect_batch() \
+            .fetch_to_host().rows()
+        assert len(rows) <= 10           # top-N query
+        led = session._last_ledger
+        assert led is not None, "audit must run under enforce"
+        assert led["leakedBuffers"] == 0
+        assert led["mintedBuffers"] >= 0
+        # tenant watermarks back at zero: no query-owned device bytes
+        # outlive the collect (the test_service discipline)
+        assert BufferCatalog.get().tenant_device_bytes() == {}
+    finally:
+        ledger.install("record")
+        lockdep.refresh_mode("record")
+
+
+def test_session_bootstrap_primes_ledger_from_conf():
+    from spark_rapids_tpu.api.session import TpuSession
+    TpuSession.builder.config(
+        {"spark.rapids.tpu.sql.analysis.bufferLedger": "enforce"}
+    ).getOrCreate()
+    try:
+        assert ledger.mode() == "enforce"
+    finally:
+        ledger.install("record")
+    # a later session without the key re-primes from its own conf
+    # (conftest's env default: record)
+    TpuSession.builder.getOrCreate()
+    assert ledger.mode() == "record"

@@ -703,3 +703,187 @@ def test_lint_json_reports_pragma_inventory():
     prag = [p for p in doc["pragmas"] if p["rule"] == "nondeterminism"]
     assert prag, "expected nondeterminism-ok pragmas in the tree"
     assert all(p["reason"] and p["suppresses"] for p in prag)
+
+
+# ---------------------------------------------------------------------------
+# Ownership rules (analysis/ownership.py, ISSUE 19): use-after-donate /
+# unreleased-acquire / double-free / untracked-residency
+# ---------------------------------------------------------------------------
+
+from spark_rapids_tpu.analysis import ownership  # noqa: E402
+
+
+def test_rule_use_after_donate_array_read():
+    src = ("def f(batch):\n"
+           "    donate = _donate_argnums(batch, 1)\n"
+           "    outs = _fused_fn(sig, build)(n, *batch.flat_arrays())\n"
+           "    return process(*batch.flat_arrays()), outs\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"use-after-donate"}
+    assert "flat_arrays" in v[0].message
+
+
+def test_rule_use_after_donate_bound_fn_and_handoff():
+    src = ("def f(batch):\n"
+           "    donate = _donate_argnums(batch, 1)\n"
+           "    fn = _fused_fn(sig, build)\n"
+           "    outs = fn(n, *batch.flat_arrays())\n"
+           "    return concat_batches(schema, batch)\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"use-after-donate"}
+    assert "concat_batches" in v[0].message
+
+
+def test_use_after_donate_metadata_and_probe_exempt():
+    # metadata reads survive donation (only the flat arrays die), the
+    # _donation_consumed/_note_donated funnels are legal, and an except
+    # handler's guarded re-read is the documented failure-path idiom
+    src = ("def f(batch):\n"
+           "    donate = _donate_argnums(batch, 1)\n"
+           "    try:\n"
+           "        outs = _fused_fn(sig, build)(n, *batch.flat_arrays())\n"
+           "        _note_donated(batch, donate)\n"
+           "    except Exception:\n"
+           "        if _donation_consumed(batch):\n"
+           "            raise\n"
+           "        return eager(batch.columns)\n"
+           "    return ColumnarBatch.from_flat_arrays(\n"
+           "        schema, list(outs), batch.num_rows)\n")
+    assert lint.lint_source(src, "exec/fixture.py") == []
+
+
+def test_use_after_donate_sibling_branch_not_flagged():
+    # code past the donated branch's return belongs to a sibling branch
+    # the donated invocation never reaches
+    src = ("def f(batch, reduce):\n"
+           "    donate = _donate_argnums(batch, 1)\n"
+           "    if reduce:\n"
+           "        outs = _fused_fn(sig, build)(n, *batch.flat_arrays())\n"
+           "        return outs\n"
+           "    return other_dispatch(batch)\n")
+    assert lint.lint_source(src, "exec/fixture.py") == []
+
+
+def test_rule_unreleased_acquire():
+    src = ("def g(b):\n"
+           "    handle = SpillableColumnarBatch(b)\n"
+           "    return 1\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"unreleased-acquire"}
+    assert "handle" in v[0].message
+
+
+def test_unreleased_acquire_release_escape_and_with_exempt():
+    released = ("def g(b):\n"
+                "    handle = SpillableColumnarBatch(b)\n"
+                "    try:\n"
+                "        return handle.get_batch()\n"
+                "    finally:\n"
+                "        handle.close()\n")
+    assert lint.lint_source(released, "exec/fixture.py") == []
+    escaped = ("def g(b):\n"
+               "    handle = SpillableColumnarBatch(b)\n"
+               "    return handle\n")
+    assert lint.lint_source(escaped, "exec/fixture.py") == []
+    with_bound = ("def g(b):\n"
+                  "    with SpillableColumnarBatch(b) as handle:\n"
+                  "        return handle.get_batch()\n")
+    assert lint.lint_source(with_bound, "exec/fixture.py") == []
+    staged = ("def g(n):\n"
+              "    win = _staging_acquire(n)\n"
+              "    try:\n"
+              "        return fill(win)\n"
+              "    finally:\n"
+              "        _staging_release(win)\n")
+    assert lint.lint_source(staged, "io/fixture.py") == []
+
+
+def test_rule_double_free():
+    src = ("def h(b):\n"
+           "    handle = SpillableColumnarBatch(b)\n"
+           "    handle.close()\n"
+           "    handle.close()\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"double-free"}
+    remove = ("def r(self, bid):\n"
+              "    self.catalog.remove(bid)\n"
+              "    self.catalog.remove(bid)\n")
+    v = lint.lint_source(remove, "exec/fixture.py")
+    assert _rules(v) == {"double-free"}
+
+
+def test_double_free_cleanup_and_rebind_exempt():
+    cleanup = ("def h(b):\n"
+               "    handle = SpillableColumnarBatch(b)\n"
+               "    try:\n"
+               "        handle.close()\n"
+               "    finally:\n"
+               "        handle.close()\n")
+    assert lint.lint_source(cleanup, "exec/fixture.py") == []
+    rebound = ("def h(b, c):\n"
+               "    handle = SpillableColumnarBatch(b)\n"
+               "    handle.close()\n"
+               "    handle = SpillableColumnarBatch(c)\n"
+               "    handle.close()\n")
+    assert lint.lint_source(rebound, "exec/fixture.py") == []
+
+
+def test_rule_untracked_residency():
+    src = ("_CACHE = {}\n\n"
+           "def c(schema, arrays, n):\n"
+           "    _CACHE[n] = ColumnarBatch.from_flat_arrays("
+           "schema, arrays, n)\n")
+    v = lint.lint_source(src, "exec/fixture.py")
+    assert _rules(v) == {"untracked-residency"}
+    assert "_CACHE" in v[0].message
+    appended = ("_RING = []\n\n"
+                "def c(x):\n"
+                "    _RING.append(jnp.asarray(x))\n")
+    v = lint.lint_source(appended, "columnar/fixture.py")
+    assert _rules(v) == {"untracked-residency"}
+
+
+def test_untracked_residency_host_values_and_locals_exempt():
+    host = ("_CACHE = {}\n\n"
+            "def c(k, v):\n"
+            "    _CACHE[k] = str(v)\n")
+    assert lint.lint_source(host, "exec/fixture.py") == []
+    local = ("def c(schema, arrays, n):\n"
+             "    cache = {}\n"
+             "    cache[n] = ColumnarBatch.from_flat_arrays("
+             "schema, arrays, n)\n"
+             "    return cache\n")
+    assert lint.lint_source(local, "exec/fixture.py") == []
+
+
+def test_ownership_pragma_silences_and_requires_reason():
+    ok = ("_CACHE = {}\n\n"
+          "def c(k, v):\n"
+          "    # lint: ownership-ok bounded per-shape cache by design\n"
+          "    _CACHE[k] = jnp.asarray(v)\n")
+    assert lint.lint_source(ok, "exec/fixture.py") == []
+    bare = ("_CACHE = {}\n\n"
+            "def c(k, v):\n"
+            "    _CACHE[k] = jnp.asarray(v)  # lint: ownership-ok\n")
+    v = lint.lint_source(bare, "exec/fixture.py")
+    assert _rules(v) == {"untracked-residency", "pragma-reason"}
+
+
+def test_ownership_rules_only_in_buffer_scope():
+    src = ("def g(b):\n"
+           "    handle = SpillableColumnarBatch(b)\n"
+           "    return 1\n")
+    assert lint.lint_source(src, "api/fixture.py") == []
+    assert lint.lint_source(src, "service/fixture.py") == []
+    assert _rules(lint.lint_source(src, "shuffle/fixture.py")) == \
+        {"unreleased-acquire"}
+
+
+def test_ownership_sink_registry_roundtrip():
+    defined = ownership.sink_registry(PKG)
+    # every declared sink resolves to a definition in the tree...
+    assert not ownership.check_registry(defined)
+    # ...and a stale declared entry is flagged
+    stale = ownership.check_registry(defined - {"exec.spill.defer_finalizer"})
+    assert len(stale) == 1 and stale[0].rule == "ownership-registry"
+    assert "defer_finalizer" in stale[0].message

@@ -161,6 +161,16 @@ def digest(query_id: str, records: List[dict], top: int = 5) -> str:
                    if r.get("hbmPeakOperator")), None)
         lines.append(f"  hbm peak: {hbm} bytes"
                      + (f" ({op})" if op else ""))
+    # buffer-lifecycle verdict (analysis/ledger.py): the leak line only
+    # appears when some worker actually leaked — a clean corpus stays
+    # clean-looking
+    leaked = sum(int(r.get("leakedBuffers", 0) or 0) for r in records)
+    if leaked:
+        peak = max((int(r.get("peakDeviceBytes", 0) or 0)
+                    for r in records), default=0)
+        lines.append(f"  LEAKED BUFFERS: {leaked} "
+                     f"(peakDeviceBytes={peak}) — see the buffer-leak "
+                     "flight events for mint sites")
     return "\n".join(lines)
 
 
