@@ -90,6 +90,13 @@ REPLAY_QPS = "replay_qps"
 REPLAY_P50_S = "replay_p50_s"
 REPLAY_P99_S = "replay_p99_s"
 REPLAY_CHAOS_P99_S = "replay_chaos_p99_s"
+#: REPLAY_PREEMPT_P99_S is the gold-tenant p99 of the preemption-armed
+#: mixed-priority leg (scheduler policy=wfq, ISSUE 20): high-priority
+#: latency while low-priority work is being suspended/resumed around it
+#: (lower is better; stamped only when >=1 suspend/resume cycle was
+#: actually observed and every query, preempted ones included, returned
+#: oracle-correct rows).
+REPLAY_PREEMPT_P99_S = "replay_preempt_p99_s"
 
 #: adaptive-execution series stamped by bench.py (ISSUE 16, docs/aqe.md):
 #: AQE_SKEW_Q3_S is the warm wall seconds of a deliberately skewed
@@ -120,7 +127,7 @@ FIRST_ROW_P99_S = "first_row_p99_s"
 INVERTED_QUERIES = frozenset({COMPILE_S, WARM_RESTART_S, WHOLE_QUERY_GAP,
                               WARM_TRAFFIC_Q6_S, CHAOS_Q6_RECOVERY_S,
                               REPLAY_P50_S, REPLAY_P99_S,
-                              REPLAY_CHAOS_P99_S,
+                              REPLAY_CHAOS_P99_S, REPLAY_PREEMPT_P99_S,
                               AQE_SKEW_Q3_S, AQE_AB_Q3,
                               COLD_Q6_S, FIRST_ROW_P99_S})
 

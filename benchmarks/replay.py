@@ -24,15 +24,21 @@ Artifact series (benchmarks/history.py, kind ``replay``):
 ``replay_qps`` (higher better), ``replay_p50_s`` / ``replay_p99_s``
 (submit->result latency percentiles, lower better),
 ``first_row_p99_s`` (submit->FIRST-BATCH p99 of the streaming leg's
-``submit_stream`` traffic, lower better), and ``replay_chaos_p99_s``
-for the chaos mode. Stamped only when every
-query returned oracle-correct rows (and, under chaos, every armed fault
-fired) — a wrong-answer replay is void, not fast.
+``submit_stream`` traffic, lower better), ``replay_chaos_p99_s``
+for the chaos mode, and ``replay_preempt_p99_s`` (gold p99 of the
+preemption-armed mixed-priority leg, --preempt: weighted-fair
+scheduling suspends a running low-priority query so the high-priority
+arrival runs first, then resumes it — ISSUE 20). Stamped only when
+every query returned oracle-correct rows (under chaos, every armed
+fault additionally fired; under --preempt, at least one suspend/resume
+cycle was additionally observed) — a wrong-answer replay is void, not
+fast.
 
 CLI::
 
     python -m benchmarks.replay --sf 0.002 --streams 4 --iters 6
     python -m benchmarks.replay --faults "fetch.fail;task.poison"
+    python -m benchmarks.replay --preempt --iters 6
 """
 
 from __future__ import annotations
@@ -330,6 +336,165 @@ def run_replay(sf: float = 0.002, streams: int = 4,
     return line
 
 
+def run_preempt_replay(sf: float = 0.002, rounds: int = 6,
+                       stamp: bool = True,
+                       history_path: Optional[str] = None) -> Dict:
+    """Preemption-armed mixed-priority leg (ISSUE 20, docs/service.md
+    §4): ONE worker slot, weighted-fair scheduling with preemption ON.
+
+    Each round submits a long low-priority ``bronze`` shuffle query,
+    waits for it to occupy the slot, then a high-priority ``gold`` query
+    arrives: the scheduler requests suspension of the running bronze
+    query, which parks its working set at the next cancel poll; gold
+    runs in the freed slot; a resumer thread re-admits the parked query,
+    which must still return oracle-correct rows. Stamps
+    ``replay_preempt_p99_s`` (gold submit->result p99, lower better)
+    ONLY when at least one full suspend/resume cycle was actually
+    observed and EVERY query — the preempted ones included — matched
+    the fault-free oracle: a preemption leg where nothing got preempted
+    (or a preempted query came back wrong) is void, not fast.
+    """
+    import jax
+    from benchmarks import datagen
+    from benchmarks import queries as Q
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.service.server import QueryService, TenantSpec
+
+    session = _build_session(None, {
+        "spark.rapids.tpu.sql.service.scheduler.policy": "wfq",
+        "spark.rapids.tpu.sql.service.scheduler.preemption": "true",
+        # a preempted query's park/resume must keep the buffer ledger
+        # clean — enforce raises on any leaked lifecycle, so the leg
+        # doubles as the suspend-path leak check
+        "spark.rapids.tpu.sql.analysis.bufferLedger": "enforce",
+        # more partitions -> more per-partition cancel polls, so the
+        # running bronze query reaches a suspension point quickly
+        "spark.rapids.tpu.sql.shuffle.partitions": "8",
+    })
+    tables = datagen.register_tables(session, sf)
+    tables["lineitem"].createOrReplaceTempView("replay_lineitem")
+    shuffled = dict(tables)
+    shuffled["lineitem"] = tables["lineitem"].repartition(
+        8, col("l_orderkey"))
+
+    # fault-free oracles, executed directly before the service opens
+    bronze_oracle = Q.QUERIES["q6"](shuffled).collect()
+    gold_stmt = session.prepare(_Q6_SQL)
+    gold_oracle: Dict[int, list] = {}
+    for i in range(rounds):
+        lo, hi = _window(i)
+        gold_oracle[i] = gold_stmt.execute(lo=lo, hi=hi).rows()
+
+    # one slot total: a gold arrival while bronze runs ALWAYS finds the
+    # service saturated, which is the preemption precondition. Gold's
+    # larger weight keeps its service-unit clock slower, so the freed
+    # slot goes to gold, not straight back to the resumed bronze.
+    svc = QueryService(session, max_workers=1, tenants=[
+        TenantSpec("gold", priority=10, slots=1, weight=4.0,
+                   memory_budget_bytes=1 << 30),
+        TenantSpec("bronze", priority=0, slots=1, weight=1.0,
+                   memory_budget_bytes=256 << 20)])
+
+    stop = threading.Event()
+
+    def resumer() -> None:
+        # the re-admission half of the cycle: parked queries go back
+        # through the scheduler as soon as they are seen
+        while not stop.is_set():
+            for qid in svc.suspended_queries():
+                try:
+                    svc.resume(qid)
+                except Exception:
+                    # a ticket resumed by a racing pass or a closing
+                    # service is not a bench failure
+                    pass
+            stop.wait(0.01)
+
+    gold_lat: List[float] = []
+    wrong: List[str] = []
+    errors: List[str] = []
+    bronze_tickets = []
+    res_thread = threading.Thread(target=resumer, daemon=True,
+                                  name="preempt-replay-resumer")
+    res_thread.start()
+    try:
+        for i in range(rounds):
+            bt = svc.submit("bronze", Q.QUERIES["q6"](shuffled),
+                            label=f"bronze-{i}")
+            bronze_tickets.append((i, bt))
+            # wait for bronze to actually occupy the slot (preemption
+            # only targets RUNNING queries)
+            deadline = time.perf_counter() + 5.0
+            while time.perf_counter() < deadline:
+                if svc.stats()["running"] >= 1:
+                    break
+                time.sleep(0.002)
+            lo, hi = _window(i)
+            gt = svc.submit("gold", gold_stmt,
+                            params={"lo": lo, "hi": hi},
+                            label=f"gold-{i}")
+            try:
+                rows = gt.result(timeout=600).rows()
+            except Exception as e:
+                errors.append(f"gold-{i}: {type(e).__name__}: {e}"[:200])
+                continue
+            gold_lat.append(gt.latency_s())
+            if not _rows_close(rows, gold_oracle[i]):
+                wrong.append(f"gold-{i}")
+        # the preempted queries must come back and come back RIGHT
+        for i, bt in bronze_tickets:
+            try:
+                rows = bt.result(timeout=600).rows()
+            except Exception as e:
+                errors.append(f"bronze-{i}: {type(e).__name__}: {e}"[:200])
+                continue
+            if not _rows_close(rows, bronze_oracle):
+                wrong.append(f"bronze-{i}")
+    finally:
+        stop.set()
+        res_thread.join(timeout=5)
+        stats = svc.stats()
+        svc.close()
+
+    bronze_stats = stats["tenants"]["bronze"]
+    preempted = int(bronze_stats["preempted"])
+    resumed = int(bronze_stats["resumed"])
+    gold_lat.sort()
+    p99 = _percentile(gold_lat, 0.99)
+    # honesty: the leg is void without >=1 OBSERVED suspend/resume
+    # cycle — otherwise it silently degrades into a plain WFQ replay
+    ok = (not wrong and not errors and len(gold_lat) == rounds and
+          preempted >= 1 and resumed >= 1)
+    line: Dict = {
+        "metric": "preempt replay",
+        "backend": jax.devices()[0].platform,
+        "sf": sf,
+        "rounds": rounds,
+        "gold_completed": len(gold_lat),
+        "preempted": preempted,
+        "resumed": resumed,
+        "replay_preempt_p99_s": round(p99, 4),
+        "replay_ok": ok,
+        "service": stats,
+    }
+    if wrong:
+        line["wrong_results"] = wrong[:10]
+    if errors:
+        line["errors"] = errors[:10]
+    if stamp and ok:
+        from benchmarks import history as bh
+        gate = bh.stamp(
+            "replay",
+            {bh.REPLAY_PREEMPT_P99_S: line["replay_preempt_p99_s"]},
+            backend=line["backend"], higher_is_better=True,
+            meta={"sf": sf, "mode": "preempt", "rounds": rounds},
+            path=history_path)
+        line["regression"] = {q: v.get("verdict")
+                              for q, v in gate["verdicts"].items()}
+        line["regression_overall"] = gate["overall"]
+    return line
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="concurrent mixed-tenant TPC-H traffic replay "
@@ -343,13 +508,21 @@ def main(argv=None) -> int:
     ap.add_argument("--faults", default=None,
                     help="chaos spec for the replay window ('default' = "
                          f"{DEFAULT_FAULTS!r})")
+    ap.add_argument("--preempt", action="store_true",
+                    help="run the preemption-armed mixed-priority leg "
+                         "(wfq + suspend/resume) instead of the stream "
+                         "replay")
     ap.add_argument("--no-stamp", action="store_true",
                     help="skip the bench-history regression stamp")
     args = ap.parse_args(argv)
-    faults = DEFAULT_FAULTS if args.faults == "default" else args.faults
-    line = run_replay(sf=args.sf, streams=args.streams,
-                      queries_per_stream=args.iters, faults=faults,
-                      stamp=not args.no_stamp)
+    if args.preempt:
+        line = run_preempt_replay(sf=args.sf, rounds=args.iters,
+                                  stamp=not args.no_stamp)
+    else:
+        faults = DEFAULT_FAULTS if args.faults == "default" else args.faults
+        line = run_replay(sf=args.sf, streams=args.streams,
+                          queries_per_stream=args.iters, faults=faults,
+                          stamp=not args.no_stamp)
     print(json.dumps(line, default=str))
     return 0 if line.get("replay_ok") else 1
 

@@ -14,7 +14,8 @@ Spec grammar (see docs/resilience.md)::
     spec     := clause (';' clause)*
     clause   := point [':' count] ['@' selector]
     point    := fetch.fail | conn.kill | task.poison | worker.die
-              | mesh.drop | desync.inject
+              | mesh.drop | desync.inject | cancel.inject
+              | preempt.inject
     count    := positive int, default 1 — firings before the clause
                 disarms
     selector := 'p<pid>' ['b<batch>'] | 'b<batch>'   (task.poison)
@@ -44,6 +45,14 @@ Points and where they fire:
   its next real event: the peers' per-query digests now disagree at
   exactly that index, driving the full desync detection path
   (DesyncError with first-divergent-event diagnosis) deterministically.
+* ``cancel.inject`` — the next ambient cancel poll
+  (``exec/lifecycle.check_cancel``) cancels the polling query, driving
+  the full cooperative-cancellation unwind (FAIL_QUERY, ledger-audited
+  cleanup) without a second thread racing the poll.
+* ``preempt.inject`` — the next ambient cancel poll requests suspension
+  of the polling query: under the service the worker loop parks the
+  ticket (spill + stage cursor); a direct collect fails loudly — there
+  is no scheduler to park under (docs/service.md).
 
 Every firing lands in the flight recorder (kind ``fault``) and bumps
 ``tpu_faults_injected_total``, so a recovery post-mortem shows the
@@ -58,7 +67,8 @@ from typing import Callable, Dict, List, Optional
 from .lockdep import named_lock
 
 POINTS = ("fetch.fail", "conn.kill", "task.poison", "worker.die",
-          "mesh.drop", "desync.inject")
+          "mesh.drop", "desync.inject", "cancel.inject",
+          "preempt.inject")
 
 _CLAUSE_RE = re.compile(
     r"^(?P<point>[a-z.]+)(?::(?P<count>\d+))?(?:@(?P<sel>[a-z0-9]+))?$")

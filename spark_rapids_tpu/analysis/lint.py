@@ -63,6 +63,16 @@ Retry/recovery decisions belong to the ONE stage-retry driver; a bare
 catch elsewhere is how retry logic quietly forks into second
 implementations (docs/resilience.md).
 
+``cancel-point`` (partition-drain / fetch-poll modules:
+``exec/tasks.py``, ``shuffle/transport.py``, ``shuffle/exchange.py``):
+every ``while`` loop, and every ``for`` loop whose body contains a
+blocking dwell (``sleep``/``wait``/``get``/``acquire``/socket calls),
+must reach the ambient cancel poll — a ``check_cancel()`` or
+``interruptible_sleep()`` call inside the loop — or carry a reasoned
+``# lint: cancel-ok <reason>`` pragma. An unpolled unbounded loop is a
+query that cannot be cancelled or preempted while it spins
+(exec/lifecycle.py, docs/resilience.md §"cancellation").
+
 The linter is pure AST + text: no engine import, no jax import.
 """
 
@@ -118,6 +128,23 @@ RECOVER_TAXONOMY_NAMES = {
 }
 #: the one module allowed to catch taxonomy types bare
 RECOVER_MODULE = "exec/recovery.py"
+
+CANCEL_PRAGMA_RE = re.compile(r"#\s*lint:\s*cancel-ok(.*)$")
+
+#: partition-drain / fetch-poll modules whose loops must reach the
+#: ambient cancel poll (exec/lifecycle.check_cancel) — the cooperative
+#: cancellation contract's enforcement surface (docs/resilience.md)
+CANCEL_POINT_MODULES = ("exec/tasks.py", "shuffle/transport.py",
+                        "shuffle/exchange.py")
+#: the calls that ARE a poll point
+CANCEL_POLL_NAMES = {"check_cancel", "interruptible_sleep"}
+#: attribute-call names that make a ``for`` loop a blocking dwell (the
+#: loop can park a thread, so a pending cancel must be able to reach
+#: it). Deliberately excludes the ambiguous ``get``/``put``/``join``
+#: (dict.get, os.path.join, ...) — the queue dwells those would catch
+#: are ``while`` loops, which the rule always checks
+CANCEL_BLOCKING_ATTRS = {"sleep", "wait", "acquire", "recv",
+                         "recv_into", "sendall", "connect", "select"}
 
 
 @dataclass
@@ -254,6 +281,10 @@ def lint_source(source: str, rel: str, path: Optional[str] = None
     # stage-retry driver carry a reasoned pragma
     out.extend(_check_bare_recover(tree, source, rel, path))
 
+    # cancel-point (partition-drain / fetch-poll modules): every
+    # unbounded or blocking loop reaches the cooperative cancel poll
+    out.extend(_check_cancel_points(tree, source, rel, path))
+
     # querylog-key: the structured query log's record fields are a
     # declared surface, like METRICS and TELEMETRY_KEYS
     if rel == QUERY_LOG_MODULE:
@@ -360,6 +391,78 @@ def _check_bare_recover(tree: ast.AST, source: str, rel: str, path: str
             "stage-retry driver (exec/recovery.retry_stage / "
             "StageRetryState) or pragma with "
             "`# lint: recover-ok <reason>`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cancel-point: drain/poll loops must reach the cooperative cancel poll
+# ---------------------------------------------------------------------------
+
+def _loop_polls_cancel(loop: ast.AST) -> bool:
+    """The loop (or anything nested in it) calls a poll-point function —
+    ``check_cancel()`` / ``interruptible_sleep()``, bare or dotted."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in CANCEL_POLL_NAMES:
+                return True
+    return False
+
+
+def _loop_blocks(loop: ast.For) -> bool:
+    """The for loop's body contains a blocking dwell (a call whose
+    attribute name is in CANCEL_BLOCKING_ATTRS) — the subset of ``for``
+    loops that can park a thread and therefore must be pollable."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in CANCEL_BLOCKING_ATTRS:
+            return True
+    return False
+
+
+def _check_cancel_points(tree: ast.AST, source: str, rel: str, path: str
+                         ) -> List[LintViolation]:
+    """``cancel-point``: in the partition-drain / fetch-poll modules,
+    every ``while`` loop and every blocking ``for`` loop either reaches
+    the ambient cancel poll or carries a reasoned cancel-ok pragma — an
+    unpolled unbounded loop is a query that cannot be cancelled or
+    preempted while it spins (exec/lifecycle.py)."""
+    out: List[LintViolation] = []
+    pragmas: Dict[int, str] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = CANCEL_PRAGMA_RE.search(line)
+        if m:
+            reason = m.group(1).strip()
+            if not reason:
+                out.append(LintViolation(
+                    path, i, "pragma-reason",
+                    "cancel-ok pragma missing its justification "
+                    "(format: `# lint: cancel-ok <reason>`)"))
+            pragmas[i] = reason
+    if rel not in CANCEL_POINT_MODULES:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.While):
+            kind = "while"
+        elif isinstance(node, ast.For) and _loop_blocks(node):
+            kind = "blocking-for"
+        else:
+            continue
+        if _loop_polls_cancel(node):
+            continue
+        if any(l in pragmas and pragmas[l]
+               for l in (node.lineno, node.lineno - 1)):
+            continue
+        out.append(LintViolation(
+            path, node.lineno, "cancel-point",
+            f"{kind} loop in a partition-drain/fetch-poll module never "
+            "polls the ambient cancel token — call "
+            "exec/lifecycle.check_cancel() (or interruptible_sleep) "
+            "inside the loop, or pragma with "
+            "`# lint: cancel-ok <reason>`"))
     return out
 
 

@@ -391,6 +391,12 @@ class DataFrame:
         qc.note_thread_query_id(qid)
         self.session._last_tenant = ctx.tenant
         self.session._last_first_row_s = None
+        # lifecycle control plane (exec/lifecycle.py): index this query's
+        # cancel token by id so cancel/suspend surfaces (QueryService,
+        # session.cancel_query, the peer META reply) can reach the
+        # running execution; unregistered in the finally below
+        from ..exec import lifecycle as _lifecycle
+        _lifecycle.register(ctx)
         from ..analysis import faults as _faults
         faults0 = _faults.fired_total()
         # AQE pre-execution hook (plan/aqe.py): clear the prior run's
@@ -403,78 +409,100 @@ class DataFrame:
         except Exception:
             pass
         t0 = time.perf_counter()
-        with qc.query_scope(ctx):
+        try:
+            with qc.query_scope(ctx):
+                try:
+                    with SyncCounter() as sc, SpanRecorder() as spans:
+                        spans.query_id = qid
+                        out = exec_plan.execute_collect()
+                except BaseException as e:
+                    # post-mortem for failures OUTSIDE task bodies
+                    # (planner-side execute, concat, exchange setup): dump
+                    # the flight ring INSIDE the query scope so the
+                    # artifact is scoped+named to the failing query.
+                    # dump_on_error never raises and dedups against the
+                    # task-level hook, so the original exception
+                    # propagates unmasked.
+                    from ..service.telemetry import dump_on_error
+                    dump_on_error(e)
+                    raise
+            self.session._last_execute_time_s = time.perf_counter() - t0
+            # a materializing collect serves its first row when it serves
+            # its last: firstRowS == executeTimeS, honestly (collect_iter
+            # is the path that beats it; docs/observability.md)
+            self.session._last_first_row_s = \
+                self.session._last_execute_time_s
             try:
-                with SyncCounter() as sc, SpanRecorder() as spans:
-                    spans.query_id = qid
-                    out = exec_plan.execute_collect()
-            except BaseException as e:
-                # post-mortem for failures OUTSIDE task bodies
-                # (planner-side execute, concat, exchange setup): dump
-                # the flight ring INSIDE the query scope so the artifact
-                # is scoped+named to the failing query. dump_on_error
-                # never raises and dedups against the task-level hook,
-                # so the original exception propagates unmasked.
-                from ..service.telemetry import dump_on_error
-                dump_on_error(e)
-                raise
-        self.session._last_execute_time_s = time.perf_counter() - t0
-        # a materializing collect serves its first row when it serves its
-        # last: firstRowS == executeTimeS, honestly (collect_iter is the
-        # path that beats it; docs/observability.md)
-        self.session._last_first_row_s = self.session._last_execute_time_s
-        try:
-            # AQE post-execution hook: store observed cardinalities +
-            # exchange bytes under this fingerprint for the NEXT
-            # execution (drift feedback, admission cost weighting)
-            from ..plan import aqe
-            aqe.note_execution(self.session, exec_plan, serving)
-        except Exception:
-            pass
-        try:
-            from ..service.telemetry import MetricsRegistry
-            MetricsRegistry.get().histogram(
-                "tpu_query_execute_seconds",
-                "collect-action execute wall seconds").observe(
-                self.session._last_execute_time_s)
-        except Exception:
-            pass           # observability must never fail the query
-        self.session._last_sync_report = sc.report()
-        self.session._last_span_report = spans.report()
-        # the recorder itself stays reachable so the bench runner / tests
-        # can export the Chrome-trace timeline of this query
-        self.session._last_span_recorder = spans
-        if listeners:
-            from .session import QueryExecution
-            ov = self.session._last_overrides
-            self.session._notify_query_listeners(QueryExecution(
-                self.session, exec_plan,
-                self.session._last_sync_report,
-                self.session._last_span_report,
-                recompile.delta(rc0), lockdep.stats_delta(lk0),
-                violations=getattr(ov, "last_violations", ()) if ov
-                else ()))
-        rkey = serving.get("resultKey")
-        if rkey is not None:
-            # store AFTER the sync/span windows closed: the caching
-            # fetch must not perturb this query's reported sync counts
-            out = pc.store_result(self.session, rkey, out)
-        # end-of-query buffer-lifecycle audit (analysis/ledger.py): runs
-        # AFTER store_result so a cached result's pinned buffers are
-        # owned by the cache, not leaked by this query. BufferLeakError
-        # propagates in enforce mode — leak discipline is the point.
-        from ..analysis import ledger as _ledger
-        self.session._last_ledger = _ledger.end_of_query(qid)
-        try:
-            # opt-in structured query log (service/query_log.py, conf
-            # telemetry.queryLog.dir): one JSONL record per execution.
-            # Best-effort — the log must never fail the query.
-            from ..service import query_log
-            query_log.maybe_log(self.session, exec_plan, serving, qid,
-                                faults_before=faults0, tenant=ctx.tenant)
-        except Exception:
-            pass
-        return out
+                # AQE post-execution hook: store observed cardinalities +
+                # exchange bytes under this fingerprint for the NEXT
+                # execution (drift feedback, admission cost weighting)
+                from ..plan import aqe
+                aqe.note_execution(self.session, exec_plan, serving)
+            except Exception:
+                pass
+            try:
+                from ..service.telemetry import MetricsRegistry
+                MetricsRegistry.get().histogram(
+                    "tpu_query_execute_seconds",
+                    "collect-action execute wall seconds").observe(
+                    self.session._last_execute_time_s)
+            except Exception:
+                pass           # observability must never fail the query
+            self.session._last_sync_report = sc.report()
+            self.session._last_span_report = spans.report()
+            # the recorder itself stays reachable so the bench runner /
+            # tests can export the Chrome-trace timeline of this query
+            self.session._last_span_recorder = spans
+            if listeners:
+                from .session import QueryExecution
+                ov = self.session._last_overrides
+                self.session._notify_query_listeners(QueryExecution(
+                    self.session, exec_plan,
+                    self.session._last_sync_report,
+                    self.session._last_span_report,
+                    recompile.delta(rc0), lockdep.stats_delta(lk0),
+                    violations=getattr(ov, "last_violations", ()) if ov
+                    else ()))
+            rkey = serving.get("resultKey")
+            if rkey is not None:
+                # store AFTER the sync/span windows closed: the caching
+                # fetch must not perturb this query's reported sync counts
+                out = pc.store_result(self.session, rkey, out)
+            # end-of-query buffer-lifecycle audit (analysis/ledger.py):
+            # runs AFTER store_result so a cached result's pinned buffers
+            # are owned by the cache, not leaked by this query.
+            # BufferLeakError propagates in enforce mode — leak
+            # discipline is the point.
+            from ..analysis import ledger as _ledger
+            self.session._last_ledger = _ledger.end_of_query(qid)
+            try:
+                # opt-in structured query log (service/query_log.py, conf
+                # telemetry.queryLog.dir): one JSONL record per execution.
+                # Best-effort — the log must never fail the query.
+                from ..service import query_log
+                query_log.maybe_log(self.session, exec_plan, serving, qid,
+                                    faults_before=faults0,
+                                    tenant=ctx.tenant)
+            except Exception:
+                pass
+            return out
+        finally:
+            import sys as _sys
+            if _sys.exc_info()[0] is not None:
+                # failed (or cancelled) queries get the residency audit
+                # too: a cancellation's cleanup must be ledger-provable,
+                # and had_error keeps enforce mode from masking the
+                # propagating exception with a leak report
+                try:
+                    from ..analysis import ledger as _ledger_err
+                    self.session._last_ledger = _ledger_err.end_of_query(
+                        qid, had_error=True)
+                except Exception:
+                    pass
+            # the token's transition log retires with the query (the
+            # query-log record read it above; a late peer META poll still
+            # sees the cancelled verdict through the retired map)
+            _lifecycle.unregister(qid)
 
     def collect_iter(self):
         """Streaming collect: yield host-resident batches as partitions
@@ -535,6 +563,10 @@ class DataFrame:
         # instead of blocking the first batches (compile_pool.routable)
         ctx.streaming = True
         self.session._last_tenant = ctx.tenant
+        # lifecycle token index (the materializing collect's rule above);
+        # unregistered in the finally
+        from ..exec import lifecycle as _lifecycle
+        _lifecycle.register(ctx)
         from ..analysis import faults as _faults
         faults0 = _faults.fired_total()
         try:
@@ -551,7 +583,11 @@ class DataFrame:
                 with SyncCounter() as sc, SpanRecorder() as spans:
                     spans.query_id = qid
                     try:
-                        for batch in exec_plan.execute_collect_iter():
+                        for batch in exec_plan.execute_collect_iter():  # lint: cancel-ok body polls check_cancel per delivered batch
+                            # streaming delivery is a lifecycle poll
+                            # point: a cancelled stream stops between
+                            # batches instead of draining to the end
+                            _lifecycle.check_cancel()
                             if first_row_s is None:
                                 first_row_s = time.perf_counter() - t0
                                 self.session._last_first_row_s = \
@@ -615,6 +651,7 @@ class DataFrame:
                                     tenant=ctx.tenant)
             except Exception:
                 pass
+            _lifecycle.unregister(qid)
 
     def collect(self) -> List[tuple]:
         return self.collect_batch().rows()

@@ -465,6 +465,26 @@ class TpuSession:
         return FlightRecorder.get().dump(path, reason="on-demand",
                                          query_id=query_id)
 
+    # -- query-lifecycle control (exec/lifecycle.py, docs/service.md §4) ----
+    def cancel_query(self, query_id: str, reason: str = "cancel") -> bool:
+        """Cooperatively cancel a RUNNING query by id (from another
+        thread — a collect is synchronous on its own): sets the query's
+        cancel flag, and the execution unwinds with a typed
+        ``QueryCancelledError`` at its next poll point (partition drain,
+        fetch/completion poll, retry backoff, ``collect_iter``
+        delivery). Never a thread kill; cleanup runs the normal error
+        path (arenas release, the buffer ledger audits residency).
+        False when no such query is live."""
+        from ..exec import lifecycle
+        return lifecycle.cancel_query(query_id, reason)
+
+    def live_queries(self) -> List[str]:
+        """Query ids currently registered with the lifecycle control
+        plane in this process (running collects; suspended queries stay
+        with the service that parked them)."""
+        from ..exec import lifecycle
+        return lifecycle.live_queries()
+
     # -- query-lifecycle observability (docs/observability.md §8) -----------
     def last_query_id(self) -> Optional[str]:
         """The query id minted for the last executed collect (None before

@@ -848,6 +848,36 @@ SERVICE_ADMISSION_EXPENSIVE_BYTES = _conf(
     "1 unit. 0 disables cost weighting (every admit charges 1)"
 ).bytes_conf.create_with_default(0)
 
+SERVICE_SCHEDULER_POLICY = _conf(
+    "spark.rapids.tpu.sql.service.scheduler.policy").doc(
+    "Queue discipline of the multi-tenant service (docs/service.md §4). "
+    "'priority': strict (priority DESC, deadline, arrival) — a "
+    "low-priority flood cannot starve a high-priority tenant, the "
+    "converse is intended. 'wfq': weighted deficit round-robin over "
+    "tenants (TenantSpec.weight shares) with preemption — a "
+    "high-priority arrival finding every slot busy suspends the running "
+    "query with the largest deficit instead of queueing behind it"
+).string_conf.check(
+    lambda v: str(v) in ("priority", "wfq")).create_with_default(
+    "priority")
+
+SERVICE_DEFAULT_TENANT_WEIGHT = _conf(
+    "spark.rapids.tpu.sql.service.defaultTenantWeight").doc(
+    "Weighted-fair share for TenantSpecs without an explicit weight "
+    "under service.scheduler.policy=wfq: each scheduling round credits "
+    "a tenant's deficit counter by its weight, and the eligible tenant "
+    "with the largest deficit runs next (docs/service.md §4)"
+).double_conf.check(lambda v: float(v) > 0).create_with_default(1.0)
+
+SERVICE_SCHEDULER_PREEMPTION = _conf(
+    "spark.rapids.tpu.sql.service.scheduler.preemption").doc(
+    "Under the wfq policy, allow a strictly higher-priority arrival "
+    "that finds all execution slots busy to SUSPEND the running query "
+    "with the largest deficit (working set spilled via the tenant "
+    "catalog, stage cursor parked, re-admitted on resume — "
+    "docs/service.md §4b). Off: arrivals always queue"
+).boolean_conf.create_with_default(True)
+
 PARSE_CACHE_MAX_ENTRIES = _conf(
     "spark.rapids.tpu.sql.service.parseCache.maxEntries").doc(
     "LRU bound on the per-session SQL-text -> parsed-plan cache serving "

@@ -187,6 +187,11 @@ def consult(key, builder, warm_args, kernel: str = "") -> str:
     consult never reaches here — ``plan.physical.fused_cached`` turns
     True first and the stage takes the plain cache-hit path (the
     eager -> compiled swap, one batch boundary after the build lands)."""
+    # consult is called once per batch boundary while a build is in
+    # flight — a named lifecycle poll point: a cancelled query must stop
+    # re-asking for a program it will never run
+    from .lifecycle import check_cancel
+    check_cancel()
     deadline_at = qc.current_deadline_at()
     with _cond:
         if key in _failed:

@@ -1,0 +1,381 @@
+"""Query lifecycle control plane: cooperative cancel, suspend, resume.
+
+ROADMAP item 2 named the gap: the multi-tenant service could shed QUEUED
+work (admission rejection, deadline shed) but a RUNNING query was
+uncontrollable — one long low-priority collect held its execution slot
+against a high-priority arrival until it finished or died. This module
+is the control plane that closes that gap, standing on the substrate the
+earlier PRs built: spillable tenant-tagged buffers (exec/spill.py),
+bounded stage retries (exec/recovery.py), and the buffer-lifecycle
+ledger (analysis/ledger.py) that can *prove* a cancelled or suspended
+query released everything.
+
+Three pieces:
+
+* :class:`CancelToken` — a per-query flag pair (cancelled /
+  suspend-requested) with lock-free reads, polled cooperatively via
+  :func:`check_cancel` at every long-running loop boundary (partition
+  drain, shuffle fetch/completion polls, stage-retry backoff dwells,
+  compile-pool consult, ``collect_iter`` delivery — the ``cancel-point``
+  lint rule keeps the poll set honest). A set flag raises the typed
+  :class:`QueryCancelledError` (mapped to FAIL_QUERY by
+  ``exec/recovery.classify`` — cancellation is never retried) or
+  :class:`QuerySuspendedError` (caught ONLY by the service worker loop,
+  which parks the ticket instead of failing it).
+* a process-global ``query_id -> token`` registry so external surfaces
+  (``QueryService.cancel/suspend/resume``, ``session.cancel_query``,
+  the shuffle META reply that propagates cancellation cross-process the
+  way divergence snapshots ride it) can reach a running query by id.
+* a timestamped transition log per query (``submitted -> running ->
+  suspend-requested -> suspended -> resumed -> ...``), flight-recorded
+  (kind ``lifecycle``) and surfaced in the query log's ``lifecycle``
+  field; transitions of recently finished queries are retained in a
+  bounded retired map so the log record written at end-of-query still
+  sees them.
+
+Deadline enforcement rides the same poll: a running query whose
+admission deadline lapses is cancelled (reason ``deadline``) at its next
+poll point — stage boundaries included — instead of running to
+completion (the "shed before the deadline lapses" promise, ROADMAP
+item 3). The chaos points ``cancel.inject`` / ``preempt.inject``
+(analysis/faults.py) fire inside :func:`check_cancel`, so every
+lifecycle path is deterministically testable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..analysis.lockdep import named_lock
+
+#: lifecycle states (transition-log vocabulary; the query log and
+#: ``tools/query_report`` consume these strings verbatim)
+RUNNING = "running"
+CANCELLED = "cancelled"
+SUSPEND_REQUESTED = "suspend-requested"
+SUSPENDED = "suspended"
+RESUMED = "resumed"
+
+
+class QueryCancelledError(RuntimeError):
+    """A query observed its cancel flag at a poll point. FAIL_QUERY in
+    the recovery taxonomy: retrying cancelled work would resurrect the
+    exact execution the caller asked to stop."""
+
+    def __init__(self, query_id: Optional[str] = None,
+                 reason: str = "cancel"):
+        self.query_id = query_id
+        self.reason = reason
+        super().__init__(
+            f"query {query_id or '<unidentified>'} cancelled ({reason})")
+
+
+class QuerySuspendedError(RuntimeError):
+    """Control-flow signal, not a failure: a query observed its
+    suspend-request flag at a poll point and is unwinding so the service
+    worker loop can park its ticket (spill the working set, free the
+    slot) and later resume it. Only ``service/server._worker_loop``
+    catches this; anywhere else it propagates like any unknown error
+    (FAIL_QUERY) — a suspend request against a direct caller-owned
+    collect has no scheduler to park under."""
+
+    def __init__(self, query_id: Optional[str] = None):
+        self.query_id = query_id
+        super().__init__(
+            f"query {query_id or '<unidentified>'} suspended (preempted)")
+
+
+class CancelToken:
+    """One query's cooperative lifecycle flags. Flag READS are lock-free
+    (polled at hot loop boundaries); transitions serialize under the
+    token's own lock and append to the timestamped transition log."""
+
+    def __init__(self, query_id: Optional[str] = None):
+        self.query_id = query_id
+        self._cancelled = False
+        self._cancel_reason: Optional[str] = None
+        self._suspend_requested = False
+        self._state = RUNNING
+        #: parked stage cursor (which stage, which partitions completed)
+        #: recorded by the poll site that raised the suspension — the
+        #: stage-retry driver re-enters the stage on resume, durable
+        #: outputs and the plan cache make the re-entry cheap
+        self.cursor: Optional[Dict[str, Any]] = None
+        self.transitions: List[Dict[str, Any]] = [
+            {"state": RUNNING, "tS": round(time.time(), 3)}]
+        self._mu = named_lock("exec.lifecycle.CancelToken._mu")
+
+    # -- transitions ---------------------------------------------------------
+
+    def _note_locked(self, state: str,
+                     reason: Optional[str] = None) -> None:
+        entry: Dict[str, Any] = {"state": state,
+                                 "tS": round(time.time(), 3)}
+        if reason:
+            entry["reason"] = reason
+        self.transitions.append(entry)
+        self._state = state
+
+    def _flight(self, state: str, reason: Optional[str] = None) -> None:
+        # OUTSIDE the token lock: flight_record takes the telemetry
+        # singleton lock and must never nest under an engine lock
+        try:
+            from ..service.telemetry import flight_record
+            flight_record("lifecycle", f"{state}-{self.query_id or '?'}",
+                          {"reason": reason} if reason else None)
+        except Exception:
+            pass
+
+    def cancel(self, reason: str = "cancel") -> bool:
+        """Set the cancel flag (idempotent; first caller's reason wins).
+        The query unwinds at its NEXT poll point — cooperative, never a
+        thread kill."""
+        with self._mu:
+            if self._cancelled:
+                return False
+            self._cancel_reason = reason
+            self._cancelled = True
+            self._note_locked(CANCELLED, reason)
+        self._flight(CANCELLED, reason)
+        _count("tpu_query_cancelled_total")
+        return True
+
+    def request_suspend(self, reason: str = "preempt") -> bool:
+        """Ask the query to park at its next poll point. No-op when
+        already cancelled or already requested."""
+        with self._mu:
+            if self._cancelled or self._suspend_requested:
+                return False
+            self._suspend_requested = True
+            self._note_locked(SUSPEND_REQUESTED, reason)
+        self._flight(SUSPEND_REQUESTED, reason)
+        return True
+
+    def mark_suspended(self, cursor: Optional[Dict[str, Any]] = None) \
+            -> None:
+        """The service worker loop parked the ticket: working set spilled,
+        slot freed, stage cursor recorded."""
+        with self._mu:
+            if cursor is not None:
+                self.cursor = cursor
+            self._note_locked(SUSPENDED)
+        self._flight(SUSPENDED)
+        _count("tpu_query_preempted_total")
+
+    def resume(self) -> None:
+        """Re-arm for re-admission: clears the suspend request so the
+        re-executed thunk runs instead of immediately re-parking."""
+        with self._mu:
+            self._suspend_requested = False
+            self._note_locked(RESUMED)
+        self._flight(RESUMED)
+        _count("tpu_query_resumed_total")
+
+    def park_cursor(self, stage: Optional[str] = None,
+                    partitions_done: Optional[List[int]] = None) -> None:
+        """Record WHERE the suspension unwound from (the poll site that
+        raised knows its stage and completed partitions)."""
+        with self._mu:
+            self.cursor = {"stage": stage,
+                           "partitionsDone": list(partitions_done or ())}
+
+    # -- lock-free poll surface ----------------------------------------------
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def suspend_requested(self) -> bool:
+        return self._suspend_requested
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def check(self) -> None:
+        """Raise if a lifecycle flag is set (the poll primitive)."""
+        if self._cancelled:
+            raise QueryCancelledError(self.query_id, self._cancel_reason
+                                      or "cancel")
+        if self._suspend_requested:
+            raise QuerySuspendedError(self.query_id)
+
+
+# ---------------------------------------------------------------------------
+# process-global registry: query id -> live token
+# ---------------------------------------------------------------------------
+
+_mu = named_lock("exec.lifecycle._mu")
+_tokens: Dict[str, CancelToken] = {}
+#: transitions of recently finished queries (bounded): the query-log
+#: record is built AFTER the collect path unregisters, and a late peer
+#: META poll may still ask "is qid cancelled?" after local teardown
+_retired: "collections.OrderedDict[str, List[Dict[str, Any]]]" = \
+    collections.OrderedDict()
+_RETIRED_CAP = 128
+#: query ids cancelled in THIS process, retained past unregistration so
+#: the shuffle META reply keeps answering peers that poll late
+_cancelled_qids: "collections.OrderedDict[str, str]" = \
+    collections.OrderedDict()
+
+
+def register(ctx, token: Optional[CancelToken] = None) -> CancelToken:
+    """Adopt (or mint) the cancel token for a freshly minted query
+    context and index it by query id. Collect paths call this right
+    after the context mint; the service worker pre-mints the token and
+    hands it down via ``query_context.cancel_token_scope`` so the ticket
+    and the execution share one token."""
+    tok = token if token is not None else \
+        getattr(ctx, "cancel_token", None)
+    if tok is None:
+        tok = CancelToken(ctx.query_id)
+    tok.query_id = ctx.query_id
+    ctx.cancel_token = tok
+    with _mu:
+        _tokens[ctx.query_id] = tok
+    return tok
+
+
+def unregister(query_id: Optional[str]) -> None:
+    """End-of-query: drop the live index, retire the transition log."""
+    if not query_id:
+        return
+    with _mu:
+        tok = _tokens.pop(query_id, None)
+        if tok is not None:
+            _retired[query_id] = list(tok.transitions)
+            while len(_retired) > _RETIRED_CAP:
+                _retired.popitem(last=False)
+            if tok.cancelled:
+                _cancelled_qids[query_id] = tok._cancel_reason or "cancel"
+                while len(_cancelled_qids) > _RETIRED_CAP:
+                    _cancelled_qids.popitem(last=False)
+
+
+def token_for(query_id: Optional[str]) -> Optional[CancelToken]:
+    if not query_id:
+        return None
+    with _mu:
+        return _tokens.get(query_id)
+
+
+def cancel_query(query_id: str, reason: str = "cancel") -> bool:
+    """Cancel a running query by id (the external surface —
+    ``QueryService.cancel``, ``session.cancel_query``, the META-borne
+    peer cancellation). False when no such query is live."""
+    tok = token_for(query_id)
+    if tok is None:
+        return False
+    return tok.cancel(reason)
+
+
+def request_suspend(query_id: str, reason: str = "preempt") -> bool:
+    tok = token_for(query_id)
+    if tok is None:
+        return False
+    return tok.request_suspend(reason)
+
+
+def is_cancelled(query_id: Optional[str]) -> bool:
+    """Has ``query_id`` been cancelled in THIS process (live token OR
+    retired)? The shuffle META server stamps this into its reply so a
+    peer's poll loop learns the cancellation the way it learns
+    divergence snapshots — no new round trip."""
+    if not query_id:
+        return False
+    with _mu:
+        tok = _tokens.get(query_id)
+        if tok is not None:
+            return tok.cancelled
+        return query_id in _cancelled_qids
+
+
+def transitions_for(query_id: Optional[str]) -> List[Dict[str, Any]]:
+    """The transition log for a query (live or recently retired); empty
+    for unknown ids. The query log's ``lifecycle`` field — only
+    non-trivial logs (anything past the initial ``running``) are worth
+    recording there."""
+    if not query_id:
+        return []
+    with _mu:
+        tok = _tokens.get(query_id)
+        if tok is not None:
+            return list(tok.transitions)
+        return list(_retired.get(query_id, ()))
+
+
+def live_queries() -> List[str]:
+    with _mu:
+        return sorted(_tokens)
+
+
+# ---------------------------------------------------------------------------
+# the ambient poll
+# ---------------------------------------------------------------------------
+
+def check_cancel() -> None:
+    """THE cooperative poll: resolve the ambient query's token and raise
+    if cancellation/suspension is pending. Called at every long-running
+    loop boundary (lint rule ``cancel-point`` enforces the set). Cheap
+    on the happy path: one TLS read plus two attribute reads; the fault
+    points and the deadline comparison only run when a token exists.
+
+    Side effects, in order:
+
+    * ``cancel.inject`` / ``preempt.inject`` chaos points fire here —
+      deterministic lifecycle testing without a second thread racing the
+      poll;
+    * a lapsed admission deadline cancels the query (reason
+      ``deadline``) — running queries now honor the deadline at stage
+      boundaries, not only at admission;
+    * the token's flags raise :class:`QueryCancelledError` /
+      :class:`QuerySuspendedError`.
+    """
+    from . import query_context as qc
+    ctx = qc.current()
+    tok: Optional[CancelToken] = getattr(ctx, "cancel_token", None) \
+        if ctx is not None else None
+    if tok is None:
+        return
+    from ..analysis import faults
+    if faults.fire("cancel.inject"):
+        tok.cancel("cancel.inject")
+    if faults.fire("preempt.inject"):
+        tok.request_suspend("preempt.inject")
+    if not tok.cancelled:
+        ddl = qc.current_deadline_at()
+        if ddl is not None and time.perf_counter() > ddl:
+            tok.cancel("deadline")
+    tok.check()
+
+
+def interruptible_sleep(seconds: float, slice_s: float = 0.05) -> None:
+    """``time.sleep`` that polls :func:`check_cancel` every ``slice_s``:
+    backoff dwells (stage-retry sleeps, fetch-poll delays) must not keep
+    a cancelled query alive for the full dwell."""
+    check_cancel()
+    deadline = time.monotonic() + max(0.0, seconds)
+    while True:  # lint: cancel-ok polls check_cancel every slice by construction
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        time.sleep(min(slice_s, remaining))
+        check_cancel()
+
+
+def _count(name: str) -> None:
+    """Bump a lifecycle counter, tenant-labelled when ambient (the
+    telemetry surface is declared in TELEMETRY_KEYS; never raises)."""
+    try:
+        from . import query_context as qc
+        from ..service.telemetry import MetricsRegistry
+        tenant = qc.current_tenant()
+        MetricsRegistry.get().counter(
+            name, "query lifecycle transitions",
+            **({"tenant": tenant} if tenant else {})).inc()
+    except Exception:
+        pass

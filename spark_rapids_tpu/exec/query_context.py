@@ -93,6 +93,13 @@ class QueryContext:
         #: True while this query drains through a streaming collect
         #: (``DataFrame.collect_iter`` sets it on the minted context)
         self.streaming: bool = bool(getattr(_tls, "streaming", False))
+        #: the cooperative lifecycle token (exec/lifecycle.py). The
+        #: service worker pre-mints one per ticket and installs it via
+        #: :class:`cancel_token_scope` before the thunk collects, so the
+        #: ticket and the execution share one token; direct collects
+        #: get a fresh token at ``lifecycle.register`` time. None only
+        #: for contexts that never reach a collect path.
+        self.cancel_token = getattr(_tls, "cancel_token", None)
         self._stage_seq = itertools.count(1)
 
     def next_stage_id(self) -> int:
@@ -230,6 +237,28 @@ class deadline_scope:
     def __exit__(self, *exc) -> bool:
         if self.deadline_at is not None:
             _tls.deadline_at = self._prev
+        return False
+
+
+class cancel_token_scope:
+    """TLS cancel-token hint for THIS thread (the :class:`deadline_scope`
+    shape): the query minted while the scope is open adopts ``token`` as
+    its lifecycle token, which is how ``QueryService.cancel/suspend``
+    reach an execution they admitted — the ticket holds the same token
+    the collect registers. ``None`` is a no-op."""
+
+    def __init__(self, token):
+        self.token = token
+
+    def __enter__(self):
+        if self.token is not None:
+            self._prev = getattr(_tls, "cancel_token", None)  # lint: unguarded-ok worker thread's own TLS field
+            _tls.cancel_token = self.token
+        return self.token
+
+    def __exit__(self, *exc) -> bool:
+        if self.token is not None:
+            _tls.cancel_token = self._prev
         return False
 
 

@@ -12,6 +12,12 @@ Taxonomy -> action (:func:`classify`):
 =========================  =============  ====================================
 error                      action         rationale
 =========================  =============  ====================================
+QueryCancelledError        FAIL_QUERY     the caller asked this exact
+                                          execution to stop (or its deadline
+                                          lapsed); retrying would resurrect it
+QuerySuspendedError        FAIL_QUERY     only the service worker loop may
+                                          park a suspension; anywhere else it
+                                          escaped its scheduler — fail loudly
 ShuffleDesyncError         FAIL_QUERY     lockstep streams diverged; retrying
                                           would pair wrong data
 ShuffleProtocolError       FAIL_QUERY     peer alive but confused (version
@@ -85,7 +91,15 @@ def classify(exc: BaseException) -> RecoveryAction:
     from ..shuffle.transport import (ShuffleDesyncError, ShuffleFetchError,
                                      ShuffleProtocolError,
                                      ShuffleWorkerLostError)
+    from .lifecycle import QueryCancelledError, QuerySuspendedError
     from .spill import BufferLostError
+    if isinstance(exc, (QueryCancelledError, QuerySuspendedError)):
+        # cooperative lifecycle unwinds (exec/lifecycle.py): retrying a
+        # cancelled query would resurrect the exact execution the caller
+        # asked to stop; a suspension propagating to here escaped the
+        # service worker loop (the only legal catcher) and must fail
+        # loudly rather than spin in a retry ladder
+        return RecoveryAction.FAIL_QUERY
     if isinstance(exc, DesyncError):
         # the digest audit's typed divergence: retrying cannot un-diverge
         # lockstep streams, and the exception already carries the
@@ -353,8 +367,13 @@ class StageRetryState:
             self.sleep_backoff()
 
     def sleep_backoff(self) -> None:
+        # the dwell is a named cancel poll point: a cancelled/preempted
+        # query must unwind from the backoff, not sleep through it
+        from .lifecycle import check_cancel, interruptible_sleep
         if self._backoff > 0:
-            time.sleep(self._backoff * self.attempts)
+            interruptible_sleep(self._backoff * self.attempts)
+        else:
+            check_cancel()
 
     def succeeded(self) -> None:
         if self.attempts and self._first_failure_t is not None:

@@ -576,6 +576,43 @@ class BufferCatalog:
                 self._note_residency()
         return buf.size_bytes if buf.tier == StorageTier.DISK else 0
 
+    def pin_working_set(self, tenant: Optional[str]) -> Tuple[int, int]:
+        """Spill EVERY device-resident buffer of ``tenant`` to the host
+        tier now — the suspend path of the query lifecycle control plane
+        (docs/service.md): a preempted query's working set leaves the
+        device so the preempting query gets real HBM headroom, not just
+        a freed scheduler slot. Unlike the pressure-driven cascade this
+        is caller-initiated and unconditional for the tenant; untenanted
+        buffers (shared caches, CACHE_PRIORITY) are never victims.
+        Returns ``(buffers_moved, bytes_moved)``. The spilled buffers
+        stay registered and re-promote lazily on their next read
+        (``acquire_batch``) after resume, so resumption pays
+        re-promotion only for what it actually re-touches."""
+        if tenant is None:
+            return (0, 0)
+        moved_n = moved_bytes = 0
+        with self._mu:
+            victims = sorted(
+                (b for b in self.buffers.values()
+                 if b.tier == StorageTier.DEVICE and b.tenant == tenant),
+                key=lambda b: b.priority)
+            with lockdep.allowed_while_locked(
+                    "suspend working-set spill under the admission lock "
+                    "(the synchronous-spill discipline, docs/service.md)"):
+                for buf in victims:
+                    moved = buf.spill_to_host()
+                    if moved:
+                        self.device_bytes -= moved
+                        self._tenant_device_delta_locked(buf, -moved)
+                        self.host_bytes += moved
+                        self.spilled_device_bytes += moved
+                        moved_n += 1
+                        moved_bytes += moved
+            self._note_residency()
+            if self.host_bytes > self.host_budget:
+                self._spill_host_to_locked(self.host_budget)
+        return (moved_n, moved_bytes)
+
     def remove(self, buffer_id: int) -> None:
         with self._mu:
             buf = self.buffers.pop(buffer_id, None)

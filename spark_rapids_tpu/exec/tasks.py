@@ -28,6 +28,26 @@ def _release_semaphore() -> None:
     TpuSemaphore.get().release_if_necessary()
 
 
+def _park_on_suspend(exc: BaseException, ctx, done_pids) -> None:
+    """A partition drain unwinding on a suspension request parks its
+    stage cursor — which drain, which partitions already completed — on
+    the query's lifecycle token. The service worker loop stashes the
+    cursor with the suspended ticket; on resume the stage-retry driver's
+    re-entry (plan cache + durable shuffle outputs) makes re-running the
+    already-done partitions cheap. Never raises."""
+    try:
+        from .lifecycle import QuerySuspendedError
+        if not isinstance(exc, QuerySuspendedError):
+            return
+        token = getattr(ctx, "cancel_token", None) if ctx is not None \
+            else None
+        if token is not None:
+            token.park_cursor(stage="partition-drain",
+                              partitions_done=sorted(done_pids))
+    except Exception:
+        pass
+
+
 def _record_swallowed(name: str, exc: BaseException) -> None:
     """A worker exception that will never re-raise on the consumer side
     (early generator close, bounded-join teardown) is LOGGED and
@@ -81,10 +101,12 @@ def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
     err: List[BaseException] = []
 
     def worker() -> None:
+        from .lifecycle import check_cancel
         try:
             for it in items:
+                check_cancel()          # per-item lifecycle poll
                 res = fn(it)
-                while not stop.is_set():
+                while not stop.is_set():  # lint: cancel-ok bounded put retry; the per-item poll above covers the drain
                     try:
                         q.put(res, timeout=0.2)
                         break
@@ -95,7 +117,7 @@ def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
         except BaseException as e:          # re-raised on the consumer side
             err.append(e)
         finally:
-            while not stop.is_set():
+            while not stop.is_set():  # lint: cancel-ok teardown sentinel delivery must complete even for a cancelled query
                 try:
                     q.put(sentinel, timeout=0.2)
                     break
@@ -106,8 +128,13 @@ def prefetch_map(items: Iterable[Any], fn: Callable[[Any], T],
     t.start()
     delivered = False
     try:
+        from .lifecycle import check_cancel
         while True:
-            v = q.get()
+            try:
+                v = q.get(timeout=0.2)
+            except queue.Empty:
+                check_cancel()          # delivery-wait lifecycle poll
+                continue
             if v is sentinel:
                 if err:
                     delivered = True
@@ -147,7 +174,7 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
     # acquire (the consumer only frees slots in order)
     depth = max(1, depth, threads)
     idx_q: "queue.SimpleQueue[int]" = queue.SimpleQueue()
-    for i in range(len(items)):
+    for i in range(len(items)):  # lint: cancel-ok SimpleQueue.put is unbounded and non-blocking — work-list seeding, no dwell
         idx_q.put(i)
     results: dict = {}
     cond = threading.Condition()  # lint: raw-lock-ok per-iterator transient coordination, dies with the generator — not shared engine state
@@ -156,7 +183,8 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
     errs: List[BaseException] = []
 
     def worker() -> None:
-        while not stop.is_set():
+        from .lifecycle import check_cancel
+        while not stop.is_set():  # lint: cancel-ok body polls check_cancel per item below
             try:
                 i = idx_q.get_nowait()
             except queue.Empty:
@@ -168,11 +196,12 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
             # starve it — a real deadlock) progress is guaranteed while
             # buffered results stay bounded at `depth`.
             with cond:
-                while not stop.is_set() and i >= state["next"] + depth:
+                while not stop.is_set() and i >= state["next"] + depth:  # lint: cancel-ok a cancelled consumer sets stop in its finally, releasing this wait
                     cond.wait(0.2)
             if stop.is_set():
                 return
             try:
+                check_cancel()          # per-item lifecycle poll
                 res = fn(items[i])
             except BaseException as e:   # re-raised on the consumer side
                 with cond:
@@ -191,9 +220,11 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
         t.start()
     delivered = False
     try:
-        for i in range(len(items)):
+        from .lifecycle import check_cancel
+        for i in range(len(items)):  # lint: cancel-ok the inner delivery wait polls check_cancel
             with cond:
                 while i not in results and not errs:
+                    check_cancel()  # delivery-wait lifecycle poll
                     cond.wait(0.2)
                 if errs:
                     delivered = True     # re-raised, not swallowed
@@ -206,7 +237,7 @@ def ordered_prefetch(items: Iterable[Any], fn: Callable[[Any], T],
         stop.set()
         with cond:
             cond.notify_all()
-        for t in workers:                # bounded join on shutdown
+        for t in workers:                # lint: cancel-ok bounded teardown join; stop is already set so workers exit on their own polls
             t.join(timeout=5.0)
         # bounded-join teardown discipline: a worker that outlived its
         # join window, or an exception captured but never re-raised
@@ -244,15 +275,21 @@ def stream_partition_tasks(parts: Sequence[Any],
     from .spill import drain_deferred_finalizers
     drain_deferred_finalizers()
     from . import query_context as _qc
+    from .lifecycle import check_cancel
     _query_ctx = _qc.current()
+    done_pids: List[int] = []
 
     def task(pid_part):
         pid, part = pid_part
         try:
             from ..analysis.sync_audit import audited_region
             with _qc.thread_scope(_query_ctx), audited_region():
-                return fn(pid, part)
+                check_cancel()      # partition-drain lifecycle poll
+                out = fn(pid, part)
+                done_pids.append(pid)   # list.append is GIL-atomic
+                return out
         except BaseException as e:
+            _park_on_suspend(e, _query_ctx, done_pids)
             from ..service.telemetry import dump_on_error
             dump_on_error(e)
             raise
@@ -261,7 +298,7 @@ def stream_partition_tasks(parts: Sequence[Any],
 
     parts = list(parts)
     if len(parts) <= 1 or max_workers <= 1:
-        for i, p in enumerate(parts):
+        for i, p in enumerate(parts):  # lint: cancel-ok serial path; task() polls per partition
             yield task((i, p))
         return
     pool = ThreadPoolExecutor(max_workers=min(max_workers, len(parts)),
@@ -270,7 +307,7 @@ def stream_partition_tasks(parts: Sequence[Any],
     delivered = -1
     raised = False
     try:
-        for i, f in enumerate(futures):
+        for i, f in enumerate(futures):  # lint: cancel-ok every task polls; a cancelled task's failure re-raises from f.result()
             try:
                 res = f.result()
             except BaseException:  # the task failure re-raises here
@@ -314,7 +351,9 @@ def run_partition_tasks(parts: Sequence[Any],
     # process, pool events must attribute to their own query, not to
     # whichever query entered the process default last
     from . import query_context as _qc
+    from .lifecycle import check_cancel
     _query_ctx = _qc.current()
+    done_pids: List[int] = []
 
     def task(pid_part):
         pid, part = pid_part
@@ -326,8 +365,12 @@ def run_partition_tasks(parts: Sequence[Any],
             # implicit crossings wrap themselves in allowed_host_transfer
             from ..analysis.sync_audit import audited_region
             with _qc.thread_scope(_query_ctx), audited_region():
-                return fn(pid, part)
+                check_cancel()      # partition-drain lifecycle poll
+                out = fn(pid, part)
+                done_pids.append(pid)   # list.append is GIL-atomic
+                return out
         except BaseException as e:
+            _park_on_suspend(e, _query_ctx, done_pids)
             # post-mortem: dump the always-on flight ring for a dying
             # task body. dump_on_error never raises and marks the
             # exception, so the collect-level hook will not dump twice

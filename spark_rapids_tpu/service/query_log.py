@@ -30,6 +30,7 @@ QUERY_LOG_FIELDS: Tuple[str, ...] = (
     "faultsFired", "shufflePlanes", "hbmPeakBytes", "hbmPeakOperator",
     "drift", "operators", "hostSyncs", "recompiles", "aqe",
     "firstRowS", "compileS", "leakedBuffers", "peakDeviceBytes",
+    "lifecycle",
 )
 
 
@@ -211,6 +212,18 @@ def build_record(session, exec_plan, serving: Dict[str, Any],
     ledger = getattr(session, "_last_ledger", None) or {}
     rec["leakedBuffers"] = int(ledger.get("leakedBuffers", 0) or 0)
     rec["peakDeviceBytes"] = int(ledger.get("peakDeviceBytes", 0) or 0)
+    # lifecycle transition log (exec/lifecycle.py): only non-trivial
+    # histories are recorded — a query that just ran to completion
+    # carries no "lifecycle" noise, a cancelled/suspended/resumed one
+    # shows its full timestamped path (tools/query_report rolls the
+    # per-tenant preempted/cancelled counts up from this)
+    try:
+        from ..exec import lifecycle as _lc
+        transitions = _lc.transitions_for(query_id)
+        if len(transitions) > 1:
+            rec["lifecycle"] = transitions
+    except Exception:
+        pass
     return rec
 
 

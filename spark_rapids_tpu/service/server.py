@@ -15,13 +15,28 @@ primitives:
 * per-tenant ``slots`` bound a tenant's concurrent queries, and a
   bounded ``max_queue_depth`` load-sheds excess submissions with a typed
   :class:`AdmissionRejected` instead of queueing unboundedly;
-* the queue orders on (priority DESC, deadline, arrival) — a
-  low-priority flood cannot starve a high-priority tenant, and a query
-  whose deadline lapses in the queue fails fast with a typed
+* the queue orders on (priority DESC, deadline, arrival) under the
+  default ``service.scheduler.policy=priority`` — a low-priority flood
+  cannot starve a high-priority tenant — or by weighted deficit
+  round-robin under ``policy=wfq``, where each backlogged tenant's
+  normalized service (admitted cost / ``TenantSpec.weight``) is
+  levelled so a weight-3 tenant drains three queries for every one a
+  weight-1 tenant drains; under either policy a query whose deadline
+  lapses in the queue fails fast with a typed
   :class:`DeadlineExceededError` without ever occupying a slot;
 * per-tenant device-byte budgets are enforced by the buffer catalog
   (``exec/spill.py``) through the ambient tenant the service installs
-  around each execution (``service/tenants.tenant_scope``).
+  around each execution (``service/tenants.tenant_scope``);
+* RUNNING queries are controllable (``exec/lifecycle.py``): each
+  admitted execution carries the ticket's :class:`CancelToken`, so
+  :meth:`QueryService.cancel` unwinds a query at its next cooperative
+  poll point, :meth:`QueryService.suspend` parks it — working set
+  spilled via ``BufferCatalog.pin_working_set``, slot freed, stage
+  cursor recorded — and :meth:`QueryService.resume` re-admits it
+  through the scheduler (spilled buffers re-promote lazily). Under
+  ``policy=wfq`` with ``service.scheduler.preemption=true`` a
+  high-priority arrival that finds every worker busy preempts the
+  most-overserved strictly-lower-priority running query automatically.
 
 Every admit / reject / deadline-shed decision is flight-recorded (kind
 ``admission``) and counted in the tenant-labeled telemetry series
@@ -115,6 +130,10 @@ class QueryTicket:
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.query_id: Optional[str] = None
+        # exec.lifecycle.CancelToken, minted at first admission and kept
+        # across suspend/resume so the ticket and every (re-)execution
+        # share one lifecycle flag pair + transition log
+        self.token = None
         self._done = threading.Event()
         self._result: Any = None
         self._exc: Optional[BaseException] = None
@@ -206,13 +225,18 @@ class _TenantState:
     service condition's lock)."""
 
     def __init__(self, spec: TenantSpec, slots: int, depth: int,
-                 budget: int):
+                 budget: int, weight: float):
         self.spec = spec
         self.name = spec.name
         self.priority = int(spec.priority)
         self.slots = max(1, int(slots))
         self.max_queue_depth = max(1, int(depth))
         self.memory_budget_bytes = max(0, int(budget))
+        self.weight = max(1e-6, float(weight))
+        # wfq: normalized service admitted so far (sum of cost/weight);
+        # the deficit scheduler admits the backlogged tenant with the
+        # LOWEST value and charges the winner here
+        self.service_units = 0.0
         self.queued = 0
         self.running = 0
         self.admitted = 0
@@ -220,6 +244,9 @@ class _TenantState:
         self.completed = 0
         self.failed = 0
         self.deadline_expired = 0
+        self.preempted = 0
+        self.resumed = 0
+        self.cancelled = 0
         self.queue_wait_s_total = 0.0
         self.queue_wait_s_max = 0.0
 
@@ -252,6 +279,10 @@ class QueryService:
             conf.get(cfg.SERVICE_DEFAULT_QUEUE_DEPTH))
         self._default_budget = int(
             conf.get(cfg.SERVICE_DEFAULT_MEMORY_BYTES))
+        self._policy = str(conf.get(cfg.SERVICE_SCHEDULER_POLICY))
+        self._preempt = bool(conf.get(cfg.SERVICE_SCHEDULER_PREEMPTION))
+        self._default_weight = float(
+            conf.get(cfg.SERVICE_DEFAULT_TENANT_WEIGHT))
         if max_workers is None:
             max_workers = int(conf.get(cfg.SERVICE_MAX_CONCURRENT))
         self.max_workers = max(1, int(max_workers))
@@ -263,6 +294,10 @@ class QueryService:
         self._cond = threading.Condition(self._mu)  # lint: raw-lock-ok condition OVER the named service lock; wait/notify not expressible through NamedLock alone
         self._queue: List[QueryTicket] = []
         self._tenants: Dict[str, _TenantState] = {}
+        # admitted tickets currently executing (preemption victim scan)
+        self._running: List[QueryTicket] = []
+        # query_id -> parked ticket awaiting resume() (or cancel/close)
+        self._suspended: Dict[str, QueryTicket] = {}
         # label -> serving fingerprint key learned from completed
         # executions: the bridge from a submission (which only has the
         # label) to AQE's observed-cost table (which keys on the plan
@@ -296,10 +331,12 @@ class QueryService:
         budget = spec.memory_budget_bytes \
             if spec.memory_budget_bytes is not None else \
             self._default_budget
+        weight = spec.weight if spec.weight is not None else \
+            self._default_weight
         with self._cond:
             state = self._tenants.get(spec.name)
             if state is None:
-                state = _TenantState(spec, slots, depth, budget)
+                state = _TenantState(spec, slots, depth, budget, weight)
                 self._tenants[spec.name] = state
             else:
                 state.spec = spec
@@ -307,6 +344,9 @@ class QueryService:
                 state.slots = max(1, int(slots))
                 state.max_queue_depth = max(1, int(depth))
                 state.memory_budget_bytes = max(0, int(budget))
+                # service_units is deliberately NOT reset: re-registering
+                # must not hand a tenant a fresh fairness slate
+                state.weight = max(1e-6, float(weight))
             self._cond.notify_all()    # a raised slot bound unblocks
         tn.set_budget(spec.name, state.memory_budget_bytes)
         return state
@@ -379,6 +419,14 @@ class QueryService:
             state.queued += ticket.cost
             self._gauge("tpu_tenant_queue_depth", tenant, state.queued)
             self._cond.notify()
+            victim = self._preempt_victim_locked(ticket)
+        if victim is not None and victim.token is not None and \
+                victim.token.request_suspend(
+                    f"preempt: higher-priority arrival {label!r} "
+                    f"(tenant {tenant!r})"):
+            flight_record("admission", "preempt",
+                          {"tenant": victim.tenant, "label": victim.label,
+                           "byTenant": tenant, "byLabel": label})
         if ticket.cost > 1:
             # observed-expensive fingerprint: the extra units charged
             # against the tenant's queue bound, beyond the flat 1
@@ -478,11 +526,13 @@ class QueryService:
 
     # -- scheduling ----------------------------------------------------------
     def _pop_eligible_locked(self) -> Optional[QueryTicket]:
-        """The best queued ticket whose tenant has a free slot, by
-        (priority DESC, deadline, arrival); None when every queued
-        tenant is saturated. Deadline-lapsed tickets fail fast HERE —
-        they are removed and finished without consuming a slot. Caller
-        holds the condition's lock."""
+        """The best queued ticket whose tenant has a free slot; None
+        when every queued tenant is saturated. Deadline-lapsed tickets
+        fail fast HERE — they are removed and finished without consuming
+        a slot. Under the default ``priority`` policy "best" is
+        (priority DESC, deadline, arrival); under ``wfq`` it is deficit
+        round-robin (:meth:`_pop_wfq_locked`). Caller holds the
+        condition's lock."""
         from .telemetry import flight_record
         now = time.perf_counter()
         expired = [t for t in self._queue
@@ -498,6 +548,8 @@ class QueryService:
                            "lateS": round(now - t.deadline_at, 4)})
             t._finish(exc=DeadlineExceededError(
                 t.tenant, t.label, now - t.deadline_at))
+        if self._policy == "wfq":
+            return self._pop_wfq_locked()
         best = None
         for t in self._queue:
             if self._tenants[t.tenant].running >= \
@@ -509,8 +561,70 @@ class QueryService:
             self._queue.remove(best)
         return best
 
+    def _pop_wfq_locked(self) -> Optional[QueryTicket]:
+        """Weighted deficit round-robin: admit from the eligible tenant
+        whose normalized service (sum of admitted cost / weight) is
+        LOWEST, so backlogged tenants drain in proportion to their
+        weights instead of strictly by priority; within a tenant the
+        (priority DESC, deadline, arrival) order still picks the ticket.
+        A tenant idle long enough to fall below the busy floor re-enters
+        AT the floor — idleness banks no burst credit. Charges the
+        winner's service counter; caller holds the condition's lock."""
+        active = [st for st in self._tenants.values()
+                  if st.queued > 0 or st.running > 0]
+        floor = min((st.service_units for st in active), default=0.0)
+        best = None
+        best_key = None
+        for t in self._queue:
+            st = self._tenants[t.tenant]
+            if st.running >= st.slots:
+                continue
+            key = (max(st.service_units, floor),) + t.sort_key
+            if best is None or key < best_key:
+                best, best_key = t, key
+        if best is not None:
+            self._queue.remove(best)
+            st = self._tenants[best.tenant]
+            st.service_units = max(st.service_units, floor) + \
+                best.cost / st.weight
+        return best
+
+    def _preempt_victim_locked(self, ticket: QueryTicket) \
+            -> Optional[QueryTicket]:
+        """Preemption candidate for a fresh arrival, or None: under
+        ``wfq`` with ``service.scheduler.preemption`` on, an arrival
+        that finds EVERY worker busy may suspend a strictly-lower-
+        priority running query — the one whose tenant sits furthest
+        above the busy floor (largest deficit, i.e. most overserved);
+        ties prefer the lower-priority, later-admitted query. Caller
+        holds the condition's lock; the suspend request itself is sent
+        OUTSIDE it (the token lock and telemetry must not nest under
+        the service lock on the submit path)."""
+        if self._policy != "wfq" or not self._preempt:
+            return None
+        if sum(st.running for st in self._tenants.values()) < \
+                self.max_workers:
+            return None            # a free worker will pick it up
+        active = [st for st in self._tenants.values()
+                  if st.queued > 0 or st.running > 0]
+        floor = min((st.service_units for st in active), default=0.0)
+        victim = None
+        victim_key = None
+        for rt in self._running:
+            if rt.priority >= ticket.priority or rt.token is None:
+                continue
+            if rt.token.cancelled or rt.token.suspend_requested:
+                continue           # already unwinding
+            st = self._tenants[rt.tenant]
+            key = (st.service_units - floor, -rt.priority, rt.seq)
+            if victim is None or key > victim_key:
+                victim, victim_key = rt, key
+        return victim
+
     def _worker_loop(self) -> None:
         from .telemetry import MetricsRegistry, flight_record
+        from ..exec import lifecycle as lc
+        from ..exec import query_context as qc
         while True:
             with self._cond:
                 ticket = None
@@ -525,6 +639,12 @@ class QueryService:
                 state.queued -= ticket.cost
                 state.running += 1
                 state.admitted += 1
+                if ticket.token is None:
+                    # minted at FIRST admission (not at submit, so a
+                    # shed ticket never allocates one); a resumed ticket
+                    # keeps its original token and transition log
+                    ticket.token = lc.CancelToken()
+                self._running.append(ticket)
                 ticket.started_at = time.perf_counter()
                 wait = ticket.queue_wait_s()
                 state.queue_wait_s_total += wait
@@ -542,19 +662,22 @@ class QueryService:
             flight_record("admission", "admit",
                           {"tenant": ticket.tenant, "label": ticket.label,
                            "queueWaitS": round(wait, 4)})
+            ok = suspended = cancelled = False
             try:
-                from ..exec import query_context as qc
                 # cleared before, read after: the id THIS thread's thunk
                 # executed (a result-cache hit executes nothing -> None);
                 # session._last_query_id is last-writer-wins and must
                 # not be joined to a ticket
                 qc.note_thread_query_id(None)
-                # the deadline rides the worker's TLS into the minted
-                # QueryContext, so the async compile pool can route cold
-                # stage builds off the query thread when the remaining
-                # slack cannot absorb a build (exec/compile_pool.py)
+                # the deadline AND the lifecycle token ride the worker's
+                # TLS into the minted QueryContext: the async compile
+                # pool can route cold stage builds off the query thread
+                # (exec/compile_pool.py), and cancel/suspend by query id
+                # reach the execution through the ticket's token
+                # (exec/lifecycle.py)
                 with tenant_scope(ticket.tenant), \
-                        qc.deadline_scope(ticket.deadline_at):
+                        qc.deadline_scope(ticket.deadline_at), \
+                        qc.cancel_token_scope(ticket.token):
                     out = ticket.thunk()
                 ticket.query_id = qc.thread_last_query_id()
                 try:
@@ -569,17 +692,131 @@ class QueryService:
                     pass
                 ticket._finish(result=out)
                 ok = True
+            except lc.QuerySuspendedError:
+                # NOT a failure: park the ticket without finishing it —
+                # result() keeps blocking until the resumed re-execution
+                # completes (or cancel/close fails it)
+                suspended = True
+                ticket.query_id = qc.thread_last_query_id() or \
+                    ticket.query_id
+                self._park_suspended(ticket)
             except BaseException as e:      # typed failure rides the ticket
+                ticket.query_id = qc.thread_last_query_id() or \
+                    ticket.query_id
+                cancelled = isinstance(e, lc.QueryCancelledError)
                 ticket._finish(exc=e)
-                ok = False
             finally:
                 with self._cond:
+                    try:
+                        self._running.remove(ticket)
+                    except ValueError:
+                        pass
                     state.running -= 1
-                    if ok:
+                    if suspended:
+                        pass       # neither completed nor failed yet
+                    elif ok:
                         state.completed += 1
                     else:
                         state.failed += 1
+                        if cancelled:
+                            state.cancelled += 1
                     self._cond.notify_all()
+
+    def _park_suspended(self, ticket: QueryTicket) -> None:
+        """Suspend bookkeeping, OUTSIDE the service lock: spill the
+        tenant's device working set (resume re-promotes lazily through
+        the catalog's normal acquire path), mark the token suspended
+        (the poll site that unwound already parked its stage cursor),
+        and index the ticket by query id for :meth:`resume`."""
+        from .telemetry import flight_record
+        moved_n = moved_bytes = 0
+        try:
+            from ..exec.spill import BufferCatalog
+            cat = BufferCatalog.peek()
+            if cat is not None:
+                moved_n, moved_bytes = cat.pin_working_set(ticket.tenant)
+        except Exception:
+            pass    # spill-to-park is best-effort; budgets still enforce
+        tok = ticket.token
+        if tok is not None:
+            tok.mark_suspended()
+        key = ticket.query_id or f"seq-{ticket.seq}"
+        with self._cond:
+            self._suspended[key] = ticket
+            st = self._tenants.get(ticket.tenant)
+            if st is not None:
+                st.preempted += 1
+        flight_record("lifecycle", "service-suspend",
+                      {"tenant": ticket.tenant, "label": ticket.label,
+                       "queryId": ticket.query_id,
+                       "spilledBuffers": moved_n,
+                       "spilledBytes": moved_bytes,
+                       "cursor": tok.cursor if tok is not None else None})
+
+    # -- query lifecycle ops -------------------------------------------------
+    def cancel(self, query_id: str, reason: str = "cancel") -> bool:
+        """Cancel a query this service is RUNNING or has SUSPENDED.
+        Running: the cooperative flag is set and the query unwinds with
+        a typed ``QueryCancelledError`` at its next poll point (never a
+        thread kill). Suspended: the parked ticket fails immediately —
+        nothing is executing. False when the id is unknown (finished,
+        shed, or never this service's)."""
+        from ..exec import lifecycle as lc
+        with self._cond:
+            ticket = self._suspended.pop(query_id, None)
+        if ticket is not None:
+            if ticket.token is not None:
+                ticket.token.cancel(reason)
+            ticket._finish(exc=lc.QueryCancelledError(query_id, reason))
+            with self._cond:
+                st = self._tenants.get(ticket.tenant)
+                if st is not None:
+                    st.failed += 1
+                    st.cancelled += 1
+                self._cond.notify_all()
+            return True
+        return lc.cancel_query(query_id, reason)
+
+    def suspend(self, query_id: str, reason: str = "operator") -> bool:
+        """Ask a RUNNING query to park at its next poll point; the
+        worker loop then spills its working set, frees the slot and
+        holds the ticket for :meth:`resume`. False when no such query
+        is live."""
+        from ..exec import lifecycle as lc
+        return lc.request_suspend(query_id, reason)
+
+    def resume(self, query_id: str) -> QueryTicket:
+        """Re-admit a suspended query: clears its suspend flag and
+        re-queues the ticket through the normal scheduler (same
+        priority/deadline/token; spilled buffers re-promote lazily as
+        the re-execution touches them). Raises ``KeyError`` for ids not
+        parked here."""
+        from .telemetry import flight_record
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed("service is closed")
+            ticket = self._suspended.pop(query_id, None)
+        if ticket is None:
+            raise KeyError(f"no suspended query {query_id!r}")
+        if ticket.token is not None:
+            ticket.token.resume()
+        state = self._state(ticket.tenant)
+        with self._cond:
+            self._queue.append(ticket)
+            state.queued += ticket.cost
+            state.resumed += 1
+            self._gauge("tpu_tenant_queue_depth", ticket.tenant,
+                        state.queued)
+            self._cond.notify()
+        flight_record("lifecycle", "service-resume",
+                      {"tenant": ticket.tenant, "label": ticket.label,
+                       "queryId": query_id})
+        return ticket
+
+    def suspended_queries(self) -> List[str]:
+        """Query ids currently parked awaiting :meth:`resume`."""
+        with self._cond:
+            return sorted(self._suspended)
 
     # -- observability -------------------------------------------------------
     @staticmethod
@@ -610,7 +847,8 @@ class QueryService:
         from ..exec.spill import BufferCatalog
         cat = BufferCatalog.peek()
         dev = cat.tenant_device_bytes() if cat is not None else {}
-        out: Dict[str, Any] = {"tenants": {}, "queued": 0, "running": 0}
+        out: Dict[str, Any] = {"tenants": {}, "queued": 0, "running": 0,
+                               "suspended": 0, "policy": self._policy}
         sem = TpuSemaphore.peek()
         if sem is not None:
             # the layer BELOW the service (docs/service.md §1): how many
@@ -632,6 +870,11 @@ class QueryService:
                     "completed": st.completed,
                     "failed": st.failed,
                     "deadlineExpired": st.deadline_expired,
+                    "weight": st.weight,
+                    "serviceUnits": round(st.service_units, 4),
+                    "preempted": st.preempted,
+                    "resumed": st.resumed,
+                    "cancelled": st.cancelled,
                     "queueWaitAvgS": round(
                         st.queue_wait_s_total / done, 4) if done else 0.0,
                     "queueWaitMaxS": round(st.queue_wait_s_max, 4),
@@ -639,6 +882,7 @@ class QueryService:
                 }
                 out["queued"] += st.queued
                 out["running"] += st.running
+            out["suspended"] = len(self._suspended)
         return out
 
     # -- lifecycle -----------------------------------------------------------
@@ -651,6 +895,8 @@ class QueryService:
                 return
             self._closed = True
             pending, self._queue = self._queue, []
+            parked = list(self._suspended.values())
+            self._suspended.clear()
             for t in pending:
                 st = self._tenants.get(t.tenant)
                 if st is not None:
@@ -659,6 +905,11 @@ class QueryService:
                                 st.queued)
                 t._finish(exc=ServiceClosed(
                     f"service closed before {t.label!r} ran"))
+            for t in parked:
+                # a suspended ticket consumes no queue-depth units; it
+                # just fails typed instead of blocking result() forever
+                t._finish(exc=ServiceClosed(
+                    f"service closed while {t.label!r} was suspended"))
             self._cond.notify_all()
         deadline = time.monotonic() + max(0.0, timeout_s)
         for w in self._workers:

@@ -151,6 +151,10 @@ TELEMETRY_KEYS: Tuple[str, ...] = (
     # buffer-lifecycle ledger (analysis/ledger.py, docs/analysis.md §7)
     "tpu_buffer_leaks_total",           # end-of-query residency leaks
     "tpu_use_after_free_total",         # UAF + use-after-donate + dbl-free
+    # query lifecycle control (exec/lifecycle.py, docs/service.md §4)
+    "tpu_query_cancelled_total",        # counter, label tenant when ambient
+    "tpu_query_preempted_total",        # suspensions parked by the service
+    "tpu_query_resumed_total",          # suspended queries re-admitted
 )
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,

@@ -44,7 +44,11 @@ class TenantSpec:
     queued (not yet running) queries beyond this are load-shed with a
     typed ``AdmissionRejected``. ``memory_budget_bytes``: device bytes
     this tenant may hold before its own buffers become the first spill
-    victims; 0 = unbudgeted. ``None`` fields fall back to the
+    victims; 0 = unbudgeted. ``weight``: the tenant's share under the
+    weighted-fair scheduler (``service.scheduler.policy=wfq``,
+    docs/service.md §4) — a weight-3 tenant is credited three times the
+    deficit of a weight-1 tenant per scheduling round; ignored under the
+    strict-priority policy. ``None`` fields fall back to the
     ``service.*`` conf defaults at registration."""
 
     name: str
@@ -52,6 +56,7 @@ class TenantSpec:
     slots: Optional[int] = None
     max_queue_depth: Optional[int] = None
     memory_budget_bytes: Optional[int] = None
+    weight: Optional[float] = None
 
 
 # ---------------------------------------------------------------------------

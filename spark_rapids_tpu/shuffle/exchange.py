@@ -859,8 +859,14 @@ class TpuShuffleExchangeExec(TpuExec):
         # lockstep shuffle id on THIS worker only, desyncing the id /
         # fingerprint streams from peers (each budget attempt would then
         # burn a full fetch timeout against a shuffle no peer completes).
-        # Recovery declines — the fault propagates unmasked instead of
-        # wedging (docs/resilience.md "nested-exchange maps")
+        # Query-namespaced ids (shuffle/manager.py) do NOT lift this:
+        # namespacing fixes id COLLISION across queries, not lockstep
+        # AGREEMENT within one — the retried child exchange is a
+        # distributed barrier that peers (who saw no failure) never
+        # re-enter, so one worker re-running it alone can never complete
+        # it under any namespace. Recovery stays declined — the fault
+        # propagates unmasked instead of wedging (docs/resilience.md
+        # "nested-exchange maps")
         nested = self._subtree_allocates_shuffle_ids(self.children[0])
 
         def gate(exc):
@@ -963,7 +969,9 @@ class TpuShuffleExchangeExec(TpuExec):
 
         rs = recovery.StageRetryState(f"shuffle-reduce-p{group}",
                                       retryable=retryable)
+        from ..exec.lifecycle import check_cancel
         while True:
+            check_cancel()       # a cancelled query must not keep retrying
             try:
                 with trace_span("shuffle_fetch", self.metrics,
                                 "fetchWaitTime"):

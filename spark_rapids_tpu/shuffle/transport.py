@@ -217,7 +217,7 @@ class ShuffleStore:
         ``tpu_durable_evicted_bytes_total``."""
         if not self.durable_budget or not self.durable_dir:
             return
-        while True:
+        while True:  # lint: cancel-ok bounded by completed-shuffle count, no dwell; eviction must finish even for a cancelled query
             with self._mu:
                 if self._durable_bytes <= self.durable_budget or \
                         len(self._durable_complete_order) <= 1:
@@ -503,7 +503,7 @@ class SocketConnection(Connection):
 
     def read_exact(self, n: int) -> bytes:
         out = b""
-        while len(out) < n:
+        while len(out) < n:  # lint: cancel-ok bounded single-frame read shared by server conn threads, which have no ambient query; the fetch-level loops above it poll
             chunk = self.sock.recv(n - len(out))
             if not chunk:
                 raise ConnectionError("peer closed")
@@ -555,7 +555,7 @@ class ShuffleServer:
         return self
 
     def _accept_loop(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set():  # lint: cancel-ok server accept thread serves ALL queries; it stops with the server, not with any one query
             try:
                 self._sock.settimeout(0.2)
                 sock, _addr = self._sock.accept()
@@ -599,7 +599,7 @@ class ShuffleServer:
             return
         reader = FrameReader(conn.read_exact)
         try:
-            while True:
+            while True:  # lint: cancel-ok server conn thread serving a PEER's fetches; it has no ambient query and exits when the peer disconnects
                 msg_type, header, _payload = reader.next_frame()
                 if msg_type == META_REQ:
                     sid = header["shuffle_id"]
@@ -650,6 +650,16 @@ class ShuffleServer:
                         div = divergence.snapshot(peer_q)
                         if div is not None:
                             resp["divergence"] = div
+                        # cross-process cancellation rides the same
+                        # round trip (exec/lifecycle.py): a query
+                        # cancelled on THIS worker stamps the reply, so
+                        # the peer's fetch/completion poll cancels its
+                        # local token instead of waiting out a full
+                        # straggler timeout against a query that will
+                        # never complete here
+                        from ..exec import lifecycle
+                        if lifecycle.is_cancelled(peer_q):
+                            resp["cancelled"] = True
                     conn.send(encode_frame(META_RESP, resp))
                 elif msg_type == XFER_REQ:
                     self._send_buffers(conn, header["buffer_ids"])
@@ -809,7 +819,9 @@ class ShuffleClient:
         deadline = time.monotonic() + timeout_s
         delay = poll_s
         last_conn_err: Optional[Exception] = None
+        from ..exec.lifecycle import check_cancel, interruptible_sleep
         while True:
+            check_cancel()          # completion-poll lifecycle boundary
             conn = None
             try:
                 # the connect itself is the most likely transient failure
@@ -837,6 +849,8 @@ class ShuffleClient:
                                      header["divergence"],
                                      peer_label=f"peer serving shuffle "
                                                 f"{shuffle_id}")
+                if msg_type == META_RESP and header.get("cancelled"):
+                    self._peer_cancelled(shuffle_id)
                 complete = msg_type == META_RESP and header.get("complete")
                 last_conn_err = None
             except (ConnectionError, OSError) as e:
@@ -859,19 +873,21 @@ class ShuffleClient:
                 raise ShuffleFetchError(
                     f"peer map phase for shuffle {shuffle_id} not complete "
                     f"after {timeout_s}s (peer alive)")
-            time.sleep(delay)
+            interruptible_sleep(delay)
             delay = min(delay * 2, 1.0)
 
     def fetch(self, shuffle_id: int, reduce_ids: List[int],
               fingerprint: Optional[str] = None) -> List[ColumnarBatch]:
         """Fetch all batches of the given reduce partitions (doFetch,
         RapidsShuffleClient.scala:480)."""
+        from ..exec.lifecycle import check_cancel, interruptible_sleep
         last_err: Optional[Exception] = None
         for attempt in range(self.max_retries + 1):
+            check_cancel()          # fetch-retry lifecycle boundary
             if attempt:
                 self.metrics["retries"] += 1
                 _note_total("retries")
-                time.sleep(self.retry_backoff_s * attempt)
+                interruptible_sleep(self.retry_backoff_s * attempt)
             try:
                 return self._fetch_once(shuffle_id, reduce_ids, fingerprint)
             except ShuffleDesyncError:  # lint: recover-ok transport retry loop: a desync must escape its own retries — re-fetching diverged streams pairs wrong data
@@ -895,6 +911,21 @@ class ShuffleClient:
         finally:
             if conn is not None:
                 conn.close()
+
+    @staticmethod
+    def _peer_cancelled(shuffle_id: int) -> None:
+        """The peer's META reply carried ``cancelled``: the query was
+        cancelled on the serving worker. Cancel the LOCAL token (so every
+        other loop of this query unwinds at its next poll, symmetric with
+        how divergence snapshots propagate) and raise the typed error —
+        FAIL_QUERY, never absorbed by fetch retries."""
+        from ..exec import lifecycle
+        qid = _current_query_id()
+        reason = f"peer-cancelled (shuffle {shuffle_id})"
+        tok = lifecycle.token_for(qid)
+        if tok is not None:
+            tok.cancel(reason)
+        raise lifecycle.QueryCancelledError(qid, reason)
 
     @staticmethod
     def _raise_protocol_error(shuffle_id: int, header: Dict) -> None:
@@ -934,6 +965,8 @@ class ShuffleClient:
                                  header["divergence"],
                                  peer_label=f"peer serving shuffle "
                                             f"{shuffle_id}")
+            if header.get("cancelled"):
+                self._peer_cancelled(shuffle_id)
             metas = [BufferDesc.from_json(d) for d in header["buffers"]]
 
             # pending transfer queue with inflight-byte throttling
@@ -947,7 +980,7 @@ class ShuffleClient:
             def issue():
                 nonlocal inflight_bytes
                 batch_ids = []
-                while pending and (
+                while pending and (  # lint: cancel-ok non-blocking drain of the local pending list into the inflight window
                         not inflight or
                         inflight_bytes + pending[0].total_bytes
                         <= self.max_inflight_bytes):
@@ -960,7 +993,10 @@ class ShuffleClient:
                                            {"buffer_ids": batch_ids}))
 
             issue()
+            from ..exec.lifecycle import check_cancel
             while inflight or pending:
+                check_cancel()    # per-frame poll: a multi-chunk transfer
+                # must not pin a cancelled query for its full duration
                 msg_type, header, payload = reader.next_frame()
                 if msg_type == ERROR:
                     # mid-transfer ERROR (e.g. a buffer freed between the

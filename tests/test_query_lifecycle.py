@@ -3,6 +3,12 @@ propagation, stage-boundary exchange statistics on all three shuffle
 planes, estimate-vs-actual drift, the structured query log + report CLI,
 the merged multi-worker timeline, the flight-dump query filter, and the
 durable-tier GC budget.
+
+Plus query lifecycle CONTROL (ISSUE 20, docs/service.md §4a): the
+CancelToken state machine, deterministic mid-execution cancel and
+suspend/resume via the chaos points, deadline enforcement at poll
+boundaries, weighted-fair scheduling, and two-OS-process cancel
+propagation over the shuffle META round trip.
 """
 
 import json
@@ -594,3 +600,343 @@ def test_two_process_merged_timeline_and_query_log(tmp_path):
                                         d1[sid]["rows"])]
         assert summed == local[sid]["rows"], (sid, summed,
                                               local[sid]["rows"])
+
+
+# ---------------------------------------------------------------------------
+# Query lifecycle control (ISSUE 20): cancel, suspend/resume, preemption
+# ---------------------------------------------------------------------------
+
+def test_cancel_token_state_machine():
+    """Unit transitions of the CancelToken: idempotent cancel with
+    first-reason-wins, suspend/resume re-arming, check() raising, and
+    the append-only transition log."""
+    import pytest
+    from spark_rapids_tpu.exec import lifecycle as lc
+
+    tok = lc.CancelToken("q-unit")
+    assert tok.state == lc.RUNNING
+    assert not tok.cancelled and not tok.suspend_requested
+    tok.check()                                    # clean: no raise
+
+    assert tok.request_suspend("preempt") is True
+    assert tok.request_suspend("again") is False   # already requested
+    with pytest.raises(lc.QuerySuspendedError):
+        tok.check()
+    tok.park_cursor(stage="stage-1", partitions_done=[0, 2])
+    tok.mark_suspended()
+    assert tok.state == lc.SUSPENDED
+    assert tok.cursor == {"stage": "stage-1", "partitionsDone": [0, 2]}
+
+    tok.resume()
+    assert tok.state == lc.RESUMED and not tok.suspend_requested
+    tok.check()                                    # resumed: clean again
+
+    assert tok.cancel("user-request") is True
+    assert tok.cancel("too-late") is False         # idempotent
+    with pytest.raises(lc.QueryCancelledError) as ei:
+        tok.check()
+    assert ei.value.reason == "user-request"       # first reason wins
+    assert tok.request_suspend() is False          # cancelled is terminal
+
+    assert [t["state"] for t in tok.transitions] == [
+        lc.RUNNING, lc.SUSPEND_REQUESTED, lc.SUSPENDED, lc.RESUMED,
+        lc.CANCELLED]
+
+
+def test_cancel_inject_fails_query_and_plan_cache_survives():
+    """A deterministic mid-execution cancel (chaos point cancel.inject)
+    raises the typed QueryCancelledError, unregisters the query, records
+    the transition for post-mortems — and the plan cache still serves
+    the identical query correctly afterwards. Runs under
+    bufferLedger=enforce, so a leaked buffer on the cancel unwind path
+    would raise instead of passing."""
+    import pytest
+    from spark_rapids_tpu.analysis import faults
+    from spark_rapids_tpu.exec import lifecycle as lc
+
+    s = _session(**{
+        "spark.rapids.tpu.sql.shuffle.partitions": "4",
+        "spark.rapids.tpu.sql.analysis.bufferLedger": "enforce"})
+    ks = [i % 7 for i in range(300)]
+    vs = [float(i % 13) for i in range(300)]
+    s.createDataFrame({"k": ks, "v": vs}).createOrReplaceTempView("lct")
+    sql = "SELECT k, sum(v) AS sv FROM lct GROUP BY k ORDER BY k"
+    oracle = s.sql(sql).collect()
+
+    faults.install("cancel.inject")
+    try:
+        with pytest.raises(lc.QueryCancelledError) as ei:
+            s.sql(sql).collect()
+    finally:
+        faults.reset()
+    assert ei.value.reason == "cancel.inject"
+    qid = ei.value.query_id
+    assert qid and qid not in lc.live_queries()    # unregistered
+    states = [t["state"] for t in lc.transitions_for(qid)]
+    assert lc.CANCELLED in states                  # retired log kept
+
+    # the plan is not poisoned: the same text serves again, correctly
+    assert s.sql(sql).collect() == oracle
+
+
+def test_deadline_lapse_cancels_mid_execution():
+    """Satellite 1: a lapsed deadline now fires DURING execution through
+    the cooperative poll (reason "deadline"), not only while queued."""
+    import time as _time
+
+    import pytest
+    from spark_rapids_tpu.exec import lifecycle as lc
+    from spark_rapids_tpu.exec import query_context as qc
+
+    s = _session(**{"spark.rapids.tpu.sql.shuffle.partitions": "4"})
+    ks = [i % 5 for i in range(200)]
+    s.createDataFrame({"k": ks, "v": [float(i) for i in range(200)]}) \
+        .createOrReplaceTempView("ddt")
+    with qc.deadline_scope(_time.perf_counter() - 0.001):   # lapsed
+        with pytest.raises(lc.QueryCancelledError) as ei:
+            s.sql("SELECT k, sum(v) AS sv FROM ddt GROUP BY k").collect()
+    assert ei.value.reason == "deadline"
+
+
+def test_preempt_inject_parks_and_resume_is_oracle_identical():
+    """Satellite 4 core: a deterministic suspension (preempt.inject)
+    mid-execution parks the ticket WITHOUT failing it; the service
+    counts the preemption, resume() re-admits it through the scheduler,
+    and the result is oracle-identical — under bufferLedger=enforce +
+    lockdep=enforce."""
+    import time as _time
+
+    from spark_rapids_tpu.analysis import faults
+    from spark_rapids_tpu.service.server import QueryService, TenantSpec
+
+    s = _session(**{
+        "spark.rapids.tpu.sql.shuffle.partitions": "4",
+        "spark.rapids.tpu.sql.analysis.lockdep": "enforce",
+        "spark.rapids.tpu.sql.analysis.bufferLedger": "enforce"})
+    ks = [i % 7 for i in range(300)]
+    vs = [float(i % 11) for i in range(300)]
+    s.createDataFrame({"k": ks, "v": vs}).createOrReplaceTempView("ppt")
+    sql = "SELECT k, sum(v) AS sv FROM ppt GROUP BY k ORDER BY k"
+    oracle = s.sql(sql).collect()
+
+    svc = QueryService(s, max_workers=1,
+                       tenants=[TenantSpec("t", priority=1)])
+    faults.install("preempt.inject")
+    try:
+        ticket = svc.submit("t", sql, label="preempt-me")
+        deadline = _time.time() + 20
+        while _time.time() < deadline and not svc.suspended_queries():
+            _time.sleep(0.01)
+        parked = svc.suspended_queries()
+        assert parked, "query never parked on the injected suspension"
+        assert svc.stats()["tenants"]["t"]["preempted"] == 1
+        assert not ticket.done()
+
+        resumed = svc.resume(parked[0])
+        assert resumed is ticket
+        rows = ticket.result(timeout=120).rows()
+        assert rows == oracle
+        st = svc.stats()["tenants"]["t"]
+        assert st["resumed"] == 1 and st["completed"] == 1
+        assert svc.suspended_queries() == []
+    finally:
+        faults.reset()
+        svc.close()
+
+
+def test_wfq_weighted_share_and_no_starvation():
+    """Weighted-fair scheduling: with equal priorities and one worker
+    slot, a weight-4 tenant is served ~4x as often as a weight-1 tenant
+    early on, and the light tenant is never starved."""
+    import threading
+    import time as _time
+
+    from spark_rapids_tpu.service.server import QueryService, TenantSpec
+
+    s = _session(**{
+        "spark.rapids.tpu.sql.service.scheduler.policy": "wfq"})
+    svc = QueryService(s, max_workers=1, tenants=[
+        TenantSpec("blk", priority=0, slots=1),
+        TenantSpec("a", priority=0, slots=1, weight=4.0),
+        TenantSpec("b", priority=0, slots=1, weight=1.0)])
+    order = []
+    mu = threading.Lock()
+    gate = threading.Event()
+
+    def mk(name):
+        def run():
+            with mu:
+                order.append(name)
+            return name
+        return run
+
+    try:
+        blocker = svc.submit("blk", lambda: gate.wait(30))
+        deadline = _time.time() + 10
+        while _time.time() < deadline and svc.stats()["running"] < 1:
+            _time.sleep(0.005)
+        tickets = []
+        for _ in range(5):               # interleaved arrivals
+            tickets.append(svc.submit("a", mk("a")))
+            tickets.append(svc.submit("b", mk("b")))
+        gate.set()
+        for t in tickets:
+            t.result(timeout=60)
+        blocker.result(timeout=60)
+        # weight 4 vs 1: the heavy tenant dominates the early pops...
+        assert order[:6].count("a") >= 4, order
+        # ...but the light tenant still gets its full share served
+        assert order.count("a") == 5 and order.count("b") == 5, order
+        stats = svc.stats()
+        assert stats["policy"] == "wfq"
+        # normalized service: a's 5 pops at cost/4 vs b's 5 at cost/1
+        assert stats["tenants"]["a"]["serviceUnits"] < \
+            stats["tenants"]["b"]["serviceUnits"]
+    finally:
+        gate.set()
+        svc.close()
+
+
+_CANCEL_WORKER = """
+import sys, json, os
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("SPARK_RAPIDS_TPU_COMPILE_CACHE", "off")
+from spark_rapids_tpu.shuffle.manager import init_worker
+
+wid = int(sys.argv[1]); n = int(sys.argv[2])
+ctx = init_worker(wid, n)
+print(json.dumps({{"port": ctx.port}}), flush=True)
+peers = json.loads(sys.stdin.readline())
+ctx.set_peers({{int(k): tuple(v) for k, v in peers.items()}})
+
+from spark_rapids_tpu.api.session import TpuSession
+
+s = TpuSession.builder.config({{
+    "spark.rapids.tpu.sql.explain": "NONE",
+    "spark.rapids.tpu.sql.shuffle.partitions": "4",
+    "spark.rapids.tpu.sql.recovery.retryBackoff": "0.0",
+}}).getOrCreate()
+
+base = wid * 1000
+ks = [(base + i) % 7 for i in range(200)]
+vs = [float(i % 13) for i in range(200)]
+s.createDataFrame({{"k": ks, "v": vs}}).createOrReplaceTempView("t")
+
+if wid == 0:
+    # worker 0's query cancels at its FIRST poll; worker 1 only learns
+    # about it from the cancelled stamp on worker 0's META reply
+    from spark_rapids_tpu.analysis import faults
+    faults.install("cancel.inject")
+
+err = None
+try:
+    s.sql("SELECT k, sum(v) AS sv FROM t GROUP BY k").collect()
+except Exception as e:
+    err = [type(e).__name__, str(e)]
+
+from spark_rapids_tpu.exec.spill import BufferCatalog
+cat = BufferCatalog.peek()
+dev = sum((cat.tenant_device_bytes() or {{}}).values()) if cat else 0
+print(json.dumps({{"err": err, "tenantDeviceBytes": dev}}), flush=True)
+sys.stdin.readline()     # stay alive to serve the peer's META polls
+ctx.shutdown()
+"""
+
+
+def test_two_process_cancel_propagates_over_meta(tmp_path):
+    """Distributed cancellation: worker 0 cancels locally
+    (cancel.inject); worker 1, blocked fetching worker 0's outputs,
+    sees the cancelled stamp on the META reply and cancels its OWN
+    token — both workers fail with the typed QueryCancelledError, no
+    fetch-timeout wedge, and tenant device bytes are zero on both."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    procs = []
+    for wid in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CANCEL_WORKER.format(repo=_REPO),
+             str(wid), "2"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, text=True))
+    results = {}
+    try:
+        ports = {}
+        for wid, p in enumerate(procs):
+            line = p.stdout.readline()
+            assert line, p.stderr.read()
+            ports[wid] = ("127.0.0.1", json.loads(line)["port"])
+        peers = json.dumps({str(w): list(a) for w, a in ports.items()})
+        for p in procs:
+            p.stdin.write(peers + "\n")
+            p.stdin.flush()
+        for wid, p in enumerate(procs):
+            line = p.stdout.readline()
+            assert line, p.stderr.read()
+            results[wid] = json.loads(line)
+        for p in procs:            # release the stay-alive gate
+            p.stdin.write("done\n")
+            p.stdin.flush()
+        for p in procs:
+            p.communicate(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    assert results[0]["err"] is not None, results
+    assert results[0]["err"][0] == "QueryCancelledError", results[0]
+    assert "cancel.inject" in results[0]["err"][1]
+    assert results[1]["err"] is not None, results
+    assert results[1]["err"][0] == "QueryCancelledError", results[1]
+    assert "peer-cancelled" in results[1]["err"][1], results[1]
+    for wid in (0, 1):
+        assert results[wid]["tenantDeviceBytes"] == 0
+
+
+def test_query_log_records_lifecycle_transitions(tmp_path):
+    """Satellite 5: a suspended-then-resumed query's log record carries
+    the full transition list in the ``lifecycle`` field; a plain query's
+    record omits the field entirely."""
+    import time as _time
+
+    from spark_rapids_tpu.analysis import faults
+    from spark_rapids_tpu.service.server import QueryService, TenantSpec
+
+    log_dir = str(tmp_path / "qlog")
+    s = _session(**{
+        "spark.rapids.tpu.sql.shuffle.partitions": "4",
+        "spark.rapids.tpu.sql.telemetry.queryLog.dir": log_dir})
+    s.createDataFrame({"k": [i % 3 for i in range(60)],
+                       "v": [float(i) for i in range(60)]}) \
+        .createOrReplaceTempView("qlt")
+    sql = "SELECT k, sum(v) AS sv FROM qlt GROUP BY k ORDER BY k"
+    s.sql(sql).collect()                       # plain: no lifecycle field
+
+    svc = QueryService(s, max_workers=1,
+                       tenants=[TenantSpec("t", priority=1)])
+    faults.install("preempt.inject")
+    try:
+        ticket = svc.submit("t", sql)
+        deadline = _time.time() + 20
+        while _time.time() < deadline and not svc.suspended_queries():
+            _time.sleep(0.01)
+        assert svc.suspended_queries()
+        svc.resume(svc.suspended_queries()[0])
+        ticket.result(timeout=120)
+    finally:
+        faults.reset()
+        svc.close()
+
+    recs = []
+    for name in os.listdir(log_dir):
+        with open(os.path.join(log_dir, name)) as f:
+            recs.extend(json.loads(l) for l in f if l.strip())
+    cycled = [r for r in recs if r.get("lifecycle")]
+    assert cycled, recs
+    states = [t["state"] for t in cycled[0]["lifecycle"]]
+    assert states[0] == "running"
+    assert "suspended" in states and "resumed" in states
+    plain = [r for r in recs if not r.get("lifecycle")]
+    assert plain                               # the direct collect

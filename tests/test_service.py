@@ -566,6 +566,30 @@ def test_tenant_rollup_counts_multiworker_query_once():
     assert "rows=23" in out
 
 
+def test_tenant_rollup_counts_lifecycle_transitions():
+    """A query with suspend/resume (or cancel) transitions in its
+    query-log ``lifecycle`` field counts ONCE per tenant, regardless of
+    cycles or worker records; plain queries add no lifecycle columns."""
+    from tools.query_report import tenant_rollup
+    cyc = [{"state": "running"}, {"state": "suspend-requested"},
+           {"state": "suspended"}, {"state": "resumed"},
+           {"state": "suspended"}, {"state": "resumed"}]
+    recs = [
+        {"tenant": "bronze", "queryId": "q1", "wallS": 1.0, "rows": 5,
+         "lifecycle": cyc},
+        {"tenant": "bronze", "queryId": "q1", "wallS": 1.0, "rows": 5,
+         "lifecycle": cyc},                       # second worker record
+        {"tenant": "bronze", "queryId": "q2", "wallS": 0.2, "rows": 0,
+         "lifecycle": [{"state": "running"}, {"state": "cancelled"}]},
+        {"tenant": "gold", "queryId": "q3", "wallS": 0.1, "rows": 1},
+    ]
+    out = tenant_rollup(recs)
+    assert "preempted=1" in out          # two cycles, one query
+    assert "cancelled=1" in out
+    gold_line = [l for l in out.splitlines() if "gold:" in l][0]
+    assert "preempted" not in gold_line and "cancelled" not in gold_line
+
+
 # ---------------------------------------------------------------------------
 # Traffic-replay bench -> history gate
 # ---------------------------------------------------------------------------
@@ -616,3 +640,33 @@ def test_replay_chaos_mode_bounded_recovery(tmp_path):
     assert line["replay_chaos_p99_s"] > 0
     rounds = bh.load(hist)
     assert set(rounds[0]["queries"]) == {bh.REPLAY_CHAOS_P99_S}
+
+
+def test_preempt_replay_end_to_end_acceptance(tmp_path):
+    """ISSUE 20 acceptance: the preemption-armed mixed-priority leg —
+    a running low-priority query is suspended by a high-priority
+    arrival which completes first; the preempted query resumes with
+    oracle-correct rows; tenant watermarks return to zero (the leg runs
+    under bufferLedger=enforce, so leaked buffers raise); and the gold
+    p99 stamps the history gate direction-inverted."""
+    from benchmarks import history as bh
+    from benchmarks.replay import run_preempt_replay
+    hist = str(tmp_path / "hist.jsonl")
+    line = run_preempt_replay(sf=0.0005, rounds=2, stamp=True,
+                              history_path=hist)
+    assert line["replay_ok"], line
+    # honesty: >=1 OBSERVED suspend/resume cycle, not just armed
+    assert line["preempted"] >= 1 and line["resumed"] >= 1
+    assert line["gold_completed"] == 2
+    assert line["replay_preempt_p99_s"] > 0
+    tenants = line["service"]["tenants"]
+    assert tenants["bronze"]["preempted"] == line["preempted"]
+    assert tenants["bronze"]["completed"] == 2   # resumed AND finished
+    assert tenants["gold"]["preempted"] == 0     # only bronze parks
+    for t in ("gold", "bronze"):
+        assert tenants[t]["deviceBytes"] == 0    # watermarks at zero
+    assert line["service"]["suspended"] == 0     # nothing left parked
+    rounds = bh.load(hist)
+    assert len(rounds) == 1
+    assert set(rounds[0]["queries"]) == {bh.REPLAY_PREEMPT_P99_S}
+    assert bh.REPLAY_PREEMPT_P99_S in rounds[0]["invertedQueries"]

@@ -887,3 +887,70 @@ def test_ownership_sink_registry_roundtrip():
     stale = ownership.check_registry(defined - {"exec.spill.defer_finalizer"})
     assert len(stale) == 1 and stale[0].rule == "ownership-registry"
     assert "defer_finalizer" in stale[0].message
+
+
+# ---------------------------------------------------------------------------
+# cancel-point: blocking loops in drain/fetch modules must poll the token
+# ---------------------------------------------------------------------------
+
+def test_rule_cancel_point_flags_unpolled_while():
+    src = ("import time\n\ndef drain(q):\n"
+           "    while True:\n"
+           "        time.sleep(0.01)\n")
+    v = lint.lint_source(src, "exec/tasks.py")
+    assert "cancel-point" in _rules(v)
+    assert any(v_.rule == "cancel-point" and v_.line == 4 for v_ in v)
+
+
+def test_rule_cancel_point_poll_satisfies():
+    src = ("import time\nfrom .lifecycle import check_cancel\n\n"
+           "def drain(q):\n"
+           "    while True:\n"
+           "        check_cancel()\n"
+           "        time.sleep(0.01)\n")
+    assert "cancel-point" not in _rules(
+        lint.lint_source(src, "exec/tasks.py"))
+    dotted = ("import time\n\ndef drain(q):\n"
+              "    while True:\n"
+              "        lifecycle.interruptible_sleep(0.5)\n")
+    assert "cancel-point" not in _rules(
+        lint.lint_source(dotted, "shuffle/transport.py"))
+
+
+def test_rule_cancel_point_pragma_and_reason():
+    ok = ("def serve(sock):\n"
+          "    while True:  # lint: cancel-ok server conn thread, "
+          "no ambient query\n"
+          "        sock.recv(4)\n")
+    assert lint.lint_source(ok, "shuffle/transport.py") == []
+    bare = ("def serve(sock):\n"
+            "    while True:  # lint: cancel-ok\n"
+            "        sock.recv(4)\n")
+    v = lint.lint_source(bare, "shuffle/transport.py")
+    # a reason-less pragma does not silence the loop and is itself flagged
+    assert _rules(v) == {"cancel-point", "pragma-reason"}
+
+
+def test_rule_cancel_point_scoped_to_drain_modules():
+    src = ("import time\n\ndef spin():\n"
+           "    while True:\n"
+           "        time.sleep(0.01)\n")
+    assert "cancel-point" not in _rules(
+        lint.lint_source(src, "api/fixture.py"))
+    assert "cancel-point" not in _rules(
+        lint.lint_source(src, "service/fixture.py"))
+
+
+def test_rule_cancel_point_for_requires_blocking_call():
+    # a plain for loop is bounded work: exempt without a pragma
+    plain = ("def f(items):\n"
+             "    for it in items:\n"
+             "        handle(it)\n")
+    assert lint.lint_source(plain, "exec/tasks.py") == []
+    # a for loop that parks the thread (ev.wait) is a dwell: flagged
+    blocking = ("def f(items, ev):\n"
+                "    for it in items:\n"
+                "        ev.wait(1.0)\n")
+    v = lint.lint_source(blocking, "exec/tasks.py")
+    assert any(v_.rule == "cancel-point" and "blocking-for"
+               in v_.message for v_ in v)

@@ -177,7 +177,8 @@ def digest(query_id: str, records: List[dict], top: int = 5) -> str:
 def tenant_rollup(records: List[dict]) -> str:
     """Per-tenant summary across every record carrying a tenant id
     (service multi-tenancy, docs/service.md): query count, wall seconds,
-    rows, retries — empty string when no record is tenant-tagged.
+    rows, retries, and preempted/cancelled lifecycle counts — empty
+    string when no record is tenant-tagged.
     Multi-worker records sharing a query id count as ONE query (wall =
     the slowest worker, the digest() rule; rows/retries sum across
     workers, each worker returns/retries its own partitions)."""
@@ -192,7 +193,8 @@ def tenant_rollup(records: List[dict]) -> str:
     for (t, _qid), recs in by_query.items():
         e = by_tenant.setdefault(t, {"queries": 0, "wallS": 0.0,
                                      "rows": 0, "retries": 0,
-                                     "compileS": 0.0, "warm": 0})
+                                     "compileS": 0.0, "warm": 0,
+                                     "preempted": 0, "cancelled": 0})
         e["queries"] += 1
         e["wallS"] += max(float(r.get("wallS", 0) or 0) for r in recs)
         e["rows"] += sum(int(r.get("rows", 0) or 0) for r in recs)
@@ -204,6 +206,15 @@ def tenant_rollup(records: List[dict]) -> str:
             # served with zero synchronous build wall: a prewarm/async/
             # cache hit — the fraction of these is the prewarm hit rate
             e["warm"] += 1
+        # lifecycle transitions (exec/lifecycle.py, docs/service.md §4):
+        # a query counts as preempted/cancelled ONCE no matter how many
+        # suspend cycles or worker records it went through
+        states = {tr.get("state")
+                  for r in recs for tr in (r.get("lifecycle") or ())}
+        if "suspended" in states:
+            e["preempted"] += 1
+        if "cancelled" in states:
+            e["cancelled"] += 1
     if not by_tenant:
         return ""
     lines = ["per-tenant summary:"]
@@ -214,7 +225,9 @@ def tenant_rollup(records: List[dict]) -> str:
             f"wallS={round(e['wallS'], 4)} rows={e['rows']} "
             f"compileS={round(e['compileS'], 4)} "
             f"prewarmHitRate={round(hit, 3)}"
-            + (f" stageRetries={e['retries']}" if e["retries"] else ""))
+            + (f" stageRetries={e['retries']}" if e["retries"] else "")
+            + (f" preempted={e['preempted']}" if e["preempted"] else "")
+            + (f" cancelled={e['cancelled']}" if e["cancelled"] else ""))
     return "\n".join(lines)
 
 

@@ -15,10 +15,20 @@ history stamp) printing the per-tenant service stats::
     python -m tools.serve --faults "fetch.fail;task.poison"
 
 Tenants are declared ``name:key=value:...`` with keys ``priority``,
-``slots``, ``depth`` (max queue depth) and ``budget`` (device bytes,
-byte suffixes allowed)::
+``slots``, ``depth`` (max queue depth), ``budget`` (device bytes, byte
+suffixes allowed) and ``weight`` (the weighted-fair share under
+``--policy wfq``)::
 
-    --tenants "gold:priority=10:slots=2:budget=1g,bronze:priority=0"
+    --tenants "gold:priority=10:slots=2:budget=1g:weight=3,bronze:priority=0"
+
+Query lifecycle control (docs/service.md §4): ``--cancel-after`` /
+``--suspend-after`` / ``--resume-after`` arm timers that drive the
+service's ``cancel(query_id)`` / ``suspend(query_id)`` /
+``resume(query_id)`` surface against the live query — a one-process
+demonstration of cooperative cancellation and suspend/resume::
+
+    python -m tools.serve --sql "SELECT ..." --suspend-after 0.2 \
+        --resume-after 1.0
 """
 
 from __future__ import annotations
@@ -53,6 +63,8 @@ def parse_tenant_specs(text: str):
                 kw["max_queue_depth"] = int(v)
             elif k == "budget":
                 kw["memory_budget_bytes"] = parse_bytes(v)
+            elif k == "weight":
+                kw["weight"] = float(v)
             else:
                 raise ValueError(f"unknown tenant field {k!r} in {raw!r}")
         specs.append(TenantSpec(name, **kw))
@@ -82,6 +94,18 @@ def main(argv=None) -> int:
                     help="demo-traffic queries per stream")
     ap.add_argument("--faults", default=None,
                     help="chaos spec for the demo traffic")
+    ap.add_argument("--policy", choices=("priority", "wfq"), default=None,
+                    help="scheduler policy (service.scheduler.policy)")
+    ap.add_argument("--cancel-after", type=float, default=None,
+                    help="seconds after which the live query is "
+                         "cancelled via QueryService.cancel(query_id)")
+    ap.add_argument("--suspend-after", type=float, default=None,
+                    help="seconds after which the live query is parked "
+                         "via QueryService.suspend(query_id); pair with "
+                         "--resume-after or the ticket waits until close")
+    ap.add_argument("--resume-after", type=float, default=None,
+                    help="seconds after which suspended queries are "
+                         "re-admitted via QueryService.resume(query_id)")
     args = ap.parse_args(argv)
 
     if not args.sql:
@@ -96,10 +120,46 @@ def main(argv=None) -> int:
     from benchmarks import datagen
     from spark_rapids_tpu.api.session import TpuSession
     from spark_rapids_tpu.service.server import QueryService
-    session = TpuSession.builder.config(
-        {"spark.rapids.tpu.sql.explain": "NONE"}).getOrCreate()
+    conf = {"spark.rapids.tpu.sql.explain": "NONE"}
+    if args.policy:
+        conf["spark.rapids.tpu.sql.service.scheduler.policy"] = args.policy
+    session = TpuSession.builder.config(conf).getOrCreate()
     datagen.register_tables(session, args.sf)
     svc = QueryService(session, tenants=parse_tenant_specs(args.tenants))
+
+    def _lifecycle_timer(delay, op):
+        """Fire ``op`` against the live/suspended query ids after
+        ``delay`` seconds (the one-process lifecycle demo surface)."""
+        import threading
+        import time as _time
+        from spark_rapids_tpu.exec import lifecycle
+
+        def fire():
+            _time.sleep(delay)
+            if op == "resume":
+                ids = svc.suspended_queries()
+            else:
+                ids = lifecycle.live_queries()
+            for qid in ids:
+                try:
+                    done = getattr(svc, op)(qid)
+                except Exception as e:
+                    done = f"{type(e).__name__}: {e}"
+                print(json.dumps({"lifecycle": op, "queryId": qid,
+                                  "result": done}, default=str))
+
+        t = threading.Thread(target=fire, daemon=True,
+                             name=f"serve-{op}-timer")
+        t.start()
+        return t
+
+    timers = []
+    if args.cancel_after is not None:
+        timers.append(_lifecycle_timer(args.cancel_after, "cancel"))
+    if args.suspend_after is not None:
+        timers.append(_lifecycle_timer(args.suspend_after, "suspend"))
+    if args.resume_after is not None:
+        timers.append(_lifecycle_timer(args.resume_after, "resume"))
     rc = 0
     try:
         for sql in args.sql:
